@@ -1,0 +1,47 @@
+"""Test substrate: an 8-device virtual CPU mesh with Pallas TPU interpret mode.
+
+This replaces the reference's torchrun launcher + ``TRITON_INTERPRET=1``
+emulation (SURVEY §4): kernels run unmodified, with simulated HBM/VMEM,
+local + remote DMAs and semaphores (``pltpu.InterpretParams``).
+"""
+
+from triton_dist_tpu.runtime.platform import use_cpu_devices
+
+use_cpu_devices(8)  # must happen before the CPU backend initializes
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", False)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+from triton_dist_tpu.runtime.platform import cpu_mesh  # noqa: E402
+from triton_dist_tpu.runtime.mesh import DistContext, initialize_distributed  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    return cpu_mesh((8,), ("tp",))
+
+
+@pytest.fixture(scope="session")
+def ctx8(mesh8) -> DistContext:
+    return initialize_distributed(devices=list(mesh8.devices.flat), axis_names=("tp",))
+
+
+@pytest.fixture(scope="session")
+def ctx4():
+    m = cpu_mesh((4,), ("tp",))
+    return initialize_distributed(devices=list(m.devices.flat), axis_names=("tp",), set_default=False)
+
+
+@pytest.fixture(scope="session")
+def ctx2():
+    m = cpu_mesh((2,), ("tp",))
+    return initialize_distributed(devices=list(m.devices.flat), axis_names=("tp",), set_default=False)
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

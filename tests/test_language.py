@@ -1,0 +1,158 @@
+"""Tests for the ``tpl`` device language: signal ping-pong, barrier, ring put.
+
+Parity targets (SURVEY §4 + BASELINE config 01):
+ - reference ``tutorials/01-distributed-notify-wait`` signal ping-pong,
+ - ``test/nvidia/test_notify_wait.py``-style wait/notify ordering,
+ - ``common_ops`` barrier-all.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
+
+import triton_dist_tpu.language as tpl
+from triton_dist_tpu.shmem import dist_pallas_call, symm_zeros
+
+
+def shard(ctx, fn, in_specs, out_specs):
+    return jax.jit(
+        jax.shard_map(
+            fn, mesh=ctx.mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
+        )
+    )
+
+
+def test_rank_num_ranks(ctx8):
+    def kernel(out_ref):
+        out_ref[0] = tpl.rank("tp")
+        out_ref[1] = tpl.num_ranks("tp")
+
+    def body():
+        return dist_pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((2,), jnp.int32),
+            out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
+            collective=False,
+        )()
+
+    out = shard(ctx8, lambda: body()[None], (), P("tp"))()
+    np.testing.assert_array_equal(np.asarray(out)[:, 0], np.arange(8))
+    np.testing.assert_array_equal(np.asarray(out)[:, 1], np.full(8, 8))
+
+
+def test_notify_wait_ping_pong(ctx2):
+    """BASELINE config 01: 2-rank signal ping-pong.
+
+    Rank 0 puts its value to rank 1 with a completion signal; rank 1 waits,
+    doubles it, puts it back. Both sides also exercise consume_token.
+    """
+
+    def kernel(x_ref, out_ref, scratch, send_sem, recv_sem):
+        me = tpl.rank("tp")
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+        @pl.when(me == 0)
+        def _():
+            # send my data to rank 1's scratch
+            dma = tpl.putmem_signal(x_ref, scratch, send_sem, recv_sem, 1)
+            dma.start()
+            dma.wait_send()
+            # wait for the reply put into my out_ref
+            tpl.wait_recv(recv_sem, out_ref)
+
+        @pl.when(me == 1)
+        def _():
+            token = tpl.wait_recv(recv_sem, scratch)  # wait for rank 0's put
+            scratch[...] = tpl.consume_token(scratch[...], token) * 2.0
+            dma = tpl.putmem_signal(scratch, out_ref, send_sem, recv_sem, 0)
+            dma.start()
+            dma.wait_send()
+
+    def body(x):
+        return dist_pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            scratch_shapes=[
+                pltpu.VMEM(x.shape, x.dtype),
+                pltpu.SemaphoreType.DMA,
+                pltpu.SemaphoreType.DMA,
+            ],
+        )(x)
+
+    x = jnp.stack([jnp.full((8, 128), 3.0), jnp.zeros((8, 128))])
+    f = shard(ctx2, body, (P("tp"),), P("tp"))
+    out = np.asarray(f(x))
+    np.testing.assert_allclose(out[0], 6.0)  # rank0 got back 2*3
+    np.testing.assert_allclose(out[1], 0.0)
+
+
+def test_barrier_all_and_ring_put(ctx8):
+    """Every rank puts its shard to its right neighbor (ring), with a
+    barrier_all before reading — exercises tpl.barrier_all + ring_neighbor."""
+
+    def kernel(x_ref, out_ref, send_sem, recv_sem):
+        dst = tpl.ring_neighbor("tp", +1)
+        dma = tpl.putmem_signal(x_ref, out_ref, send_sem, recv_sem, dst)
+        dma.start()
+        tpl.wait_recv(recv_sem, out_ref)  # my left neighbor's put arrived
+        dma.wait_send()
+        tpl.barrier_all("tp")
+
+    def body(x):
+        return dist_pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            scratch_shapes=[pltpu.SemaphoreType.DMA, pltpu.SemaphoreType.DMA],
+        )(x)
+
+    x = jnp.arange(8 * 8 * 128, dtype=jnp.float32).reshape(8, 8, 128)
+    f = shard(ctx8, body, (P("tp"),), P("tp"))
+    out = np.asarray(f(x))
+    np.testing.assert_allclose(out, np.roll(np.asarray(x), 1, axis=0))
+
+
+def test_symm_zeros(ctx8):
+    buf = symm_zeros(ctx8, (4, 128), jnp.bfloat16, axis="tp")
+    assert buf.shape == (8, 4, 128)
+    assert len(buf.addressable_shards) == 8
+    assert buf.addressable_shards[0].data.shape == (1, 4, 128)
+
+
+def test_notify_remote_accumulate(ctx4):
+    """dl.notify with sig_op=add onto rank 0 from all ranks
+    (reference distributed_ops.py:103 SIGNAL_ADD path)."""
+
+    def kernel(out_ref, sem):
+        me = tpl.rank("tp")
+        world = tpl.num_ranks("tp")
+        tpl.notify(sem, 0, axis="tp")  # everyone (incl. 0) signals rank 0
+
+        @pl.when(me == 0)
+        def _():
+            tpl.wait(sem, world)
+            out_ref[0] = jnp.int32(1)
+
+        @pl.when(me != 0)
+        def _():
+            out_ref[0] = jnp.int32(0)
+
+    def body():
+        return dist_pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((1,), jnp.int32),
+            out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
+            scratch_shapes=[pltpu.SemaphoreType.REGULAR],
+        )()[None]
+
+    out = np.asarray(shard(ctx4, body, (), P("tp"))())
+    np.testing.assert_array_equal(out[:, 0], [1, 0, 0, 0])

@@ -1,0 +1,87 @@
+"""``tpl`` — the TPU device language for distributed Pallas kernels.
+
+TPU-native re-design of the reference's device language
+(``python/triton_dist/language/distributed_ops.py:57-111`` and
+``language/extra/libshmem_device.py:47-443``): the signal/wait/one-sided-put
+programming model, expressed over Mosaic semaphores and async remote DMA
+instead of an MLIR dialect — no compiler pass needed, because Mosaic already
+gives DMA/semaphore ordering semantics (SURVEY §7.2).
+
+Usage inside a Pallas kernel (itself inside ``jax.shard_map`` over a Mesh)::
+
+    import triton_dist_tpu.language as tpl
+
+    def kernel(x_ref, out_ref, sem, send_sem, recv_sem):
+        me = tpl.rank("tp")
+        world = tpl.num_ranks("tp")
+        tpl.putmem_signal(              # one-sided put + completion signal
+            src=x_ref, dst=out_ref.at[me],
+            send_sem=send_sem, recv_sem=recv_sem,
+            peer=tpl.ring_neighbor("tp", +1),
+            axis="tp",
+        ).start()
+        token = tpl.wait(sem, 1)        # spin-wait ≈ dl.wait
+        val = tpl.consume_token(x_ref[...], token)
+
+Mapping table (reference symbol → tpl):
+
+=========================================  =====================================
+reference (``distributed_ops.py`` etc.)    tpl
+=========================================  =====================================
+``dl.rank(axis)``                :84       ``tpl.rank(axis)``
+``dl.num_ranks(axis)``           :90       ``tpl.num_ranks(axis)``
+``dl.wait(ptr, n, scope, sem)``  :57       ``tpl.wait(sem_ref, value)``
+``dl.consume_token(v, token)``   :74       ``tpl.consume_token(v, token)``
+``dl.notify(ptr, rank, op)``     :103      ``tpl.notify(sem_ref, peer, axis=...)``
+``dl.symm_at(ptr, rank)``        :96       implicit: remote ``dst_ref`` + peer id
+``libshmem_device.putmem_signal_nbi``      ``tpl.putmem_signal(...).start()``
+``libshmem_device.signal_wait_until``      ``tpl.signal_wait_until``
+``libshmem_device.barrier_all[_block]``    ``tpl.barrier_all(axes)``
+``libshmem_device.quiet/fence``            ``tpl.quiet`` (wait on send sems)
+``libshmem_device.my_pe/n_pes``            ``tpl.rank()/num_ranks()``
+=========================================  =====================================
+"""
+
+from triton_dist_tpu.language.core import (
+    SIGNAL_SET,
+    SIGNAL_ADD,
+    rank,
+    num_ranks,
+    logical_device_id,
+    ring_neighbor,
+    wait,
+    wait_recv,
+    wait_send,
+    signal_wait_until,
+    notify,
+    consume_token,
+    putmem_signal,
+    putmem_nbi,
+    getmem_nbi,
+    local_copy,
+    barrier_all,
+    quiet,
+    semaphore_read,
+)
+
+__all__ = [
+    "SIGNAL_SET",
+    "SIGNAL_ADD",
+    "rank",
+    "num_ranks",
+    "logical_device_id",
+    "ring_neighbor",
+    "wait",
+    "wait_recv",
+    "wait_send",
+    "signal_wait_until",
+    "notify",
+    "consume_token",
+    "putmem_signal",
+    "putmem_nbi",
+    "getmem_nbi",
+    "local_copy",
+    "barrier_all",
+    "quiet",
+    "semaphore_read",
+]

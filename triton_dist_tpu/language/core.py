@@ -1,0 +1,289 @@
+"""Core ``tpl`` primitives. See package docstring for the mapping table.
+
+All functions are meant to be called *inside* a Pallas TPU kernel body that is
+itself invoked under ``jax.shard_map`` over a ``jax.sharding.Mesh`` — the mesh
+axes are the rank space (reference: NVSHMEM PEs / teams).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.pallas import tpu as pltpu
+
+# Signal ops (reference DistributedAttrDefs.td SignalOp SET/ADD). On TPU a
+# semaphore signal is always an increment; SET is emulated at the protocol
+# level (generation counting) — exposed for API parity and used by
+# kernels/common_ops.
+SIGNAL_SET = "set"
+SIGNAL_ADD = "add"
+
+
+# ----------------------------------------------------------------- rank query
+
+
+def rank(axis: str | Sequence[str] = "tp") -> jax.Array:
+    """This device's index along ``axis`` (``dl.rank``,
+    ``distributed_ops.py:84``; NVSHMEM ``my_pe``)."""
+    if isinstance(axis, str):
+        return jax.lax.axis_index(axis)
+    return jax.lax.axis_index(tuple(axis))
+
+
+def num_ranks(axis: str | Sequence[str] = "tp") -> int:
+    """World size along ``axis`` (``dl.num_ranks``, ``distributed_ops.py:90``;
+    NVSHMEM ``n_pes``). Static under tracing."""
+    if isinstance(axis, str):
+        return jax.lax.axis_size(axis)
+    n = 1
+    for a in axis:
+        n *= jax.lax.axis_size(a)
+    return n
+
+
+def logical_device_id(axis: str, peer, mesh_axes: Sequence[str] | None = None):
+    """Logical device id of the device whose coordinate along ``axis`` is
+    ``peer`` and whose other mesh coordinates equal ours.
+
+    This is the TPU analog of ``dl.symm_at(ptr, rank)`` peer addressing
+    (``distributed_ops.py:96``): remote memory is addressed by (buffer ref,
+    peer device id) instead of translated pointers.
+
+    ``mesh_axes`` must list *all* mesh axis names in order when the mesh has
+    more than one axis (needed to linearize; defaults to (axis,)).
+    """
+    if mesh_axes is None or tuple(mesh_axes) == (axis,):
+        return peer
+    idx = jnp.int32(0)
+    for a in mesh_axes:
+        size = jax.lax.axis_size(a)
+        coord = peer if a == axis else jax.lax.axis_index(a)
+        idx = idx * size + coord
+    return idx
+
+
+def ring_neighbor(axis: str, offset: int = 1, mesh_axes: Sequence[str] | None = None):
+    """Logical device id of the rank at ``(rank(axis) + offset) % world``."""
+    world = num_ranks(axis)
+    peer = jax.lax.rem(rank(axis) + offset + world, world)
+    return logical_device_id(axis, peer, mesh_axes)
+
+
+# ------------------------------------------------------------- sync primitives
+
+
+def wait(sem_ref, value: int | jax.Array = 1) -> jax.Array:
+    """Block until ``sem_ref`` reaches ``value``, then decrement by ``value``.
+
+    The TPU analog of ``dl.wait(barrierPtrs, numBarriers, scope, "acquire")``
+    (``distributed_ops.py:57``; PTX spin-wait lowering
+    ``NVIDIA/DistributedOpToLLVM.cpp:156-229``). Mosaic semaphore waits have
+    acquire semantics w.r.t. DMAs signaled on the same semaphore, so no
+    explicit memory-order argument exists. Returns a token (int32 0) for
+    ``consume_token`` parity.
+    """
+    pltpu.semaphore_wait(sem_ref, value)
+    return jnp.int32(0)
+
+
+def wait_recv(recv_sem, ref) -> jax.Array:
+    """Block until a remote put of ``ref``'s size has fully arrived.
+
+    DMA semaphores on TPU count *bytes*, not events — so the receiver of a
+    one-sided put (``putmem_signal``) waits for the byte count of the expected
+    message. This is the receiver half of the put-with-signal handshake
+    (reference ``libshmem_device.signal_wait_until`` on the data signal,
+    ``libshmem_device.py``). Returns a token for ``consume_token``.
+    """
+    pltpu.make_async_copy(ref, ref, recv_sem).wait()
+    return jnp.int32(0)
+
+
+def wait_send(send_sem, ref) -> jax.Array:
+    """Wait for local completion (source readability) of an outstanding put of
+    ``ref``'s size — the ``quiet``/``fence`` analog for a single message."""
+    pltpu.make_async_copy(ref, ref, send_sem).wait()
+    return jnp.int32(0)
+
+
+def signal_wait_until(sem_ref, value: int | jax.Array) -> jax.Array:
+    """``libshmem_device.signal_wait_until(sig_addr, CMP_GE, value)``
+    (``libshmem_device.py``): wait for ``value`` arrivals. Decrements, so
+    protocols must re-signal per generation (see kernels/common_ops)."""
+    return wait(sem_ref, value)
+
+
+def notify(
+    sem_ref,
+    peer=None,
+    *,
+    inc: int | jax.Array = 1,
+    axis: str | None = None,
+    mesh_axes: Sequence[str] | None = None,
+) -> None:
+    """Signal a semaphore — locally or on a peer device.
+
+    TPU analog of ``dl.notify(ptr, rank, signal=inc, sig_op="add", scope)``
+    (``distributed_ops.py:103``; lowering ``DistributedOpToLLVM.cpp:243-353``).
+    ``peer`` is an index along ``axis`` (or an absolute logical id when
+    ``axis`` is None). Local signal when ``peer`` is None.
+
+    CommScope GPU/INTRA_NODE/INTER_NODE collapses on TPU: ICI remote signals
+    use the same instruction regardless of distance; DCN-crossing transfers
+    should go through XLA collectives instead (SURVEY §7 hard-part (c)).
+    """
+    if peer is None:
+        pltpu.semaphore_signal(sem_ref, inc=inc)
+        return
+    device_id = logical_device_id(axis, peer, mesh_axes) if axis is not None else peer
+    pltpu.semaphore_signal(
+        sem_ref,
+        inc=inc,
+        device_id=device_id,
+        device_id_type=pltpu.DeviceIdType.LOGICAL,
+    )
+
+
+def consume_token(value, token):
+    """Create an artificial data dependency between a ``wait`` and a use.
+
+    Reference ``dl.consume_token`` (``distributed_ops.py:74``,
+    ``TT_ConsumeTokenOp`` ``DistributedOps.td:79``): prevents the compiler from
+    hoisting a load above its guarding wait. On TPU, Mosaic orders memory ops
+    with semaphore waits in program order, so this is usually unnecessary; we
+    provide it as an ``optimization_barrier`` for defense-in-depth and parity.
+    """
+    value, _ = jax.lax.optimization_barrier((value, token))
+    return value
+
+
+def semaphore_read(sem_ref):
+    """Non-blocking read of a semaphore's current value."""
+    return pltpu.semaphore_read(sem_ref)
+
+
+# ----------------------------------------------------------- one-sided put/get
+
+
+def putmem_signal(
+    src,
+    dst,
+    send_sem,
+    recv_sem,
+    peer,
+    *,
+    axis: str | None = None,
+    mesh_axes: Sequence[str] | None = None,
+):
+    """One-sided put of ``src`` into ``dst`` on ``peer``, signalling
+    ``recv_sem`` on the peer when complete.
+
+    Analog of ``libshmem_device.putmem_signal[_nbi]``
+    (``libshmem_device.py:159-241``) — the completion signal is fused into the
+    DMA (the receiver waits on ``recv_sem``), exactly the put-with-signal
+    semantics NVSHMEM exposes. Returns the descriptor; call ``.start()`` /
+    ``.wait()`` (wait = local send completion, i.e. ``quiet``).
+    """
+    device_id = logical_device_id(axis, peer, mesh_axes) if axis is not None else peer
+    return pltpu.make_async_remote_copy(
+        src_ref=src,
+        dst_ref=dst,
+        send_sem=send_sem,
+        recv_sem=recv_sem,
+        device_id=device_id,
+        device_id_type=pltpu.DeviceIdType.LOGICAL,
+    )
+
+
+# `putmem_nbi` == putmem_signal on TPU: every remote DMA carries its recv
+# semaphore (there is no unsignalled remote write), which is strictly stronger
+# than NVSHMEM's unordered nbi put + later fence.
+putmem_nbi = putmem_signal
+
+
+def getmem_nbi(*args, **kwargs):
+    """One-sided *get* (pull from peer) — NOT EXPRESSIBLE on TPU ICI.
+
+    TPU remote DMA is push-only: ``make_async_remote_copy`` always moves a
+    *local* source to a peer's destination. The reference's pull-style
+    collectives (``libshmem_device.getmem_nbi_block``; pull all-gather
+    ``kernels/nvidia/allgather.py:82``) are therefore redesigned push-from-
+    owner in this framework (see ``kernels/allgather.py``), which maps better
+    onto ICI DMA anyway. Raising instead of silently pushing the wrong way.
+    """
+    raise NotImplementedError(
+        "TPU remote DMA is push-only; restructure as putmem_signal from the "
+        "data owner (see triton_dist_tpu.kernels.allgather for the pattern)"
+    )
+
+
+def local_copy(src, dst, sem):
+    """Async local DMA (HBM↔VMEM↔HBM), the copy-engine analog
+    (reference CE producers, ``kernels/nvidia/allgather.py:82-232``)."""
+    return pltpu.make_async_copy(src, dst, sem)
+
+
+# ----------------------------------------------------------------- barriers
+
+
+def barrier_all(axis: str | Sequence[str] = "tp", mesh_axes: Sequence[str] | None = None) -> None:
+    """Device-side barrier over all ranks of ``axis``.
+
+    Analog of ``libshmem_device.barrier_all[_block]`` /
+    ``BarrierAllContext.barrier_all`` (``kernels/nvidia/common_ops.py:154-199``):
+    every rank signals every other rank's barrier semaphore, then waits for
+    world-1 arrivals. Uses the Mosaic global barrier semaphore — the calling
+    ``pallas_call`` must set ``CompilerParams(collective_id=...)``
+    (``dist_pallas_call`` does this automatically).
+
+    IMPORTANT: when the mesh has axes beyond ``axis`` (e.g. barrier over "tp"
+    in a ("dp","tp") mesh), ``mesh_axes`` MUST list all mesh axis names in
+    order — otherwise peer logical ids are computed over ``axis`` alone and
+    signals land on devices of a different group. Host-side kernel wrappers
+    plumb this from the context automatically.
+    """
+    axes = (axis,) if isinstance(axis, str) else tuple(axis)
+    barrier_sem = pltpu.get_barrier_semaphore()
+    world = num_ranks(axes)
+    me = rank(axes)  # linear index over `axes`
+
+    # Signal every peer (including a self-signal to keep the count uniform).
+    def signal_peer(i, _):
+        # i is the peer's linear index along `axes`; convert to logical id.
+        peer_linear = i
+        if mesh_axes is None and len(axes) == 1:
+            device_id = peer_linear
+        else:
+            # Decompose linear index over `axes`, then linearize over the full
+            # mesh keeping other coordinates fixed.
+            full = tuple(mesh_axes) if mesh_axes is not None else axes
+            coords = {}
+            rem = peer_linear
+            for a in reversed(axes):
+                size = jax.lax.axis_size(a)
+                coords[a] = jax.lax.rem(rem, size)
+                rem = jax.lax.div(rem, size)
+            device_id = jnp.int32(0)
+            for a in full:
+                size = jax.lax.axis_size(a)
+                c = coords[a] if a in coords else jax.lax.axis_index(a)
+                device_id = device_id * size + c
+        pltpu.semaphore_signal(
+            barrier_sem,
+            inc=1,
+            device_id=device_id,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        return 0
+
+    jax.lax.fori_loop(0, world, signal_peer, 0)
+    pltpu.semaphore_wait(barrier_sem, world)
+
+
+def quiet(dma_descriptors) -> None:
+    """Wait for local completion of outstanding puts
+    (``libshmem_device.quiet``). On TPU: wait each descriptor's send leg."""
+    for d in dma_descriptors:
+        d.wait_send()

@@ -1,0 +1,21 @@
+"""Host runtime: platform selection, mesh construction, distributed init, utils.
+
+TPU-native analog of the reference host runtime
+(``python/triton_dist/{utils.py,nv_utils.py,jit.py}``): instead of
+torchrun + NCCL process groups + NVSHMEM uniqueid broadcast
+(``utils.py:235-260``), we initialize ``jax.distributed`` (multi-host) and build
+a ``jax.sharding.Mesh`` whose axes play the role of NVSHMEM teams.
+"""
+
+from triton_dist_tpu.runtime.mesh import (
+    DistContext,
+    initialize_distributed,
+    finalize_distributed,
+    get_default_context,
+)
+from triton_dist_tpu.runtime.platform import (
+    use_cpu_devices,
+    cpu_mesh,
+    interpret_mode_default,
+    is_cpu_platform,
+)

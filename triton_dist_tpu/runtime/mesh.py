@@ -1,0 +1,166 @@
+"""Distributed initialization + device-mesh management.
+
+TPU-native analog of ``initialize_distributed()``
+(reference ``python/triton_dist/utils.py:235-260``): where the reference does
+``torchrun`` rendezvous → ``init_process_group("cpu:gloo,cuda:nccl")`` →
+NVSHMEM uniqueid broadcast → symmetric heap mapping, the TPU build does
+``jax.distributed.initialize()`` (multi-host rendezvous) → ``Mesh``
+construction over ``jax.devices()`` → symmetric buffers as mesh-sharded arrays
+(see ``triton_dist_tpu.shmem``).
+
+Mesh axes are the TPU analog of NVSHMEM teams / torch process groups:
+a named axis ("tp", "ep", "sp", "pp", "dp") identifies the rank set a
+collective runs over, and ``jax.lax.axis_index(axis)`` inside shard_map /
+Pallas is the analog of ``dl.rank()``
+(reference ``python/triton_dist/language/distributed_ops.py:84``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+from typing import Mapping, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+_DEFAULT_CONTEXT: "DistContext | None" = None
+_JAX_DISTRIBUTED_INITIALIZED = False
+
+
+@dataclasses.dataclass(frozen=True)
+class DistContext:
+    """Handle to the distributed runtime: the mesh plus rank/topology queries.
+
+    Plays the role of the reference's module-level distributed state
+    (torch PG + NVSHMEM team handles, ``utils.py:145-260``) but is an explicit
+    value — idiomatic for JAX's single-controller model.
+    """
+
+    mesh: Mesh
+
+    # ------------------------------------------------------------------ query
+    @property
+    def axis_names(self) -> tuple[str, ...]:
+        return tuple(self.mesh.axis_names)
+
+    def num_ranks(self, axis: str | Sequence[str] | None = None) -> int:
+        """World size along ``axis`` (all axes if None).
+
+        Analog of ``dl.num_ranks`` / ``nvshmem n_pes``
+        (``distributed_ops.py:90``, ``nvshmem_wrapper.cu``).
+        """
+        if axis is None:
+            return math.prod(self.mesh.shape.values())
+        if isinstance(axis, str):
+            return self.mesh.shape[axis]
+        return math.prod(self.mesh.shape[a] for a in axis)
+
+    @property
+    def world_size(self) -> int:
+        return self.num_ranks()
+
+    def process_index(self) -> int:
+        return jax.process_index()
+
+    # -------------------------------------------------------------- shardings
+    def sharding(self, *spec) -> NamedSharding:
+        """NamedSharding on this mesh from PartitionSpec entries."""
+        return NamedSharding(self.mesh, PartitionSpec(*spec))
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, PartitionSpec())
+
+    # ------------------------------------------------------------------ tools
+    def local_devices(self):
+        return [d for d in self.mesh.devices.flat if d.process_index == jax.process_index()]
+
+    def __repr__(self) -> str:  # pragma: no cover - cosmetic
+        shape = dict(self.mesh.shape)
+        return f"DistContext(mesh={shape}, processes={jax.process_count()})"
+
+
+def _build_mesh(
+    axis_names: Sequence[str],
+    axis_sizes: Sequence[int] | None,
+    devices=None,
+) -> Mesh:
+    devices = list(devices) if devices is not None else jax.devices()
+    n = len(devices)
+    if axis_sizes is None:
+        axis_sizes = [n] + [1] * (len(axis_names) - 1)
+    if math.prod(axis_sizes) != n:
+        raise ValueError(f"axis sizes {axis_sizes} do not multiply to #devices {n}")
+    arr = np.asarray(devices).reshape(tuple(axis_sizes))
+    return Mesh(arr, tuple(axis_names))
+
+
+def initialize_distributed(
+    axis_names: Sequence[str] = ("tp",),
+    axis_sizes: Sequence[int] | None = None,
+    *,
+    devices=None,
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+    seed: int | None = 42,
+    set_default: bool = True,
+) -> DistContext:
+    """Initialize the distributed runtime and build the device mesh.
+
+    Single-host: uses local ``jax.devices()``. Multi-host (the torchrun/MPI
+    analog): pass coordinator_address/num_processes/process_id or set the
+    standard env vars (``COORDINATOR_ADDRESS``, ``NUM_PROCESSES``,
+    ``PROCESS_ID``) and ``jax.distributed.initialize`` handles rendezvous the
+    way the reference's NCCL/gloo PG + NVSHMEM-uniqueid bootstrap does
+    (``utils.py:145-161``).
+
+    Reference parity: ``initialize_distributed`` (``utils.py:235``), including
+    the deterministic seeding of ``init_seed`` (``utils.py:115``).
+    """
+    global _JAX_DISTRIBUTED_INITIALIZED
+    coordinator_address = coordinator_address or os.environ.get("COORDINATOR_ADDRESS")
+    if coordinator_address and not _JAX_DISTRIBUTED_INITIALIZED:
+        # Must run BEFORE any jax.devices()/process_count() call initializes
+        # the local backend, or the process never joins the cluster.
+        if num_processes is None:
+            num_processes = int(os.environ.get("NUM_PROCESSES", "1"))
+        if process_id is None:
+            process_id = int(os.environ.get("PROCESS_ID", "0"))
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        _JAX_DISTRIBUTED_INITIALIZED = True
+
+    mesh = _build_mesh(axis_names, axis_sizes, devices)
+    ctx = DistContext(mesh=mesh)
+
+    if seed is not None:
+        # Deterministic seeding across processes (reference utils.py:115-134):
+        # every process derives the same root key; per-rank streams are
+        # produced functionally with jax.random.fold_in(key, rank).
+        np.random.seed(seed)
+
+    global _DEFAULT_CONTEXT
+    if set_default:
+        _DEFAULT_CONTEXT = ctx
+    return ctx
+
+
+def get_default_context() -> DistContext:
+    """Return the context from the last ``initialize_distributed`` call."""
+    if _DEFAULT_CONTEXT is None:
+        raise RuntimeError("call initialize_distributed() first")
+    return _DEFAULT_CONTEXT
+
+
+def finalize_distributed() -> None:
+    """Tear down distributed state (reference ``utils.py:206``)."""
+    global _DEFAULT_CONTEXT
+    _DEFAULT_CONTEXT = None
+    if jax.process_count() > 1:  # pragma: no cover - multi-host only
+        jax.distributed.shutdown()

@@ -1,0 +1,84 @@
+"""Platform / backend selection helpers.
+
+The reference emulates multi-node on one node by shrinking ``LOCAL_WORLD_SIZE``
+(SURVEY §4, ``test/nvidia/test_ag_gemm.py``) and uses ``TRITON_INTERPRET=1``
+for pure-python kernel emulation. The TPU build does better: an N-device
+virtual CPU mesh (``--xla_force_host_platform_device_count``) plus Pallas TPU
+*interpret mode* (``pltpu.InterpretParams``) simulates HBM/VMEM, local+remote
+DMAs and semaphores on CPU — including optional race detection
+(``detect_races=True``), which subsumes the reference's compute-sanitizer hook
+(``scripts/launch.sh:164-166``).
+"""
+
+from __future__ import annotations
+
+import os
+from functools import lru_cache
+
+_CPU_DEVICE_ENV = "--xla_force_host_platform_device_count"
+
+
+def _ensure_cpu_device_flag(n: int) -> None:
+    """Set (or update) the host-device-count XLA flag. Must run before the
+    CPU backend is initialized to have any effect."""
+    import re
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    new = f"{_CPU_DEVICE_ENV}={n}"
+    if _CPU_DEVICE_ENV in flags:
+        flags = re.sub(rf"{_CPU_DEVICE_ENV}=\d+", new, flags)
+    else:
+        flags = f"{flags} {new}".strip()
+    os.environ["XLA_FLAGS"] = flags
+
+
+def use_cpu_devices(n: int = 8) -> None:
+    """Force JAX onto N virtual CPU devices (test / simulation substrate).
+
+    Call before any JAX computation. Safe to call multiple times.
+    """
+    _ensure_cpu_device_flag(n)
+    import jax
+
+    # The environment may pin jax_platforms to an accelerator plugin (e.g. a
+    # tunneled TPU); override explicitly — env var JAX_PLATFORMS alone is not
+    # reliable when a plugin registers itself at import time.
+    jax.config.update("jax_platforms", "cpu")
+
+
+@lru_cache(maxsize=None)
+def is_cpu_platform() -> bool:
+    import jax
+
+    return jax.devices()[0].platform == "cpu"
+
+
+def interpret_mode_default(detect_races: bool = False):
+    """Return the value for ``pallas_call(interpret=...)`` on this platform.
+
+    On CPU returns ``pltpu.InterpretParams`` (full TPU simulation, incl. remote
+    DMA + semaphores); on real TPU returns ``False`` (compile via Mosaic).
+    """
+    if is_cpu_platform():
+        from jax.experimental.pallas import tpu as pltpu
+
+        return pltpu.InterpretParams(detect_races=detect_races)
+    return False
+
+
+def cpu_mesh(shape, axis_names):
+    """Build a Mesh of virtual CPU devices (row-major) for tests."""
+    import math
+
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    n = math.prod(shape)
+    devs = jax.devices("cpu")[:n]
+    if len(devs) < n:
+        raise RuntimeError(
+            f"need {n} CPU devices, have {len(devs)}; call use_cpu_devices({n}) "
+            "before any JAX computation"
+        )
+    return Mesh(np.asarray(devs).reshape(shape), axis_names)

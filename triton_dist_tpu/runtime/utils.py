@@ -1,0 +1,124 @@
+"""Host-side utilities: env flags, rank-filtered printing, timing, assertions.
+
+Reference parity: ``python/triton_dist/utils.py`` (``dist_print`` :333,
+``get_bool_env/get_int_env`` :726-750, ``sleep_async`` straggler injection
+:650, perf helpers :430-640).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import os
+import time
+from typing import Callable, Iterable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# ----------------------------------------------------------------- env flags
+
+
+def get_bool_env(name: str, default: bool = False) -> bool:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.lower() in ("1", "true", "yes", "on")
+
+
+def get_int_env(name: str, default: int = 0) -> int:
+    v = os.environ.get(name)
+    return int(v) if v is not None else default
+
+
+# ------------------------------------------------------------------ printing
+
+
+def dist_print(*args, prefix: bool = True, **kwargs) -> None:
+    """Print only on process 0 unless TDT_PRINT_ALL=1 (reference
+    ``dist_print`` allrank/prefix options, ``utils.py:333``)."""
+    if jax.process_index() == 0 or get_bool_env("TDT_PRINT_ALL"):
+        if prefix:
+            args = (f"[proc {jax.process_index()}]",) + args
+        print(*args, **kwargs)
+
+
+# -------------------------------------------------------------------- timing
+
+
+def block_until_ready(tree):
+    return jax.tree_util.tree_map(
+        lambda x: x.block_until_ready() if hasattr(x, "block_until_ready") else x, tree
+    )
+
+
+def bench_fn(
+    fn: Callable,
+    *args,
+    warmup: int = 5,
+    iters: int = 20,
+    **kwargs,
+) -> float:
+    """Median wall-clock ms of ``fn(*args)`` with device sync.
+
+    Analog of the reference's ``perf_func``/do_bench usage in every kernel test
+    (e.g. ``test/nvidia/test_ag_gemm.py``).
+    """
+    for _ in range(warmup):
+        block_until_ready(fn(*args, **kwargs))
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        block_until_ready(fn(*args, **kwargs))
+        times.append((time.perf_counter() - t0) * 1e3)
+    return float(np.median(times))
+
+
+# ---------------------------------------------------------------- assertions
+
+
+def assert_allclose(actual, expected, atol=2e-2, rtol=2e-2, msg: str = ""):
+    np.testing.assert_allclose(
+        np.asarray(actual, dtype=np.float32),
+        np.asarray(expected, dtype=np.float32),
+        atol=atol,
+        rtol=rtol,
+        err_msg=msg,
+    )
+
+
+# --------------------------------------------------- straggler / fault inject
+
+
+@contextlib.contextmanager
+def straggler(rank: int, delay_ms: float):
+    """Host-side straggler injection (reference ``sleep_async`` ``utils.py:650``
+    + ``straggler_option`` in ``allgather_gemm.py:539``).
+
+    Delays process ``rank`` once, at context entry — offsetting the dispatch
+    of whatever is issued inside the block to emulate a slow rank. For
+    per-iteration straggling, re-enter per iteration; for *device-side*
+    straggling inside a kernel, see ``tpl`` delay support in kernels that
+    accept a ``straggler_option``.
+    """
+    if jax.process_index() == rank:
+        time.sleep(delay_ms / 1e3)
+    yield
+
+
+# ------------------------------------------------------------------- helpers
+
+
+def cdiv(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+def round_up(a: int, b: int) -> int:
+    return cdiv(a, b) * b
+
+
+def per_rank_key(key: jax.Array, axis: str) -> jax.Array:
+    """Inside shard_map: derive a per-rank PRNG stream functionally
+    (replaces the reference's per-rank torch seeding, ``utils.py:115-134``)."""
+    return jax.random.fold_in(key, jax.lax.axis_index(axis))

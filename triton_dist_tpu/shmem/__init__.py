@@ -1,0 +1,32 @@
+"""``tpushmem`` — symmetric memory + kernel-launch layer (NVSHMEM analog).
+
+Reference parity (SURVEY §2.1/§2.6): the NVSHMEM/ROCSHMEM/MXSHMEM bindings
+(``shmem/*``), symmetric-heap tensor creation
+(``python/triton_dist/utils.py:169-197``) and the ``@triton_dist.jit`` launch
+wrapper (``python/triton_dist/jit.py:251``).
+
+TPU design: a "symmetric buffer" is a mesh-sharded array with one same-shape
+shard per rank — the shard IS the per-PE symmetric allocation, and remote
+access happens by (buffer, peer-device-id) addressing inside Pallas remote
+DMAs. ``dist_pallas_call`` is the launch wrapper: it injects platform-correct
+interpret params (CPU simulation), side-effect marking, and the collective id
+used by barrier semaphores — the role the post-compile NVSHMEM module-init
+hooks play in the reference (``jit.py:43-88``).
+"""
+
+from triton_dist_tpu.shmem.symm import (
+    symm_buffer,
+    symm_zeros,
+    symm_spec,
+    SymmSpec,
+)
+from triton_dist_tpu.shmem.kernel import dist_pallas_call, next_collective_id
+
+__all__ = [
+    "symm_buffer",
+    "symm_zeros",
+    "symm_spec",
+    "SymmSpec",
+    "dist_pallas_call",
+    "next_collective_id",
+]

@@ -1,0 +1,70 @@
+"""Distributed Pallas launch wrapper — the ``@triton_dist.jit`` analog.
+
+Reference (``python/triton_dist/jit.py``): wraps ``triton.jit`` to (a) link the
+NVSHMEM device library into every kernel (:91-121), (b) run module init hooks
+post-compile (:43-88), (c) rewrite the cubin when shmem symbols are present
+(:151-235). On TPU none of that machinery is needed — Mosaic lowers semaphore
+and remote-DMA ops natively — so the wrapper's job reduces to launch hygiene:
+
+* pick ``interpret=pltpu.InterpretParams(...)`` automatically on CPU (the
+  simulation/test substrate, SURVEY §4) and compile on real TPU;
+* mark communication kernels ``has_side_effects`` so XLA cannot DCE a launch
+  whose only effect is a DMA (pitfall #6 in the Pallas guide);
+* allocate a process-unique ``collective_id`` per kernel *site* so barrier
+  semaphores of different kernels never alias.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any
+
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from triton_dist_tpu.runtime.platform import interpret_mode_default
+
+_collective_ids = itertools.count(0)
+
+
+def next_collective_id() -> int:
+    """Process-unique collective id for barrier-semaphore-using kernels."""
+    return next(_collective_ids)
+
+
+def dist_pallas_call(
+    kernel,
+    *,
+    out_shape,
+    collective: bool = True,
+    collective_id: int | None = None,
+    interpret: Any | None = None,
+    detect_races: bool = False,
+    compiler_params: pltpu.CompilerParams | None = None,
+    **kwargs,
+):
+    """``pl.pallas_call`` with distributed launch defaults (see module doc).
+
+    ``collective=True`` marks a kernel that performs remote DMA / semaphore
+    signalling: it forces ``has_side_effects`` and assigns a collective id.
+    """
+    if compiler_params is None:
+        if collective_id is None and collective:
+            # Distinct id per launch site so barrier semaphores of different
+            # kernels traced into the same program never alias. SPMD tracing
+            # is identical on every process, so the counter stays consistent
+            # across ranks. Mosaic's barrier-semaphore pool is small — wrap.
+            collective_id = next_collective_id() % 32
+        compiler_params = pltpu.CompilerParams(
+            has_side_effects=collective,
+            collective_id=collective_id,
+        )
+    if interpret is None:
+        interpret = interpret_mode_default(detect_races=detect_races)
+    return pl.pallas_call(
+        kernel,
+        out_shape=out_shape,
+        compiler_params=compiler_params,
+        interpret=interpret,
+        **kwargs,
+    )

@@ -1,0 +1,57 @@
+"""Symmetric-memory buffers over a device mesh.
+
+Reference: ``nvshmem_create_tensor`` / ``nvshmem_create_tensors``
+(``python/triton_dist/utils.py:169-197``) allocate one same-shape tensor per
+PE on the symmetric heap and expose per-peer views for direct load/store.
+
+On TPU the same contract is expressed with sharding: a global array of shape
+``(world, *shape)`` partitioned along its leading axis gives every rank a
+local ``shape``-shaped shard in its HBM at a mesh-known location — Pallas
+remote DMAs address a peer's shard by (ref, logical device id). That is the
+whole symmetric heap: no allocator needed, XLA owns placement; "free" is
+letting the array die (reference ``nvshmem_free_tensor`` ``utils.py:200``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from triton_dist_tpu.runtime.mesh import DistContext
+
+
+@dataclasses.dataclass(frozen=True)
+class SymmSpec:
+    """Static description of a symmetric buffer (per-rank shape + dtype)."""
+
+    shape: tuple[int, ...]
+    dtype: jnp.dtype
+    axis: str = "tp"
+
+    def global_shape(self, ctx: DistContext) -> tuple[int, ...]:
+        return (ctx.num_ranks(self.axis), *self.shape)
+
+
+def symm_spec(shape: Sequence[int], dtype, axis: str = "tp") -> SymmSpec:
+    return SymmSpec(tuple(shape), jnp.dtype(dtype), axis)
+
+
+def symm_zeros(ctx: DistContext, shape: Sequence[int], dtype, axis: str = "tp") -> jax.Array:
+    """Allocate a zero-filled symmetric buffer: each rank of ``axis`` holds a
+    ``shape``-shaped shard (``nvshmem_create_tensor``, ``utils.py:169``)."""
+    world = ctx.num_ranks(axis)
+    sharding = NamedSharding(ctx.mesh, PartitionSpec(axis))
+    return jax.device_put(jnp.zeros((world, *shape), dtype=dtype), sharding)
+
+
+def symm_buffer(ctx: DistContext, local_value: jax.Array, axis: str = "tp") -> jax.Array:
+    """Build a symmetric buffer from a host value replicated per rank
+    (each rank's shard starts as ``local_value``)."""
+    world = ctx.num_ranks(axis)
+    stacked = jnp.broadcast_to(local_value[None], (world, *local_value.shape))
+    sharding = NamedSharding(ctx.mesh, PartitionSpec(axis))
+    return jax.device_put(stacked, sharding)

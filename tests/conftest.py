@@ -3,6 +3,14 @@
 This replaces the reference's torchrun launcher + ``TRITON_INTERPRET=1``
 emulation (SURVEY §4): kernels run unmodified, with simulated HBM/VMEM,
 local + remote DMAs and semaphores (``pltpu.InterpretParams``).
+
+IMPORTANT (sim substrate limitation): on this single-core host, interpret-mode
+collective kernels deadlock when any single kernel buffer allocation is
+≳128 KB — the blocking semaphore-wait callbacks starve the CPU client's
+async-work pool that materialises large buffer-init operands. Keep every
+per-kernel buffer (inputs, outputs, scratch) ≤ 64 KB in tests; protocol
+correctness is shape-independent, so small shapes lose no coverage. Real-TPU
+runs are unaffected.
 """
 
 from triton_dist_tpu.runtime.platform import use_cpu_devices

@@ -1,0 +1,99 @@
+"""Collective kernel tests on the 8-device CPU-sim mesh.
+
+Parity model (SURVEY §4): each test builds a jax.lax reference (the torch.
+distributed analog) and asserts allclose — mirroring e.g.
+``test/nvidia/test_allreduce.py --check`` / ``test_ag_gemm.py`` reference
+checks.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from triton_dist_tpu.kernels import (
+    AllGatherMethod,
+    AllReduceMethod,
+    all_gather_shard,
+    all_reduce_shard,
+    reduce_scatter_shard,
+    p2p_put_shard,
+    barrier_all_on_device,
+)
+
+
+def shard(ctx, fn, in_specs, out_specs):
+    return jax.jit(
+        jax.shard_map(fn, mesh=ctx.mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False)
+    )
+
+
+@pytest.mark.parametrize("method", [AllGatherMethod.RING_1D, AllGatherMethod.FULL_MESH_PUSH])
+def test_all_gather_shard(ctx8, rng, method):
+    x = jnp.asarray(rng.standard_normal((8 * 16, 128)), jnp.float32)
+
+    def fn(xs):
+        out = all_gather_shard(xs, axis="tp", method=method)
+        return out.reshape(-1, out.shape[-1])
+
+    out = shard(ctx8, fn, (P("tp"),), P())(x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x), rtol=0, atol=0)
+
+
+def test_all_gather_bf16_fullmesh(ctx8, rng):
+    x = jnp.asarray(rng.standard_normal((8 * 16, 256)), jnp.bfloat16)
+
+    def fn(xs):
+        out = all_gather_shard(xs, axis="tp", method=AllGatherMethod.FULL_MESH_PUSH)
+        return out.reshape(-1, out.shape[-1])
+
+    out = shard(ctx8, fn, (P("tp"),), P())(x)
+    np.testing.assert_array_equal(np.asarray(out, np.float32), np.asarray(x, np.float32))
+
+
+def test_reduce_scatter_shard(ctx8, rng):
+    # Every rank holds a full (128, 128) partial; result: rank r owns summed rows.
+    per_rank = jnp.asarray(rng.standard_normal((8, 128, 128)), jnp.float32)
+
+    def fn(x_local):
+        return reduce_scatter_shard(x_local[0], axis="tp")
+
+    out = shard(ctx8, fn, (P("tp"),), P("tp"))(per_rank)
+    expect = np.asarray(per_rank).sum(axis=0)
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("method", [AllReduceMethod.ONE_SHOT, AllReduceMethod.TWO_SHOT])
+def test_all_reduce_shard(ctx8, rng, method):
+    # NOTE: per-buffer allocations in CPU-sim kernels must stay < ~64 KB
+    # (interpret-mode limitation on this host, see tests/conftest.py).
+    per_rank = jnp.asarray(rng.standard_normal((8, 16, 128)), jnp.float32)
+
+    def fn(x_local):
+        return all_reduce_shard(x_local[0], axis="tp", method=method)[None]
+
+    out = shard(ctx8, fn, (P("tp"),), P("tp"))(per_rank)
+    expect = np.asarray(per_rank).sum(axis=0)
+    for r in range(8):
+        np.testing.assert_allclose(np.asarray(out)[r], expect, rtol=1e-4, atol=1e-5, err_msg=f"rank {r}")
+
+
+def test_p2p_shift(ctx4, rng):
+    x = jnp.asarray(rng.standard_normal((4 * 8, 128)), jnp.float32)
+
+    def fn(xs):
+        return p2p_put_shard(xs, axis="tp", offset=1)
+
+    out = shard(ctx4, fn, (P("tp"),), P("tp"))(x)
+    expect = np.roll(np.asarray(x).reshape(4, 8, 128), 1, axis=0).reshape(32, 128)
+    np.testing.assert_array_equal(np.asarray(out), expect)
+
+
+def test_barrier_all_on_device(ctx8):
+    def fn():
+        barrier_all_on_device(axis="tp")
+        return jnp.zeros((1,), jnp.int32)
+
+    out = shard(ctx8, lambda: fn()[None], (), P("tp"))()
+    assert np.asarray(out).shape == (8, 1)

@@ -1,0 +1,65 @@
+"""Distributed kernel library (reference: ``python/triton_dist/kernels/nvidia``).
+
+Every op comes in two forms:
+
+* ``*_shard`` — operates on the *local shard* inside an enclosing
+  ``jax.shard_map`` over the context mesh. This is the composable form used by
+  layers/models (the analog of calling a triton_dist kernel from a larger
+  program).
+* a standalone host wrapper that applies ``shard_map`` + ``jit`` itself,
+  mirroring the reference's host-side ops (``ag_gemm``, ``gemm_rs``, ...).
+
+Contexts (``create_*_context``) carry method selection and static config — the
+TPU analog of the reference's symmetric-buffer/stream contexts (§2.4); actual
+symmetric buffers are materialised by XLA as sharded arrays, so contexts here
+are cheap, stateless descriptors.
+"""
+
+from triton_dist_tpu.kernels.common_ops import (
+    barrier_all_on_device,
+    copy_tensor_shard,
+)
+from triton_dist_tpu.kernels.allgather import (
+    AllGatherMethod,
+    AllGatherContext,
+    create_allgather_context,
+    get_auto_all_gather_method,
+    all_gather_shard,
+    all_gather,
+)
+from triton_dist_tpu.kernels.reduce_scatter import (
+    ReduceScatterContext,
+    create_reduce_scatter_context,
+    reduce_scatter_shard,
+    reduce_scatter,
+)
+from triton_dist_tpu.kernels.allreduce import (
+    AllReduceMethod,
+    get_auto_all_reduce_method,
+    create_all_reduce_context,
+    all_reduce_shard,
+    all_reduce,
+)
+from triton_dist_tpu.kernels.p2p import p2p_put_shard, p2p_send_recv
+
+__all__ = [
+    "barrier_all_on_device",
+    "copy_tensor_shard",
+    "AllGatherMethod",
+    "AllGatherContext",
+    "create_allgather_context",
+    "get_auto_all_gather_method",
+    "all_gather_shard",
+    "all_gather",
+    "ReduceScatterContext",
+    "create_reduce_scatter_context",
+    "reduce_scatter_shard",
+    "reduce_scatter",
+    "AllReduceMethod",
+    "get_auto_all_reduce_method",
+    "create_all_reduce_context",
+    "all_reduce_shard",
+    "all_reduce",
+    "p2p_put_shard",
+    "p2p_send_recv",
+]

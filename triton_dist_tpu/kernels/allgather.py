@@ -1,0 +1,220 @@
+"""AllGather built from one-sided remote DMAs.
+
+Reference: ``python/triton_dist/kernels/nvidia/allgather.py`` — copy-engine
+full-mesh push/pull producers (:82-232), 1D ring (:150), NUMA-aware 2D ring,
+NVSHMEM inter-node producers (:295-489), and ``get_auto_all_gather_method``
+(:57). TPU redesign:
+
+* **ring_1d** — each chip forwards the chunk it just received to its +1 ICI
+  neighbour; ``world-1`` steps, each moving ``shard_bytes``. Bandwidth-optimal
+  on a torus and the default for large messages.
+* **full_mesh_push** — every chip puts its shard directly to all peers.
+  ``world-1`` concurrent DMAs; latency-optimal for small messages (the
+  reference's ``pull/push_numa_2d`` small-message variants map here).
+* **xla** — ``jax.lax.all_gather``: the baseline the custom paths must beat,
+  and the DCN-crossing fallback (SURVEY §7 hard-part (c)).
+
+All methods are *push from the data owner* — TPU remote DMA has no pull
+(see ``tpl.getmem_nbi``), so the reference's pull variants are not ported.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
+
+import triton_dist_tpu.language as tpl
+from triton_dist_tpu.runtime.mesh import DistContext
+from triton_dist_tpu.shmem.kernel import dist_pallas_call
+
+
+class AllGatherMethod(enum.Enum):
+    """Reference ``AllGatherMethod`` (``allgather.py:46``), TPU members."""
+
+    AUTO = "auto"
+    RING_1D = "ring_1d"
+    FULL_MESH_PUSH = "full_mesh_push"
+    XLA = "xla"
+
+
+def get_auto_all_gather_method(shard_bytes: int, world: int) -> AllGatherMethod:
+    """Size-based auto selection (reference ``get_auto_all_gather_method``,
+    ``allgather.py:57``: full-mesh for small, ring for large / NUMA-crossing).
+
+    Small shards → one-shot full-mesh (latency: 1 hop instead of world-1);
+    large shards → ring (each link carries shard_bytes per step, all links
+    busy every step)."""
+    if shard_bytes <= 128 * 1024:
+        return AllGatherMethod.FULL_MESH_PUSH
+    return AllGatherMethod.RING_1D
+
+
+@dataclasses.dataclass(frozen=True)
+class AllGatherContext:
+    """Static AG config (the TPU analog of the reference's symm-buffer ctx,
+    ``create_ag_gemm_context`` ``allgather_gemm.py:475`` — buffers themselves
+    are XLA-managed here)."""
+
+    ctx: DistContext
+    axis: str = "tp"
+    method: AllGatherMethod = AllGatherMethod.AUTO
+
+    @property
+    def world(self) -> int:
+        return self.ctx.num_ranks(self.axis)
+
+    def resolve(self, shard) -> AllGatherMethod:
+        if self.method is not AllGatherMethod.AUTO:
+            return self.method
+        nbytes = shard.size * shard.dtype.itemsize
+        return get_auto_all_gather_method(nbytes, self.world)
+
+
+def create_allgather_context(
+    ctx: DistContext, axis: str = "tp", method: AllGatherMethod = AllGatherMethod.AUTO
+) -> AllGatherContext:
+    return AllGatherContext(ctx=ctx, axis=axis, method=method)
+
+
+# --------------------------------------------------------------------- kernels
+
+
+def _ring_ag_kernel(x_ref, out_ref, send_sem, recv_sem, copy_sem, *, axis, mesh_axes):
+    """1D ring all-gather: out[(world, *shard)] filled in world-1 steps.
+
+    Chunk flow: at step s, I send out[(me-s) % world] (received at step s-1,
+    or my own shard at s=0) to my +1 neighbour; simultaneously my -1 neighbour
+    delivers chunk (me-s-1) % world into my out.
+    """
+    me = tpl.rank(axis)
+    world = tpl.num_ranks(axis)
+    right = tpl.ring_neighbor(axis, +1, mesh_axes=mesh_axes)
+
+    # Local shard into its slot (HBM→HBM local DMA).
+    cp = pltpu.make_async_copy(x_ref, out_ref.at[me], copy_sem)
+    cp.start()
+    cp.wait()
+
+    # Peers may still be in a previous kernel using out_ref; rendezvous first.
+    tpl.barrier_all(axis, mesh_axes=mesh_axes)
+
+    def step(s, _):
+        src = jax.lax.rem(me - s + world, world)  # chunk I forward
+        # Per-step semaphore slots: ranks drift around the ring (no global
+        # lockstep), so slot reuse could alias a fast neighbour's step s+2
+        # arrival with my step-s wait. One slot per step removes the hazard.
+        slot = s
+        dma = pltpu.make_async_remote_copy(
+            src_ref=out_ref.at[src],
+            dst_ref=out_ref.at[src],
+            send_sem=send_sem.at[slot],
+            recv_sem=recv_sem.at[slot],
+            device_id=right,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        dma.start()
+        # Chunk (me-s-1)%world arrives from my left neighbour on the same slot.
+        arriving = jax.lax.rem(me - s - 1 + world, world)
+        pltpu.make_async_copy(out_ref.at[arriving], out_ref.at[arriving], recv_sem.at[slot]).wait()
+        dma.wait_send()
+        return 0
+
+    jax.lax.fori_loop(0, world - 1, step, 0)
+
+
+def _fullmesh_ag_kernel(x_ref, out_ref, send_sem, recv_sem, copy_sem, *, axis, mesh_axes):
+    """Full-mesh push: put my shard to every peer's out[me] slot, then wait for
+    world-1 arrivals (reference push producer ``allgather.py:82-148``)."""
+    me = tpl.rank(axis)
+    world = tpl.num_ranks(axis)
+
+    cp = pltpu.make_async_copy(x_ref, out_ref.at[me], copy_sem)
+    cp.start()
+    cp.wait()
+
+    tpl.barrier_all(axis, mesh_axes=mesh_axes)
+
+    def send(i, _):
+        peer = jax.lax.rem(me + i, world)  # skew start so links are balanced
+        dma = tpl.putmem_signal(
+            x_ref, out_ref.at[me], send_sem, recv_sem, peer, axis=axis, mesh_axes=mesh_axes
+        )
+        dma.start()
+        return 0
+
+    jax.lax.fori_loop(1, world, send, 0)
+
+    def wait_one(i, _):
+        src = jax.lax.rem(me + i, world)
+        # Each arrival delivers one shard-sized chunk; recv_sem counts bytes.
+        pltpu.make_async_copy(out_ref.at[src], out_ref.at[src], recv_sem).wait()
+        pltpu.make_async_copy(x_ref, x_ref, send_sem).wait()  # drain send leg
+        return 0
+
+    jax.lax.fori_loop(1, world, wait_one, 0)
+
+
+def _ag_pallas(shard, *, axis, mesh_axes, method):
+    world = jax.lax.axis_size(axis)
+    kernel = _ring_ag_kernel if method is AllGatherMethod.RING_1D else _fullmesh_ag_kernel
+    out = dist_pallas_call(
+        functools.partial(kernel, axis=axis, mesh_axes=mesh_axes),
+        out_shape=jax.ShapeDtypeStruct((world, *shard.shape), shard.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA((max(world - 1, 1),)),
+            pltpu.SemaphoreType.DMA((max(world - 1, 1),)),
+            pltpu.SemaphoreType.DMA,
+        ]
+        if kernel is _ring_ag_kernel
+        else [pltpu.SemaphoreType.DMA, pltpu.SemaphoreType.DMA, pltpu.SemaphoreType.DMA],
+    )(shard)
+    return out
+
+
+def all_gather_shard(
+    shard: jax.Array,
+    *,
+    axis: str = "tp",
+    mesh_axes=None,
+    method: AllGatherMethod = AllGatherMethod.AUTO,
+) -> jax.Array:
+    """All-gather the local ``shard`` over mesh ``axis`` → ``(world, *shard)``.
+
+    Usable inside ``shard_map``. ``method=XLA`` lowers to
+    ``jax.lax.all_gather`` (compiler-scheduled); other methods run the Pallas
+    one-sided-DMA kernels above.
+    """
+    if method is AllGatherMethod.AUTO:
+        nbytes = shard.size * shard.dtype.itemsize
+        method = get_auto_all_gather_method(nbytes, jax.lax.axis_size(axis))
+    if method is AllGatherMethod.XLA or jax.lax.axis_size(axis) == 1:
+        return jax.lax.all_gather(shard, axis)
+    return _ag_pallas(shard, axis=axis, mesh_axes=mesh_axes, method=method)
+
+
+def all_gather(ag_ctx: AllGatherContext, x: jax.Array) -> jax.Array:
+    """Standalone host op: ``x`` sharded on dim 0 over ``axis`` → replicated
+    gathered array (reference host AG ops, ``allgather.py:238-291``)."""
+    axis = ag_ctx.axis
+    mesh = ag_ctx.ctx.mesh
+    mesh_axes = ag_ctx.ctx.axis_names
+
+    def fn(x_shard):
+        out = all_gather_shard(
+            x_shard, axis=axis, mesh_axes=mesh_axes, method=ag_ctx.method
+        )
+        return out.reshape((-1, *out.shape[2:]))
+
+    shard_f = jax.shard_map(
+        fn, mesh=mesh, in_specs=P(axis), out_specs=P(), check_vma=False
+    )
+    return jax.jit(shard_f)(x)

@@ -1,0 +1,55 @@
+"""Common device ops: barriers and copies.
+
+Reference: ``python/triton_dist/kernels/nvidia/common_ops.py`` — grid barriers,
+``BarrierAllContext`` intra-node barrier-all (:154-199), host signal helpers
+(:364-409). On TPU the grid-barrier family collapses: a Pallas kernel *is* a
+single program per chip (no cooperative-grid sync needed), and host
+``cuStreamWriteValue``-style signal ops have no analog (XLA owns the stream) —
+cross-kernel ordering comes from data dependencies instead.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+import triton_dist_tpu.language as tpl
+from triton_dist_tpu.shmem.kernel import dist_pallas_call
+
+
+def barrier_all_on_device(axis: str = "tp", mesh_axes=None) -> None:
+    """Launch a kernel that is just a barrier over ``axis``.
+
+    Analog of ``barrier_all_on_stream`` (``common_ops.py:200-226``): a
+    standalone synchronization point between ranks, usable inside shard_map.
+    """
+
+    def kernel(out_ref):
+        tpl.barrier_all(axis, mesh_axes=mesh_axes)
+        out_ref[0] = jnp.int32(0)
+
+    dist_pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((1,), jnp.int32),
+        out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
+    )()
+
+
+def copy_tensor_shard(src: jax.Array, out_dtype=None) -> jax.Array:
+    """DMA copy through a Pallas kernel (reference ``memory_ops.copy_tensor``,
+    ``memory_ops.py:250-560``). Mostly useful as a building block / benchmark
+    of HBM bandwidth; XLA copies are otherwise free-standing."""
+    out_dtype = out_dtype or src.dtype
+
+    def kernel(x_ref, o_ref):
+        o_ref[...] = x_ref[...].astype(out_dtype)
+
+    return dist_pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(src.shape, out_dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        collective=False,
+    )(src)

@@ -1,0 +1,68 @@
+"""Point-to-point one-sided transfers (pipeline-parallel transport).
+
+Reference: ``python/triton_dist/kernels/nvidia/p2p.py`` (150 LoC) — SM-driven
+put/get used by ``layers/nvidia/pp_block.py``. TPU: a single remote DMA with a
+recv-semaphore handshake; the get path is redesigned as a push from the owner
+(TPU DMA is push-only, see ``tpl.getmem_nbi``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
+
+import triton_dist_tpu.language as tpl
+from triton_dist_tpu.runtime.mesh import DistContext
+from triton_dist_tpu.shmem.kernel import dist_pallas_call
+
+
+def _p2p_kernel(x_ref, out_ref, send_sem, recv_sem, copy_sem, *, axis, mesh_axes, offset):
+    """Every rank sends its buffer to rank+offset and receives from
+    rank-offset (a ppermute — the building block of PP stage handoff)."""
+    dst = tpl.ring_neighbor(axis, offset, mesh_axes=mesh_axes)
+    dma = tpl.putmem_signal(x_ref, out_ref, send_sem, recv_sem, dst)
+    dma.start()
+    tpl.wait_recv(recv_sem, out_ref)
+    dma.wait_send()
+    tpl.barrier_all(axis, mesh_axes=mesh_axes)
+
+
+def p2p_put_shard(
+    x: jax.Array, *, axis: str = "pp", offset: int = 1, mesh_axes=None, use_xla: bool = False
+) -> jax.Array:
+    """Shift shards by ``offset`` along the ring of ``axis``
+    (rank r's result = rank r-offset's input). Usable inside shard_map."""
+    world = jax.lax.axis_size(axis)
+    if use_xla or world == 1:
+        perm = [(i, (i + offset) % world) for i in range(world)]
+        return jax.lax.ppermute(x, axis, perm)
+    return dist_pallas_call(
+        functools.partial(_p2p_kernel, axis=axis, mesh_axes=mesh_axes, offset=offset),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+        ],
+    )(x)
+
+
+def p2p_send_recv(ctx: DistContext, x: jax.Array, *, axis: str = "pp", offset: int = 1) -> jax.Array:
+    """Standalone host op: shift ``x`` (sharded on dim 0 over ``axis``) by
+    ``offset`` stages (reference host p2p ops)."""
+    mesh_axes = ctx.axis_names
+
+    def fn(x_local):
+        return p2p_put_shard(x_local, axis=axis, offset=offset, mesh_axes=mesh_axes)
+
+    shard_f = jax.shard_map(
+        fn, mesh=ctx.mesh, in_specs=P(axis), out_specs=P(axis), check_vma=False
+    )
+    return jax.jit(shard_f)(x)

@@ -1,0 +1,223 @@
+"""ReduceScatter built from one-sided remote DMAs.
+
+Reference: ``python/triton_dist/kernels/nvidia/reduce_scatter.py`` —
+``ReduceScatter2DContext`` (:48), intra-node scatter + local reduce
+(:551,:639), inter-node p2p ring + ring-reduce (:472,:780),
+``reduce_scatter_2d_op`` (:822). TPU redesign:
+
+* **ring** — classic reduce-scatter ring over the ICI axis: each chip owns one
+  output chunk; partial sums travel ``world-1`` hops, each hop adds the local
+  contribution. Accumulation in fp32 (MXU/VPU native) regardless of the wire
+  dtype. Bandwidth-optimal; one link-width per step.
+* **xla** — ``jax.lax.psum_scatter`` fallback/baseline.
+
+The reference's separate "scatter then local-reduce" shape (symm buffer of
+world× shards + ``kernel_ring_reduce``) is fused here: the add happens on the
+receive path of each ring step, which is what its inter-node
+``ring_reduce_after_scatter`` converges to anyway.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
+
+import triton_dist_tpu.language as tpl
+from triton_dist_tpu.runtime.mesh import DistContext
+from triton_dist_tpu.shmem.kernel import dist_pallas_call
+
+
+@dataclasses.dataclass(frozen=True)
+class ReduceScatterContext:
+    """Reference ``ReduceScatter2DContext`` (``reduce_scatter.py:48``)."""
+
+    ctx: DistContext
+    axis: str = "tp"
+    use_xla: bool = False
+    accum_dtype: jnp.dtype = jnp.float32
+
+
+def create_reduce_scatter_context(
+    ctx: DistContext, axis: str = "tp", use_xla: bool = False
+) -> ReduceScatterContext:
+    return ReduceScatterContext(ctx=ctx, axis=axis, use_xla=use_xla)
+
+
+def _ring_rs_kernel(
+    x_ref,  # (world, chunk_m, n) partial sums, HBM
+    out_ref,  # (chunk_m, n)
+    recv_buf,  # HBM (2, chunk_m, n) landing zone for incoming partials (dummy output)
+    send_buf,  # HBM (2, chunk_m, n) staged outgoing partials (dummy output)
+    acc_ref,  # VMEM (chunk_m, n) wire dtype — running sum, also the send stage
+    tmp_in,  # VMEM (chunk_m, n)
+    tmp_x,  # VMEM (chunk_m, n)
+    send_sem,
+    recv_sem,
+    copy_sem,
+    copy_sem2,
+    credit_sem,
+    *,
+    axis,
+    mesh_axes,
+    accum_dtype,
+):
+    """Ring reduce-scatter.
+
+    Chunk ``c`` starts at rank ``(c+1) % world`` and travels +1 around the
+    ring, accumulating each host's partial, finishing at rank ``c``. At step
+    ``s``, rank ``me`` sends the running sum for chunk ``(me - s - 1) % world``
+    and receives chunk ``(me - s - 2) % world`` (arriving sums exclude my own
+    contribution, which I add before forwarding / finalising).
+    """
+    me = tpl.rank(axis)
+    world = tpl.num_ranks(axis)
+    right = tpl.ring_neighbor(axis, +1, mesh_axes=mesh_axes)
+    left = tpl.ring_neighbor(axis, -1, mesh_axes=mesh_axes)
+
+    tpl.barrier_all(axis, mesh_axes=mesh_axes)
+
+    # Stage my partial for chunk (me-1): copy into send_buf[0] via VMEM acc.
+    first = jax.lax.rem(me - 1 + world, world)
+    cp = pltpu.make_async_copy(x_ref.at[first], send_buf.at[0], copy_sem)
+    cp.start()
+    cp.wait()
+
+    def step(s, _):
+        send_slot = jax.lax.rem(s, 2)
+        recv_slot = jax.lax.rem(s, 2)
+
+        # Backpressure: ranks drift (no global lockstep on a ring), so my
+        # +1 neighbour's recv slot s%2 may still hold unconsumed data from
+        # step s-2. Wait for its "slot free" credit before re-sending into it.
+        @pl.when(s >= 2)
+        def _():
+            tpl.wait(credit_sem, 1)
+
+        dma = pltpu.make_async_remote_copy(
+            src_ref=send_buf.at[send_slot],
+            dst_ref=recv_buf.at[recv_slot],
+            send_sem=send_sem.at[send_slot],
+            recv_sem=recv_sem.at[recv_slot],
+            device_id=right,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        dma.start()
+        # Receive the running sum for chunk (me - s - 2).
+        incoming = jax.lax.rem(me - s - 2 + 2 * world, world)
+        pltpu.make_async_copy(recv_buf.at[recv_slot], recv_buf.at[recv_slot], recv_sem.at[recv_slot]).wait()
+        dma.wait_send()
+        # HBM → VMEM: incoming partial and my own partial for that chunk
+        # (HBM refs cannot be read by the VPU directly).
+        cp_in = pltpu.make_async_copy(recv_buf.at[recv_slot], tmp_in, copy_sem)
+        cp_in.start()
+        cp_x = pltpu.make_async_copy(x_ref.at[incoming], tmp_x, copy_sem2)
+        cp_x.start()
+        cp_in.wait()
+        cp_x.wait()
+        # Running sum in fp32, re-quantised to the wire dtype per hop (the
+        # wire carries partials, so precision matches the ring algorithm).
+        acc_ref[...] = (
+            tmp_in[...].astype(accum_dtype) + tmp_x[...].astype(accum_dtype)
+        ).astype(acc_ref.dtype)
+        # recv slot consumed — grant my -1 neighbour a send credit for it.
+        tpl.notify(credit_sem, left)
+
+        # Forward (next step's send) or finalise.
+        @pl.when(s + 1 < world - 1)
+        def _():
+            nxt = jax.lax.rem(s + 1, 2)
+            cp2 = pltpu.make_async_copy(acc_ref, send_buf.at[nxt], copy_sem)
+            cp2.start()
+            cp2.wait()
+
+        return 0
+
+    # world is static (mesh shape); world==1 is short-circuited by the caller.
+    jax.lax.fori_loop(0, world - 1, step, 0)
+    out_ref[...] = acc_ref[...]
+    # Drain unconsumed credits (granted world-1, consumed max(world-3,0))
+    # so the semaphore is zero at kernel exit.
+    tpl.wait(credit_sem, min(world - 1, 2))
+
+    # Ranks drift; make buffer reuse across calls safe.
+    tpl.barrier_all(axis, mesh_axes=mesh_axes)
+
+
+def reduce_scatter_shard(
+    x: jax.Array,  # (world * chunk_m, n) local partial sums
+    *,
+    axis: str = "tp",
+    mesh_axes=None,
+    use_xla: bool = False,
+    accum_dtype=jnp.float32,
+) -> jax.Array:
+    """Reduce-scatter local partials over ``axis``: returns this rank's
+    ``(chunk_m, n)`` chunk of the sum. Usable inside shard_map."""
+    world = jax.lax.axis_size(axis)
+    if use_xla or world == 1:
+        return jax.lax.psum_scatter(x, axis, scatter_dimension=0, tiled=True)
+    assert x.shape[0] % world == 0, (x.shape, world)
+    chunk_m = x.shape[0] // world
+    xw = x.reshape(world, chunk_m, *x.shape[1:])
+    # NOTE (VMEM): acc/send/recv buffers hold one chunk each; callers tile
+    # large inputs (gemm_rs does) so chunks fit on-chip.
+    wire_dtype = x.dtype
+    chunk_shape = (chunk_m, *x.shape[1:])
+    # Comm buffers are extra ANY (HBM) *outputs*, not scratch: scratch is
+    # VMEM/SMEM-only (interpret mode enforces it; on hw ANY-scratch would
+    # alias real HBM anyway). The dummy outputs are dropped.
+    out, _, _ = dist_pallas_call(
+        functools.partial(
+            _ring_rs_kernel, axis=axis, mesh_axes=mesh_axes, accum_dtype=accum_dtype
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct(chunk_shape, x.dtype),
+            jax.ShapeDtypeStruct((2, *chunk_shape), wire_dtype),
+            jax.ShapeDtypeStruct((2, *chunk_shape), wire_dtype),
+        ),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=(
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM(chunk_shape, wire_dtype),
+            pltpu.VMEM(chunk_shape, wire_dtype),
+            pltpu.VMEM(chunk_shape, wire_dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.REGULAR,
+        ],
+    )(xw)
+    return out
+
+
+def reduce_scatter(rs_ctx: ReduceScatterContext, x: jax.Array) -> jax.Array:
+    """Standalone host op: every rank holds partial sums ``x``; result is the
+    summed array scattered on dim 0 (reference ``reduce_scatter_2d_op``,
+    ``reduce_scatter.py:822``)."""
+    axis = rs_ctx.axis
+    mesh_axes = rs_ctx.ctx.axis_names
+
+    def fn(x_local):
+        return reduce_scatter_shard(
+            x_local,
+            axis=axis,
+            mesh_axes=mesh_axes,
+            use_xla=rs_ctx.use_xla,
+            accum_dtype=rs_ctx.accum_dtype,
+        )
+
+    shard_f = jax.shard_map(
+        fn, mesh=rs_ctx.ctx.mesh, in_specs=P(), out_specs=P(axis), check_vma=False
+    )
+    return jax.jit(shard_f)(x)

@@ -183,8 +183,10 @@ def putmem_signal(
     Analog of ``libshmem_device.putmem_signal[_nbi]``
     (``libshmem_device.py:159-241``) — the completion signal is fused into the
     DMA (the receiver waits on ``recv_sem``), exactly the put-with-signal
-    semantics NVSHMEM exposes. Returns the descriptor; call ``.start()`` /
-    ``.wait()`` (wait = local send completion, i.e. ``quiet``).
+    semantics NVSHMEM exposes. Returns the descriptor; call ``.start()`` then
+    ``.wait_send()`` for local send completion (``quiet``). ``.wait()`` would
+    additionally wait the recv semaphore — only correct on ranks that also
+    receive a same-sized message on ``recv_sem``.
     """
     device_id = logical_device_id(axis, peer, mesh_axes) if axis is not None else peer
     return pltpu.make_async_remote_copy(
@@ -233,8 +235,9 @@ def barrier_all(axis: str | Sequence[str] = "tp", mesh_axes: Sequence[str] | Non
 
     Analog of ``libshmem_device.barrier_all[_block]`` /
     ``BarrierAllContext.barrier_all`` (``kernels/nvidia/common_ops.py:154-199``):
-    every rank signals every other rank's barrier semaphore, then waits for
-    world-1 arrivals. Uses the Mosaic global barrier semaphore — the calling
+    every rank signals all ``world`` ranks (including itself, keeping counts
+    uniform), then waits for ``world`` arrivals. Uses the Mosaic global
+    barrier semaphore — the calling
     ``pallas_call`` must set ``CompilerParams(collective_id=...)``
     (``dist_pallas_call`` does this automatically).
 
@@ -247,7 +250,6 @@ def barrier_all(axis: str | Sequence[str] = "tp", mesh_axes: Sequence[str] | Non
     axes = (axis,) if isinstance(axis, str) else tuple(axis)
     barrier_sem = pltpu.get_barrier_semaphore()
     world = num_ranks(axes)
-    me = rank(axes)  # linear index over `axes`
 
     # Signal every peer (including a self-signal to keep the count uniform).
     def signal_peer(i, _):

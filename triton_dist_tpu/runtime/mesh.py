@@ -160,7 +160,8 @@ def get_default_context() -> DistContext:
 
 def finalize_distributed() -> None:
     """Tear down distributed state (reference ``utils.py:206``)."""
-    global _DEFAULT_CONTEXT
+    global _DEFAULT_CONTEXT, _JAX_DISTRIBUTED_INITIALIZED
     _DEFAULT_CONTEXT = None
     if jax.process_count() > 1:  # pragma: no cover - multi-host only
         jax.distributed.shutdown()
+    _JAX_DISTRIBUTED_INITIALIZED = False

@@ -25,11 +25,25 @@ from jax.experimental.pallas import tpu as pltpu
 from triton_dist_tpu.runtime.platform import interpret_mode_default
 
 _collective_ids = itertools.count(0)
+_collective_id_registry: dict[str, int] = {}
 
 
 def next_collective_id() -> int:
     """Process-unique collective id for barrier-semaphore-using kernels."""
     return next(_collective_ids)
+
+
+def collective_id_for(name: str) -> int:
+    """Stable collective id keyed by kernel name.
+
+    Re-tracing the same kernel (new shapes) reuses its id, so ids are not
+    burned per trace; distinct kernel names get distinct ids while fewer than
+    32 collective kernels exist in the program (Mosaic's barrier-semaphore
+    pool). Registration order is trace order, identical across SPMD processes.
+    """
+    if name not in _collective_id_registry:
+        _collective_id_registry[name] = len(_collective_id_registry) % 32
+    return _collective_id_registry[name]
 
 
 def dist_pallas_call(
@@ -50,11 +64,11 @@ def dist_pallas_call(
     """
     if compiler_params is None:
         if collective_id is None and collective:
-            # Distinct id per launch site so barrier semaphores of different
-            # kernels traced into the same program never alias. SPMD tracing
-            # is identical on every process, so the counter stays consistent
-            # across ranks. Mosaic's barrier-semaphore pool is small — wrap.
-            collective_id = next_collective_id() % 32
+            # Stable id per kernel so barrier semaphores of different kernels
+            # traced into the same program never alias, while retraces of the
+            # same kernel reuse their id. SPMD tracing is identical on every
+            # process, so the registry stays consistent across ranks.
+            collective_id = collective_id_for(getattr(kernel, "__qualname__", repr(kernel)))
         compiler_params = pltpu.CompilerParams(
             has_side_effects=collective,
             collective_id=collective_id,

@@ -42,16 +42,23 @@ def symm_spec(shape: Sequence[int], dtype, axis: str = "tp") -> SymmSpec:
 
 def symm_zeros(ctx: DistContext, shape: Sequence[int], dtype, axis: str = "tp") -> jax.Array:
     """Allocate a zero-filled symmetric buffer: each rank of ``axis`` holds a
-    ``shape``-shaped shard (``nvshmem_create_tensor``, ``utils.py:169``)."""
+    ``shape``-shaped shard (``nvshmem_create_tensor``, ``utils.py:169``).
+
+    Allocated shard-by-shard in place (jit with out_shardings), never
+    materialising the world× array on one device."""
     world = ctx.num_ranks(axis)
     sharding = NamedSharding(ctx.mesh, PartitionSpec(axis))
-    return jax.device_put(jnp.zeros((world, *shape), dtype=dtype), sharding)
+    return jax.jit(
+        lambda: jnp.zeros((world, *shape), dtype=dtype), out_shardings=sharding
+    )()
 
 
 def symm_buffer(ctx: DistContext, local_value: jax.Array, axis: str = "tp") -> jax.Array:
     """Build a symmetric buffer from a host value replicated per rank
     (each rank's shard starts as ``local_value``)."""
     world = ctx.num_ranks(axis)
-    stacked = jnp.broadcast_to(local_value[None], (world, *local_value.shape))
     sharding = NamedSharding(ctx.mesh, PartitionSpec(axis))
-    return jax.device_put(stacked, sharding)
+    return jax.jit(
+        lambda v: jnp.broadcast_to(v[None], (world, *local_value.shape)),
+        out_shardings=sharding,
+    )(local_value)

@@ -41,6 +41,33 @@ from triton_dist_tpu.kernels.allreduce import (
     all_reduce,
 )
 from triton_dist_tpu.kernels.p2p import p2p_put_shard, p2p_send_recv
+from triton_dist_tpu.kernels.gemm import (
+    GemmConfig,
+    get_config_space,
+    gemm,
+    gemm_swiglu,
+)
+from triton_dist_tpu.kernels.allgather_gemm import (
+    AGGemmMethod,
+    AGGemmContext,
+    create_ag_gemm_context,
+    ag_gemm_shard,
+    ag_gemm,
+)
+from triton_dist_tpu.kernels.gemm_reduce_scatter import (
+    GemmRSMethod,
+    GemmRSContext,
+    create_gemm_rs_context,
+    gemm_rs_shard,
+    gemm_rs,
+)
+from triton_dist_tpu.kernels.gemm_allreduce import (
+    GemmARMethod,
+    GemmARContext,
+    create_gemm_ar_context,
+    gemm_ar_shard,
+    gemm_ar,
+)
 
 __all__ = [
     "barrier_all_on_device",
@@ -62,4 +89,23 @@ __all__ = [
     "all_reduce",
     "p2p_put_shard",
     "p2p_send_recv",
+    "GemmConfig",
+    "get_config_space",
+    "gemm",
+    "gemm_swiglu",
+    "AGGemmMethod",
+    "AGGemmContext",
+    "create_ag_gemm_context",
+    "ag_gemm_shard",
+    "ag_gemm",
+    "GemmRSMethod",
+    "GemmRSContext",
+    "create_gemm_rs_context",
+    "gemm_rs_shard",
+    "gemm_rs",
+    "GemmARMethod",
+    "GemmARContext",
+    "create_gemm_ar_context",
+    "gemm_ar_shard",
+    "gemm_ar",
 ]

@@ -64,17 +64,8 @@ class AllGatherContext:
 
     ctx: DistContext
     axis: str = "tp"
-    method: AllGatherMethod = AllGatherMethod.AUTO
-
-    @property
-    def world(self) -> int:
-        return self.ctx.num_ranks(self.axis)
-
-    def resolve(self, shard) -> AllGatherMethod:
-        if self.method is not AllGatherMethod.AUTO:
-            return self.method
-        nbytes = shard.size * shard.dtype.itemsize
-        return get_auto_all_gather_method(nbytes, self.world)
+    method: AllGatherMethod = AllGatherMethod.AUTO  # AUTO resolved per-call
+    # by all_gather_shard via get_auto_all_gather_method.
 
 
 def create_allgather_context(

@@ -1,0 +1,273 @@
+"""AG-GEMM: tile-pipelined AllGather → GEMM (the north-star op).
+
+Reference: ``python/triton_dist/kernels/nvidia/allgather_gemm.py`` — CE/NVSHMEM
+producers fill a symmetric buffer setting per-rank signals; a persistent GEMM
+consumer ``dl.wait``s on the rank-range covering its M-tile, rank-swizzled so
+each rank starts on its local shard (:165-270, :534-616). TPU redesign — two
+overlap engines:
+
+* **xla_ring** — the collective-matmul decomposition: ``world`` unrolled
+  steps, each ``(m, k) @ (k, n_local)`` on the chunk currently held, with a
+  ``ppermute`` rotating the A-shard ring-wise. XLA's latency-hiding scheduler
+  runs each step's collective-permute concurrently with the next step's MXU
+  work — the compiler-scheduled analog of the reference's
+  producer/consumer-signal pipeline (and the "async collective fusion" pattern
+  of Wang et al.'s "Overlap Communication with Dependent Computation" /
+  the collective-matmul in XLA SPMD). Rank-swizzle falls out for free: step 0
+  computes on the local shard, exactly like the reference's swizzled tile
+  order (``allgather_gemm.py:227-241``).
+* **pallas_fused** — one kernel: ring-forward remote DMA of A chunks, MXU
+  GEMM on the chunk in hand while the next chunk is in flight; per-chunk
+  arrival waits are the semaphore analog of ``dl.wait`` + ``consume_token``.
+  Whole (m, k) and (k, n_local) panels live in VMEM — the small/medium-M
+  regime (decode, the regime where the reference's custom path wins most).
+
+Also returns the gathered A when requested (reference ``ag_gemm`` returns the
+AG result for reuse in later layers, ``allgather_gemm.py:534``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
+
+import triton_dist_tpu.language as tpl
+from triton_dist_tpu.runtime.mesh import DistContext
+from triton_dist_tpu.shmem.kernel import dist_pallas_call
+
+
+class AGGemmMethod(enum.Enum):
+    AUTO = "auto"
+    XLA_RING = "xla_ring"
+    PALLAS_FUSED = "pallas_fused"
+    XLA_AG_THEN_GEMM = "xla_ag_then_gemm"  # unoverlapped baseline
+
+
+@dataclasses.dataclass(frozen=True)
+class AGGemmContext:
+    """Static config (reference ``create_ag_gemm_context``,
+    ``allgather_gemm.py:475`` — symm workspace is XLA-managed here)."""
+
+    ctx: DistContext
+    axis: str = "tp"
+    method: AGGemmMethod = AGGemmMethod.AUTO
+
+
+def create_ag_gemm_context(
+    ctx: DistContext, axis: str = "tp", method: AGGemmMethod = AGGemmMethod.AUTO
+) -> AGGemmContext:
+    return AGGemmContext(ctx=ctx, axis=axis, method=method)
+
+
+def _resolve_method(method: AGGemmMethod, m_shard: int, k: int, dtype) -> AGGemmMethod:
+    if method is not AGGemmMethod.AUTO:
+        return method
+    # The fused kernel keeps the whole (m, k) A panel + (k, n) B panel in
+    # VMEM; use it in the small-M (decode) regime, XLA ring otherwise.
+    panel_bytes = m_shard * k * jnp.dtype(dtype).itemsize
+    if panel_bytes <= 2 * 1024 * 1024:
+        return AGGemmMethod.PALLAS_FUSED
+    return AGGemmMethod.XLA_RING
+
+
+# ------------------------------------------------------------------- xla ring
+
+
+def _ag_gemm_xla_ring(a, b, *, axis, accum_dtype=jnp.float32, return_gathered=False):
+    world = jax.lax.axis_size(axis)
+    me = jax.lax.axis_index(axis)
+    m, _ = a.shape
+    n = b.shape[1]
+
+    parts = []
+    chunks = []
+    a_cur = a
+    perm = [(i, (i + 1) % world) for i in range(world)]
+    for s in range(world):  # static unroll: maximum scheduling freedom
+        parts.append(jnp.dot(a_cur, b, preferred_element_type=accum_dtype).astype(a.dtype))
+        if return_gathered:
+            chunks.append(a_cur)
+        if s + 1 < world:
+            a_cur = jax.lax.ppermute(a_cur, axis, perm)
+
+    # parts[s] is the product with rank (me - s) % world's shard.
+    order = jnp.mod(me - jnp.arange(world), world)
+    out = jnp.zeros((world, m, n), a.dtype).at[order].set(jnp.stack(parts))
+    out = out.reshape(world * m, n)
+    if return_gathered:
+        ag = jnp.zeros((world, m, a.shape[1]), a.dtype).at[order].set(jnp.stack(chunks))
+        return out, ag.reshape(world * m, a.shape[1])
+    return out
+
+
+# --------------------------------------------------------------- pallas fused
+
+
+def _ag_gemm_fused_kernel(
+    a_ref,  # (m, k) ANY — local shard
+    b_ref,  # (k, n) VMEM — local weight panel
+    out_ref,  # (world*m, n) VMEM
+    a_buf,  # (world, m, k) ANY dummy output — symmetric gather workspace
+    a_vmem,  # (2, m, k) VMEM — compute staging, double-buffered
+    send_sem,  # DMA (world-1,)
+    recv_sem,  # DMA (world-1,)
+    copy_sem,  # DMA (2,)
+    *,
+    axis,
+    mesh_axes,
+):
+    """Ring-forward producer fused with per-chunk GEMM consumer.
+
+    Step ``s`` computes on chunk ``(me - s) % world`` while the ring DMA for
+    the next chunk is in flight — compute hides communication exactly like the
+    reference's persistent consumer waiting per-tile signals
+    (``allgather_gemm.py:242-243``).
+    """
+    me = tpl.rank(axis)
+    world = tpl.num_ranks(axis)
+    right = tpl.ring_neighbor(axis, +1, mesh_axes=mesh_axes)
+    m = a_ref.shape[0]
+
+    cp = pltpu.make_async_copy(a_ref, a_buf.at[me], copy_sem.at[0])
+    cp.start()
+    cp.wait()
+    tpl.barrier_all(axis, mesh_axes=mesh_axes)
+
+    def stage_in(s, src, slot):
+        cpv = pltpu.make_async_copy(a_buf.at[src], a_vmem.at[slot], copy_sem.at[slot])
+        cpv.start()
+        return cpv
+
+    # Prefetch my own chunk into VMEM slot 0.
+    stage_in(0, me, 0).wait()
+
+    def step(s, _):
+        src = jax.lax.rem(me - s + world, world)
+        slot = jax.lax.rem(s, 2)
+
+        @pl.when(s < world - 1)
+        def _():
+            # Ring-forward the chunk I hold (per-step sem slots: ranks drift).
+            dma = pltpu.make_async_remote_copy(
+                src_ref=a_buf.at[src],
+                dst_ref=a_buf.at[src],
+                send_sem=send_sem.at[s],
+                recv_sem=recv_sem.at[s],
+                device_id=right,
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            )
+            dma.start()
+
+        # MXU work on the chunk in hand — overlaps the DMA above.
+        token = jnp.int32(0)
+        prod = jnp.dot(
+            tpl.consume_token(a_vmem[slot], token),
+            b_ref[...],
+            preferred_element_type=jnp.float32,
+        )
+        out_ref[pl.ds(src * m, m), :] = prod.astype(out_ref.dtype)
+
+        @pl.when(s < world - 1)
+        def _():
+            nxt = jax.lax.rem(me - s - 1 + world, world)
+            # Wait arrival of the next chunk (dl.wait analog), then stage it.
+            pltpu.make_async_copy(a_buf.at[nxt], a_buf.at[nxt], recv_sem.at[s]).wait()
+            pltpu.make_async_copy(a_buf.at[src], a_buf.at[src], send_sem.at[s]).wait()
+            stage_in(s + 1, nxt, jax.lax.rem(s + 1, 2)).wait()
+
+        return 0
+
+    jax.lax.fori_loop(0, world, step, 0)
+    tpl.barrier_all(axis, mesh_axes=mesh_axes)
+
+
+def _ag_gemm_pallas(a, b, *, axis, mesh_axes):
+    world = jax.lax.axis_size(axis)
+    m, k = a.shape
+    n = b.shape[1]
+    out, a_buf = dist_pallas_call(
+        functools.partial(_ag_gemm_fused_kernel, axis=axis, mesh_axes=mesh_axes),
+        out_shape=(
+            jax.ShapeDtypeStruct((world * m, n), a.dtype),
+            jax.ShapeDtypeStruct((world, m, k), a.dtype),
+        ),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, m, k), a.dtype),
+            pltpu.SemaphoreType.DMA((max(world - 1, 1),)),
+            pltpu.SemaphoreType.DMA((max(world - 1, 1),)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )(a, b)
+    return out, a_buf.reshape(world * m, k)
+
+
+# ----------------------------------------------------------------- public API
+
+
+def ag_gemm_shard(
+    a: jax.Array,  # (m_shard, k) — A row-shard of this rank
+    b: jax.Array,  # (k, n_shard) — B column-shard of this rank
+    *,
+    axis: str = "tp",
+    mesh_axes=None,
+    method: AGGemmMethod = AGGemmMethod.AUTO,
+    return_gathered: bool = False,
+):
+    """Compute ``all_gather(A) @ B_local`` with comm/compute overlap.
+
+    Usable inside shard_map: returns the ``(world * m_shard, n_shard)`` local
+    output (plus the gathered A when ``return_gathered``). Reference host op
+    ``ag_gemm`` (``allgather_gemm.py:534``).
+    """
+    world = jax.lax.axis_size(axis)
+    method = _resolve_method(method, a.shape[0], a.shape[1], a.dtype)
+    if world == 1:
+        out = jnp.dot(a, b, preferred_element_type=jnp.float32).astype(a.dtype)
+        return (out, a) if return_gathered else out
+
+    if method is AGGemmMethod.XLA_AG_THEN_GEMM:
+        ag = jax.lax.all_gather(a, axis, tiled=True)
+        out = jnp.dot(ag, b, preferred_element_type=jnp.float32).astype(a.dtype)
+        return (out, ag) if return_gathered else out
+
+    if method is AGGemmMethod.PALLAS_FUSED:
+        out, ag = _ag_gemm_pallas(a, b, axis=axis, mesh_axes=mesh_axes)
+        return (out, ag) if return_gathered else out
+
+    return _ag_gemm_xla_ring(a, b, axis=axis, return_gathered=return_gathered)
+
+
+def ag_gemm(ag_ctx: AGGemmContext, a: jax.Array, b: jax.Array) -> jax.Array:
+    """Standalone host op: A sharded on rows, B sharded on cols over ``axis``;
+    returns the full ``A @ B`` sharded on columns."""
+    axis = ag_ctx.axis
+    mesh_axes = ag_ctx.ctx.axis_names
+
+    def fn(a_shard, b_shard):
+        return ag_gemm_shard(
+            a_shard, b_shard, axis=axis, mesh_axes=mesh_axes, method=ag_ctx.method
+        )
+
+    shard_f = jax.shard_map(
+        fn,
+        mesh=ag_ctx.ctx.mesh,
+        in_specs=(P(axis), P(None, axis)),
+        out_specs=P(None, axis),
+        check_vma=False,
+    )
+    return jax.jit(shard_f)(a, b)

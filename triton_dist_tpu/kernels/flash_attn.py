@@ -1,0 +1,199 @@
+"""Pallas flash attention (prefill) with GQA and causal masking.
+
+TPU-native design (not a port of the reference's triton flash kernels): grid
+``(batch*q_heads, q_blocks, kv_blocks)`` with the KV dimension innermost and
+"arbitrary" semantics; online-softmax running max/sum live in VMEM scratch as
+``(block_q, LANES)`` tiles (the VPU-friendly layout). GQA is folded into the
+BlockSpec index maps — a q head reads its kv head's block directly, no
+materialised head broadcast. Optionally returns the log-sum-exp, the hook the
+distributed decode / ring-attention combines need (reference
+``kernels/nvidia/flash_decode.py:308-566`` combine path).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from triton_dist_tpu.runtime.platform import interpret_mode_default
+
+LANES = 128
+NEG_INF = -1e30
+
+
+def _flash_kernel(
+    q_ref,  # (1, bq, d)
+    k_ref,  # (1, bk, d)
+    v_ref,  # (1, bk, d)
+    o_ref,  # (1, bq, d)
+    lse_ref,  # (1, 1, bq) or None
+    acc_scr,  # VMEM (bq, d) f32
+    m_scr,  # VMEM (bq, LANES) f32
+    l_scr,  # VMEM (bq, LANES) f32
+    *,
+    scale: float,
+    causal: bool,
+    block_q: int,
+    block_k: int,
+    n_kv: int,
+    kv_len: int,
+    sq: int,
+):
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _():
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+
+    def compute():
+        q = q_ref[0]  # (bq, d)
+        k = k_ref[0]  # (bk, d)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (bq, bk)
+        s *= scale
+
+        if causal:
+            # End-aligned (KV-cache) convention: query row i sits at absolute
+            # position kv_len - sq + iq*bq + i, so a prefill continuation
+            # (sq < kv_len) still attends to the whole cached prefix.
+            q_off = kv_len - sq
+            q_ids = q_off + iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_ids = ik * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_ids >= k_ids, s, NEG_INF)
+
+        m_prev = m_scr[...]  # (bq, LANES)
+        m_cur = jnp.max(s, axis=1, keepdims=True)  # (bq, 1)
+        m_new = jnp.maximum(m_prev, jnp.broadcast_to(m_cur, m_prev.shape))
+        alpha = jnp.exp(m_prev - m_new)  # (bq, LANES)
+        p = jnp.exp(s - m_new[:, :1])  # (bq, bk)
+
+        l_scr[...] = l_scr[...] * alpha + jnp.broadcast_to(
+            jnp.sum(p, axis=1, keepdims=True), m_prev.shape
+        )
+        m_scr[...] = m_new
+        acc_scr[...] = acc_scr[...] * alpha[:, :1] + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        # Skip KV blocks entirely above the (end-aligned) diagonal.
+        @pl.when(ik * block_k <= (kv_len - sq) + iq * block_q + block_q - 1)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(ik == n_kv - 1)
+    def _():
+        l = l_scr[:, :1]
+        l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows → zero output
+        o_ref[0] = (acc_scr[...] / l).astype(o_ref.dtype)
+        if lse_ref is not None:
+            lse = m_scr[:, 0] + jnp.log(jnp.maximum(l_scr[:, 0], 1e-30))
+            lse_ref[0, 0] = lse.astype(lse_ref.dtype)
+
+
+def flash_attention(
+    q: jax.Array,  # (B, Hq, Sq, D)
+    k: jax.Array,  # (B, Hkv, Sk, D)
+    v: jax.Array,  # (B, Hkv, Sk, D)
+    *,
+    causal: bool = True,
+    scale: float | None = None,
+    block_q: int = 256,
+    block_k: int = 256,
+    return_lse: bool = False,
+):
+    """Flash attention forward. Returns ``o`` (B, Hq, Sq, D), plus the
+    log-sum-exp (B, Hq, Sq) when ``return_lse`` (fp32)."""
+    b, hq, sq, d = q.shape
+    _, hkv, sk, _ = k.shape
+    assert hq % hkv == 0, (hq, hkv)
+    group = hq // hkv
+    scale = scale if scale is not None else d ** -0.5
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    assert sq % block_q == 0 and sk % block_k == 0, (sq, sk, block_q, block_k)
+    n_kv = sk // block_k
+
+    qr = q.reshape(b * hq, sq, d)
+    kr = k.reshape(b * hkv, sk, d)
+    vr = v.reshape(b * hkv, sk, d)
+
+    def kv_index(bh, iq_, ik_):
+        # q head bh = bi*hq + h → kv row bi*hkv + h // group
+        return (bh // hq) * hkv + (bh % hq) // group, ik_, 0
+
+    out_shape = [jax.ShapeDtypeStruct((b * hq, sq, d), q.dtype)]
+    out_specs = [pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0))]
+    if return_lse:
+        out_shape.append(jax.ShapeDtypeStruct((b * hq, 1, sq), jnp.float32))
+        out_specs.append(pl.BlockSpec((1, 1, block_q), lambda bh, iq, ik: (bh, 0, iq)))
+
+    kernel = functools.partial(
+        _flash_kernel,
+        scale=scale,
+        causal=causal,
+        block_q=block_q,
+        block_k=block_k,
+        n_kv=n_kv,
+        kv_len=sk,
+        sq=sq,
+    )
+    if not return_lse:
+        kernel_fn = lambda q_, k_, v_, o_, acc, m, l: kernel(q_, k_, v_, o_, None, acc, m, l)
+    else:
+        kernel_fn = kernel
+
+    res = pl.pallas_call(
+        kernel_fn,
+        grid=(b * hq, sq // block_q, n_kv),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0)),
+            pl.BlockSpec((1, block_k, d), kv_index),
+            pl.BlockSpec((1, block_k, d), kv_index),
+        ],
+        out_specs=out_specs if return_lse else out_specs[0],
+        out_shape=out_shape if return_lse else out_shape[0],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret_mode_default(),
+    )(qr, kr, vr)
+
+    if return_lse:
+        o, lse = res
+        return o.reshape(b, hq, sq, d), lse.reshape(b, hq, sq)
+    return res.reshape(b, hq, sq, d)
+
+
+def attention_reference(q, k, v, *, causal=True, scale=None):
+    """Unfused reference (the torch-eager analog used by reference tests)."""
+    b, hq, sq, d = q.shape
+    _, hkv, sk, _ = k.shape
+    scale = scale if scale is not None else d ** -0.5
+    group = hq // hkv
+    kx = jnp.repeat(k, group, axis=1)
+    vx = jnp.repeat(v, group, axis=1)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32), kx.astype(jnp.float32)) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        s = jnp.where(mask, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, vx.astype(jnp.float32)).astype(q.dtype)

@@ -37,9 +37,12 @@ class GemmConfig:
 
 
 def get_config_space(max_m: int | None = None) -> list[GemmConfig]:
-    """Candidate configs for the autotuner (MXU-aligned tile sizes)."""
+    """Candidate configs for the autotuner (MXU-aligned tile sizes).
+
+    ``max_m`` caps the M-tile at the problem's M (small-M decode regime);
+    the space is never empty — bm=128 survives any cap."""
     space = []
-    for bm in (256, 512, 1024):
+    for bm in (128, 256, 512, 1024):
         for bn in (256, 512, 1024):
             for bk in (512, 1024, 2048):
                 if max_m is not None and bm > max(max_m, 128):
@@ -110,18 +113,6 @@ def gemm(
             transcendentals=0,
         ),
     )(a, b)
-
-
-def swiglu_epilogue(gate_up: jax.Array) -> jax.Array:
-    """SwiGLU on a fused gate|up projection tile: silu(gate) * up.
-
-    The tile's last dim holds [gate, up] halves (reference
-    ``kernels/nvidia/swiglu.py`` computes silu(x[::2]) * x[1::2] over the
-    doubled intermediate dim). Used via ``gemm_swiglu`` below, which keeps the
-    halves in separate N-tiles instead — better for tiling.
-    """
-    gate, up = jnp.split(gate_up, 2, axis=-1)
-    return jax.nn.silu(gate) * up
 
 
 def gemm_swiglu(

@@ -1,0 +1,107 @@
+"""GEMM-AR: fused GEMM + AllReduce for the small-M decode regime.
+
+Reference: ``python/triton_dist/kernels/nvidia/gemm_allreduce.py`` —
+persistent GEMM with per-tile notify + consumer AR kernel (multimem / ring),
+low-latency double-buffer phase contexts (:44-831); headline 1.26-1.44×
+decode-path wins (``e2e_dense.md:34-38``). TPU redesign:
+
+* **rs_ag** — ring reduce-scatter matmul followed by ring all-gather: the
+  bandwidth-optimal composition for larger M.
+* **one_shot** — local partial GEMM, then the one-shot push AR kernel: one
+  hop of latency, the multimem-analog for tiny M (decode).
+* **xla** — ``dot + psum`` baseline.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from triton_dist_tpu.runtime.mesh import DistContext
+from triton_dist_tpu.kernels.allgather import all_gather_shard, AllGatherMethod
+from triton_dist_tpu.kernels.allreduce import all_reduce_shard, AllReduceMethod
+from triton_dist_tpu.kernels.gemm_reduce_scatter import _gemm_rs_xla_ring
+
+
+class GemmARMethod(enum.Enum):
+    AUTO = "auto"
+    RS_AG = "rs_ag"
+    ONE_SHOT = "one_shot"
+    XLA = "xla"
+
+
+@dataclasses.dataclass(frozen=True)
+class GemmARContext:
+    """Reference ``GemmARContext`` / ``LLGemmARContext``
+    (``gemm_allreduce.py:44,:80``)."""
+
+    ctx: DistContext
+    axis: str = "tp"
+    method: GemmARMethod = GemmARMethod.AUTO
+
+
+def create_gemm_ar_context(
+    ctx: DistContext, axis: str = "tp", method: GemmARMethod = GemmARMethod.AUTO
+) -> GemmARContext:
+    return GemmARContext(ctx=ctx, axis=axis, method=method)
+
+
+def gemm_ar_shard(
+    a: jax.Array,  # (m, k_shard)
+    b: jax.Array,  # (k_shard, n)
+    *,
+    axis: str = "tp",
+    mesh_axes=None,
+    method: GemmARMethod = GemmARMethod.AUTO,
+) -> jax.Array:
+    """``all_reduce(A_local @ B_local)`` — every rank gets the full (m, n)
+    product. Usable inside shard_map. Reference host ops
+    ``gemm_ar_op``/``ll_gemm_ar_op`` (``gemm_allreduce.py:660,:722``)."""
+    world = jax.lax.axis_size(axis)
+    m = a.shape[0]
+    if world == 1:
+        return jnp.dot(a, b, preferred_element_type=jnp.float32).astype(a.dtype)
+    if method is GemmARMethod.AUTO:
+        # Ragged or tiny M → one-shot (latency-bound); else rs_ag.
+        method = GemmARMethod.ONE_SHOT if (m % world != 0 or m <= 64) else GemmARMethod.RS_AG
+
+    if method is GemmARMethod.XLA:
+        partial = jnp.dot(a, b, preferred_element_type=jnp.float32)
+        return jax.lax.psum(partial, axis).astype(a.dtype)
+
+    if method is GemmARMethod.ONE_SHOT:
+        partial = jnp.dot(a, b, preferred_element_type=jnp.float32).astype(a.dtype)
+        return all_reduce_shard(
+            partial, axis=axis, mesh_axes=mesh_axes, method=AllReduceMethod.ONE_SHOT
+        )
+
+    scattered = _gemm_rs_xla_ring(a, b, axis=axis)
+    gathered = all_gather_shard(
+        scattered, axis=axis, mesh_axes=mesh_axes, method=AllGatherMethod.RING_1D
+    )
+    return gathered.reshape(m, b.shape[1])
+
+
+def gemm_ar(ar_ctx: GemmARContext, a: jax.Array, b: jax.Array) -> jax.Array:
+    """Standalone host op: A sharded on cols, B sharded on rows; returns the
+    replicated full product."""
+    axis = ar_ctx.axis
+    mesh_axes = ar_ctx.ctx.axis_names
+
+    def fn(a_shard, b_shard):
+        return gemm_ar_shard(
+            a_shard, b_shard, axis=axis, mesh_axes=mesh_axes, method=ar_ctx.method
+        )
+
+    shard_f = jax.shard_map(
+        fn,
+        mesh=ar_ctx.ctx.mesh,
+        in_specs=(P(None, axis), P(axis)),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return jax.jit(shard_f)(a, b)

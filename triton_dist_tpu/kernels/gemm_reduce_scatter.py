@@ -1,0 +1,136 @@
+"""GEMM-RS: GEMM → ReduceScatter with comm/compute overlap.
+
+Reference: ``python/triton_dist/kernels/nvidia/gemm_reduce_scatter.py`` — the
+producer GEMM notifies per-tile scatter signals; an RS consumer on a second
+stream scatters, locally reduces, and ring-reduces across nodes
+(:122,:273,:492-616). TPU redesign:
+
+* **xla_ring** — reduce-scatter matmul: the running partial-sum chunk travels
+  the ring; each of the ``world`` unrolled steps computes one
+  ``(m/world, k_local) @ (k_local, n)`` chunk-GEMM and adds it to the
+  incoming accumulator. XLA overlaps each step's ``ppermute`` with the next
+  chunk-GEMM — compute hides the scatter exactly like the reference's
+  per-tile-signal consumer.
+* **pallas** — pallas GEMM producing the full partial, then the one-sided
+  ring-RS kernel (kernel-granular overlap only; the fused per-tile variant is
+  the planned successor).
+* **xla** — ``dot + psum_scatter`` unoverlapped baseline.
+
+Accumulation is fp32 on-chip; the ring wire carries the output dtype.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from triton_dist_tpu.runtime.mesh import DistContext
+from triton_dist_tpu.kernels.gemm import gemm, GemmConfig
+from triton_dist_tpu.kernels.reduce_scatter import reduce_scatter_shard
+
+
+class GemmRSMethod(enum.Enum):
+    AUTO = "auto"
+    XLA_RING = "xla_ring"
+    PALLAS = "pallas"
+    XLA = "xla"
+
+
+@dataclasses.dataclass(frozen=True)
+class GemmRSContext:
+    """Reference ``create_gemm_rs_context`` (``gemm_reduce_scatter.py:560``)."""
+
+    ctx: DistContext
+    axis: str = "tp"
+    method: GemmRSMethod = GemmRSMethod.AUTO
+    gemm_config: GemmConfig | None = None
+
+
+def create_gemm_rs_context(
+    ctx: DistContext, axis: str = "tp", method: GemmRSMethod = GemmRSMethod.AUTO
+) -> GemmRSContext:
+    return GemmRSContext(ctx=ctx, axis=axis, method=method)
+
+
+def _gemm_rs_xla_ring(a, b, *, axis, accum_dtype=jnp.float32):
+    """Ring reduce-scatter matmul (see module doc). Chunk ``c`` finishes on
+    rank ``c`` after visiting every rank once."""
+    world = jax.lax.axis_size(axis)
+    me = jax.lax.axis_index(axis)
+    m, _ = a.shape
+    assert m % world == 0, (m, world)
+    chunk = m // world
+    perm = [(i, (i + 1) % world) for i in range(world)]
+
+    def chunk_gemm(idx):
+        rows = jax.lax.dynamic_slice(a, (idx * chunk, 0), (chunk, a.shape[1]))
+        return jnp.dot(rows, b, preferred_element_type=accum_dtype)
+
+    first = jnp.mod(me - 1, world)
+    acc = chunk_gemm(first)
+    for s in range(world - 1):  # static unroll
+        acc = jax.lax.ppermute(acc, axis, perm)
+        incoming = jnp.mod(me - s - 2, world)
+        acc = acc + chunk_gemm(incoming)
+    return acc.astype(a.dtype)
+
+
+def gemm_rs_shard(
+    a: jax.Array,  # (m, k_shard) — A column-shard of this rank
+    b: jax.Array,  # (k_shard, n) — B row-shard of this rank
+    *,
+    axis: str = "tp",
+    mesh_axes=None,
+    method: GemmRSMethod = GemmRSMethod.AUTO,
+    gemm_config: GemmConfig | None = None,
+) -> jax.Array:
+    """Compute ``reduce_scatter(A_local @ B_local)`` → this rank's
+    ``(m/world, n)`` row-chunk of the summed product. Usable inside shard_map.
+    Reference host op ``gemm_rs`` (``gemm_reduce_scatter.py:593``)."""
+    world = jax.lax.axis_size(axis)
+    if world == 1:
+        return jnp.dot(a, b, preferred_element_type=jnp.float32).astype(a.dtype)
+    if method is GemmRSMethod.AUTO:
+        method = GemmRSMethod.XLA_RING
+
+    if method is GemmRSMethod.XLA:
+        partial = jnp.dot(a, b, preferred_element_type=jnp.float32)
+        return jax.lax.psum_scatter(
+            partial, axis, scatter_dimension=0, tiled=True
+        ).astype(a.dtype)
+
+    if method is GemmRSMethod.PALLAS:
+        partial = gemm(a, b, config=gemm_config)
+        return reduce_scatter_shard(partial, axis=axis, mesh_axes=mesh_axes)
+
+    return _gemm_rs_xla_ring(a, b, axis=axis)
+
+
+def gemm_rs(rs_ctx: GemmRSContext, a: jax.Array, b: jax.Array) -> jax.Array:
+    """Standalone host op: A sharded on cols, B sharded on rows over ``axis``;
+    returns ``A @ B`` sharded on rows (the TP down-projection shape)."""
+    axis = rs_ctx.axis
+    mesh_axes = rs_ctx.ctx.axis_names
+
+    def fn(a_shard, b_shard):
+        return gemm_rs_shard(
+            a_shard,
+            b_shard,
+            axis=axis,
+            mesh_axes=mesh_axes,
+            method=rs_ctx.method,
+            gemm_config=rs_ctx.gemm_config,
+        )
+
+    shard_f = jax.shard_map(
+        fn,
+        mesh=rs_ctx.ctx.mesh,
+        in_specs=(P(None, axis), P(axis)),
+        out_specs=P(axis),
+        check_vma=False,
+    )
+    return jax.jit(shard_f)(a, b)

@@ -1,0 +1,89 @@
+"""Benchmark entry: prints ONE JSON line {metric, value, unit, vs_baseline}.
+
+Runs on the real TPU chip when available (CPU fallback for smoke). Primary
+metric this round: Pallas tiled-GEMM throughput vs the XLA stock dot on the
+same shape — the "does the custom kernel beat the compiler path" ratio that
+underpins every fused op in the framework (the reference benches its GEMMs
+against cuBLAS the same way, SURVEY §6).
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def _time_chained(step, a, b, iters=128, base=32, reps=3):
+    """Per-iteration device time of ``c = step(a, c)`` chained on device.
+
+    Two gotchas of the tunneled TPU: host dispatch latency is huge, and
+    ``block_until_ready`` does NOT wait for device completion — only a
+    device→host readback does. So: run two fori_loop chains of different
+    lengths in one jit each, force a scalar readback (``float(...)``), and
+    difference the times. ``clip`` keeps the chained values finite."""
+
+    def chain(n):
+        @jax.jit
+        def run(a_, b_):
+            c = jax.lax.fori_loop(
+                0, n, lambda i, c: step(a_, jnp.clip(c, -1, 1)), b_
+            )
+            return c.astype(jnp.float32).sum()
+
+        return run
+
+    short, long_ = chain(base), chain(iters + base)
+    float(short(a, b))  # compile + warm
+    float(long_(a, b))
+    t_s = min(_walltime(lambda: float(short(a, b))) for _ in range(reps))
+    t_l = min(_walltime(lambda: float(long_(a, b))) for _ in range(reps))
+    return max(t_l - t_s, 1e-9) / iters
+
+
+def _walltime(thunk):
+    t0 = time.perf_counter()
+    thunk()
+    return time.perf_counter() - t0
+
+
+def main():
+    on_tpu = jax.devices()[0].platform != "cpu"
+    if on_tpu:
+        m = k = n = 4096
+        dtype = jnp.bfloat16
+    else:  # CPU smoke: tiny
+        m = k = n = 256
+        dtype = jnp.float32
+
+    from triton_dist_tpu.kernels.gemm import gemm, GemmConfig
+
+    key = jax.random.PRNGKey(0)
+    a = jax.random.normal(key, (m, k), jnp.float32).astype(dtype)
+    b = jax.random.normal(key, (k, n), jnp.float32).astype(dtype)
+
+    cfg = GemmConfig(512, 512, 512) if on_tpu else GemmConfig(128, 128, 128)
+    t_pallas = _time_chained(lambda x, c: gemm(x, c, config=cfg), a, b)
+    t_xla = _time_chained(
+        lambda x, c: jnp.dot(x, c, preferred_element_type=jnp.float32).astype(x.dtype),
+        a,
+        b,
+    )
+
+    flops = 2.0 * m * n * k
+    tflops = flops / t_pallas / 1e12
+    print(
+        json.dumps(
+            {
+                "metric": f"pallas_gemm_bf16_{m}_tflops" if on_tpu else f"pallas_gemm_f32_{m}_tflops",
+                "value": round(tflops, 2),
+                "unit": "TFLOP/s",
+                # ratio vs the XLA stock dot on the same shape/chip
+                "vs_baseline": round(t_xla / t_pallas, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,227 @@
+"""Layer tests: TP MLP/Attn/MoE, EP MoE, PP comm — dist modes vs xla reference.
+
+Parity model: reference ``test/nvidia/test_tp_mlp.py``, ``test_tp_attn.py``,
+``test_tp_moe.py``, ``test_pp.py`` — each compares the triton_dist backend
+against the torch/eager path.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from triton_dist_tpu.layers import TP_MLP, TP_Attn, TP_MoE, EP_MoE, PPCommLayer, RMSNorm
+
+WORLD = 4
+
+
+def sm(ctx, fn, in_specs, out_specs):
+    return jax.jit(
+        jax.shard_map(fn, mesh=ctx.mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False)
+    )
+
+
+def test_tp_mlp_modes_agree(ctx4, rng):
+    d, ff, m = 64, 4 * 64, 32
+    x = jnp.asarray(rng.standard_normal((m, d)), jnp.float32) * 0.3
+    wg = jnp.asarray(rng.standard_normal((d, ff)), jnp.float32) * 0.1
+    wu = jnp.asarray(rng.standard_normal((d, ff)), jnp.float32) * 0.1
+    wd = jnp.asarray(rng.standard_normal((ff, d)), jnp.float32) * 0.1
+
+    ref = np.asarray(
+        (jax.nn.silu((x @ wg).astype(jnp.float32)) * (x @ wu).astype(jnp.float32)).astype(
+            jnp.float32
+        )
+        @ wd.astype(jnp.float32)
+    )
+
+    def run(mode, x_spec, out_spec):
+        def fn(x_, wg_, wu_, wd_):
+            mlp = TP_MLP(w_gate=wg_, w_up=wu_, w_down=wd_, axis="tp")
+            return mlp(x_, mode=mode)
+
+        return sm(ctx4, fn, (x_spec, P(None, "tp"), P(None, "tp"), P("tp")), out_spec)
+
+    out_xla = np.asarray(run("xla", P(), P())(x, wg, wu, wd))
+    np.testing.assert_allclose(out_xla, ref, rtol=1e-4, atol=1e-4)
+    out_dist = np.asarray(run("dist", P("tp"), P("tp"))(x, wg, wu, wd))
+    np.testing.assert_allclose(out_dist, ref, rtol=1e-4, atol=1e-4)
+    out_ar = np.asarray(run("dist_ar", P(), P())(x, wg, wu, wd))
+    np.testing.assert_allclose(out_ar, ref, rtol=1e-4, atol=1e-4)
+
+
+def _make_attn_weights(rng, d, hq, hkv, hd):
+    wqkv = np.asarray(rng.standard_normal((d, (hq + 2 * hkv) * hd)), np.float32) * 0.1
+    wo = np.asarray(rng.standard_normal((hq * hd, d)), np.float32) * 0.1
+    return wqkv, wo
+
+
+def _shard_qkv_weights(wqkv, hq, hkv, hd, world):
+    """Reorder the fused QKV columns so a tp column-shard holds its local
+    heads contiguously as [q_local | k_local | v_local]."""
+    d = wqkv.shape[0]
+    q, k, v = np.split(wqkv, [hq * hd, (hq + hkv) * hd], axis=1)
+    qs = q.reshape(d, world, hq // world * hd)
+    ks = k.reshape(d, world, hkv // world * hd)
+    vs = v.reshape(d, world, hkv // world * hd)
+    return np.concatenate([qs, ks, vs], axis=2).reshape(d, -1)
+
+
+def test_tp_attn_prefill_dist_vs_xla(ctx4, rng):
+    d, hq, hkv, hd, bsz, seq = 64, 8, 4, 32, 1, 64
+    wqkv, wo = _make_attn_weights(rng, d, hq, hkv, hd)
+    wqkv_sh = jnp.asarray(_shard_qkv_weights(wqkv, hq, hkv, hd, WORLD))
+    wo_j = jnp.asarray(wo)
+    x = jnp.asarray(rng.standard_normal((bsz * seq, d)), jnp.float32) * 0.3
+    pos = jnp.arange(seq, dtype=jnp.int32)[None]
+
+    def fn(x_, wqkv_, wo_, mode):
+        attn = TP_Attn(
+            wqkv=wqkv_, wo=wo_, q_norm=None, k_norm=None,
+            num_q_heads_local=hq // WORLD, num_kv_heads_local=hkv // WORLD,
+            head_dim=hd, axis="tp",
+        )
+        out, _ = attn.prefill(x_, pos, mode=mode, bsz=bsz)
+        return out
+
+    out_xla = np.asarray(
+        sm(ctx4, lambda a, b, c: fn(a, b, c, "xla"), (P(), P(None, "tp"), P("tp")), P())(
+            x, wqkv_sh, wo_j
+        )
+    )
+    out_dist = np.asarray(
+        sm(ctx4, lambda a, b, c: fn(a, b, c, "dist"), (P("tp"), P(None, "tp"), P("tp")), P("tp"))(
+            x, wqkv_sh, wo_j
+        )
+    )
+    np.testing.assert_allclose(out_dist, out_xla, rtol=2e-4, atol=2e-4)
+
+
+def test_tp_attn_decode_updates_cache(ctx4, rng):
+    d, hq, hkv, hd, bsz, cache_len = 64, 8, 4, 32, 2, 64
+    wqkv, wo = _make_attn_weights(rng, d, hq, hkv, hd)
+    wqkv_sh = jnp.asarray(_shard_qkv_weights(wqkv, hq, hkv, hd, WORLD))
+    wo_j = jnp.asarray(wo)
+    x = jnp.asarray(rng.standard_normal((bsz, d)), jnp.float32) * 0.3
+    kc = jnp.asarray(rng.standard_normal((bsz, hkv, cache_len, hd)), jnp.float32) * 0.3
+    vc = jnp.asarray(rng.standard_normal((bsz, hkv, cache_len, hd)), jnp.float32) * 0.3
+    lengths = jnp.asarray([10, 20], jnp.int32)
+    pos = lengths
+
+    def fn(x_, wqkv_, wo_, kc_, vc_, mode):
+        attn = TP_Attn(
+            wqkv=wqkv_, wo=wo_, q_norm=None, k_norm=None,
+            num_q_heads_local=hq // WORLD, num_kv_heads_local=hkv // WORLD,
+            head_dim=hd, axis="tp",
+        )
+        out, (kc2, vc2) = attn.decode(x_, pos, kc_, vc_, lengths, mode=mode)
+        return out, kc2, vc2
+
+    kv_spec = P(None, "tp")
+    out_ar, kc_ar, _ = sm(
+        ctx4, lambda *a: fn(*a, "dist_ar"), (P(), P(None, "tp"), P("tp"), kv_spec, kv_spec),
+        (P(), kv_spec, kv_spec),
+    )(x, wqkv_sh, wo_j, kc, vc)
+    out_x, kc_x, _ = sm(
+        ctx4, lambda *a: fn(*a, "xla"), (P(), P(None, "tp"), P("tp"), kv_spec, kv_spec),
+        (P(), kv_spec, kv_spec),
+    )(x, wqkv_sh, wo_j, kc, vc)
+    np.testing.assert_allclose(np.asarray(out_ar), np.asarray(out_x), rtol=2e-4, atol=2e-4)
+    # Cache row at `lengths` must have been overwritten identically.
+    np.testing.assert_allclose(np.asarray(kc_ar), np.asarray(kc_x), rtol=1e-5, atol=1e-5)
+    assert not np.allclose(np.asarray(kc_ar)[0, :, 10], np.asarray(kc)[0, :, 10])
+
+
+def test_tp_moe_vs_dense(ctx4, rng):
+    d, ff, e, t, k = 32, 4 * 16, 4, 16, 2
+    x = jnp.asarray(rng.standard_normal((t, d)), jnp.float32) * 0.3
+    wr = jnp.asarray(rng.standard_normal((d, e)), jnp.float32)
+    wg = jnp.asarray(rng.standard_normal((e, d, ff)), jnp.float32) * 0.1
+    wu = jnp.asarray(rng.standard_normal((e, d, ff)), jnp.float32) * 0.1
+    wd = jnp.asarray(rng.standard_normal((e, ff, d)), jnp.float32) * 0.1
+
+    def fn(x_, wr_, wg_, wu_, wd_):
+        moe = TP_MoE(
+            w_router=wr_, w_gate=wg_, w_up=wu_, w_down=wd_,
+            top_k=k, capacity_factor=4.0, axis="tp",
+        )
+        return moe(x_, mode="xla")
+
+    out = np.asarray(
+        sm(
+            ctx4, fn,
+            (P(), P(), P(None, None, "tp"), P(None, None, "tp"), P(None, "tp")),
+            P(),
+        )(x, wr, wg, wu, wd)
+    )
+
+    # Dense reference
+    from triton_dist_tpu.kernels.moe_utils import topk_routing
+
+    idx, w = topk_routing(jnp.dot(x, wr), k)
+    ref = np.zeros((t, d), np.float32)
+    for ti in range(t):
+        for ki in range(k):
+            ei = int(idx[ti, ki])
+            h = np.asarray(x[ti]) @ np.asarray(wg[ei])
+            u = np.asarray(x[ti]) @ np.asarray(wu[ei])
+            act = (h / (1 + np.exp(-h))) * u
+            ref[ti] += float(w[ti, ki]) * (act @ np.asarray(wd[ei]))
+    np.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-3)
+
+
+def test_ep_moe_vs_dense(ctx4, rng):
+    d, ff, e, t, k = 32, 48, 8, 8, 2
+    x = jnp.asarray(rng.standard_normal((WORLD, t, d)), jnp.float32) * 0.3
+    wr = jnp.asarray(rng.standard_normal((d, e)), jnp.float32)
+    wg = jnp.asarray(rng.standard_normal((e, d, ff)), jnp.float32) * 0.1
+    wu = jnp.asarray(rng.standard_normal((e, d, ff)), jnp.float32) * 0.1
+    wd = jnp.asarray(rng.standard_normal((e, ff, d)), jnp.float32) * 0.1
+
+    def fn(x_, wr_, wg_, wu_, wd_):
+        moe = EP_MoE(
+            w_router=wr_, w_gate=wg_, w_up=wu_, w_down=wd_,
+            num_experts=e, top_k=k, capacity_factor=8.0, axis="tp",
+        )
+        return moe(x_[0])[None]
+
+    out = np.asarray(
+        sm(
+            ctx4, fn,
+            (P("tp"), P(), P("tp"), P("tp"), P("tp")),
+            P("tp"),
+        )(x, wr, wg, wu, wd)
+    )
+
+    from triton_dist_tpu.kernels.moe_utils import topk_routing
+
+    for r in range(WORLD):
+        idx, w = topk_routing(jnp.dot(x[r], wr), k)
+        ref = np.zeros((t, d), np.float32)
+        for ti in range(t):
+            for ki in range(k):
+                ei = int(idx[ti, ki])
+                h = np.asarray(x[r, ti]) @ np.asarray(wg[ei])
+                u = np.asarray(x[r, ti]) @ np.asarray(wu[ei])
+                act = (h / (1 + np.exp(-h))) * u
+                ref[ti] += float(w[ti, ki]) * (act @ np.asarray(wd[ei]))
+        np.testing.assert_allclose(out[r], ref, rtol=1e-3, atol=1e-3, err_msg=f"rank {r}")
+
+
+def test_pp_comm_roundtrip(ctx4, rng):
+    x = jnp.asarray(rng.standard_normal((WORLD, 8, 128)), jnp.float32)
+    pp = PPCommLayer(axis="tp", backend="pallas")
+
+    f = sm(ctx4, lambda xs: pp.send_next(xs[0])[None], (P("tp"),), P("tp"))
+    out = np.asarray(f(x))
+    np.testing.assert_array_equal(out, np.roll(np.asarray(x), 1, axis=0))
+
+
+def test_rmsnorm(rng):
+    x = jnp.asarray(rng.standard_normal((4, 64)), jnp.float32)
+    w = jnp.ones((64,), jnp.float32) * 2.0
+    out = RMSNorm(weight=w)(x)
+    xf = np.asarray(x)
+    ref = xf / np.sqrt((xf ** 2).mean(-1, keepdims=True) + 1e-6) * 2.0
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5, atol=1e-5)

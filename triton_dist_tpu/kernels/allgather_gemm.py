@@ -66,13 +66,17 @@ def create_ag_gemm_context(
     return AGGemmContext(ctx=ctx, axis=axis, method=method)
 
 
-def _resolve_method(method: AGGemmMethod, m_shard: int, k: int, dtype) -> AGGemmMethod:
+def _resolve_method(
+    method: AGGemmMethod, m_shard: int, k: int, n: int, world: int, dtype
+) -> AGGemmMethod:
     if method is not AGGemmMethod.AUTO:
         return method
-    # The fused kernel keeps the whole (m, k) A panel + (k, n) B panel in
-    # VMEM; use it in the small-M (decode) regime, XLA ring otherwise.
-    panel_bytes = m_shard * k * jnp.dtype(dtype).itemsize
-    if panel_bytes <= 2 * 1024 * 1024:
+    # The fused kernel pins in VMEM: the (k, n) B panel, the (world·m, n)
+    # output, and the (2, m, k) A staging buffers. Use it only when the whole
+    # working set fits comfortably (small-M decode regime); XLA ring otherwise.
+    itemsize = jnp.dtype(dtype).itemsize
+    vmem_bytes = (k * n + world * m_shard * n + 2 * m_shard * k) * itemsize
+    if vmem_bytes <= 10 * 1024 * 1024:
         return AGGemmMethod.PALLAS_FUSED
     return AGGemmMethod.XLA_RING
 
@@ -235,7 +239,7 @@ def ag_gemm_shard(
     ``ag_gemm`` (``allgather_gemm.py:534``).
     """
     world = jax.lax.axis_size(axis)
-    method = _resolve_method(method, a.shape[0], a.shape[1], a.dtype)
+    method = _resolve_method(method, a.shape[0], a.shape[1], b.shape[1], world, a.dtype)
     if world == 1:
         out = jnp.dot(a, b, preferred_element_type=jnp.float32).astype(a.dtype)
         return (out, a) if return_gathered else out

@@ -25,6 +25,15 @@ LANES = 128
 NEG_INF = -1e30
 
 
+def fit_block(n: int, want: int) -> int:
+    """Largest power-of-two-shrunk block ≤ ``want`` dividing ``n`` (falls back
+    to n itself for awkward lengths) — callers never trip divisibility."""
+    b = min(want, n)
+    while b > 1 and n % b:
+        b //= 2
+    return b if n % b == 0 else n
+
+
 def _flash_kernel(
     q_ref,  # (1, bq, d)
     k_ref,  # (1, bk, d)
@@ -122,9 +131,8 @@ def flash_attention(
     assert hq % hkv == 0, (hq, hkv)
     group = hq // hkv
     scale = scale if scale is not None else d ** -0.5
-    block_q = min(block_q, sq)
-    block_k = min(block_k, sk)
-    assert sq % block_q == 0 and sk % block_k == 0, (sq, sk, block_q, block_k)
+    block_q = fit_block(sq, block_q)
+    block_k = fit_block(sk, block_k)
     n_kv = sk // block_k
 
     qr = q.reshape(b * hq, sq, d)

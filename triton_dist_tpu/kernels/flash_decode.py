@@ -113,8 +113,9 @@ def flash_decode(
     assert hq % hkv == 0
     group = hq // hkv
     scale = scale if scale is not None else d ** -0.5
-    block_k = min(block_k, s)
-    assert s % block_k == 0
+    from triton_dist_tpu.kernels.flash_attn import fit_block
+
+    block_k = fit_block(s, block_k)
     n_kv = s // block_k
 
     qr = q.reshape(b, hkv, group, d).reshape(b * hkv, group, d)

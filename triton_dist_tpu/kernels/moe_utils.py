@@ -1,0 +1,108 @@
+"""MoE token routing: static-capacity sort-based dispatch metadata.
+
+Reference: ``csrc/lib/moe_utils.cu`` (``moe_ag_scatter_align_block_size``,
+:61-314) builds a histogram/sort of expert indices into a block-aligned
+schedule for grouped GEMM; ``kernels/nvidia/moe_utils.py`` hosts the python
+twins. TPU redesign: **static shapes everywhere** (SURVEY §7 hard-part (b)) —
+top-k routing becomes an argsort over expert ids plus per-expert positions,
+with a fixed per-expert capacity; overflow tokens are dropped (their combine
+weight is zeroed), the standard capacity-factor MoE contract on TPU.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class RoutingPlan:
+    """Static-shape routing of T tokens × K experts into (E, C) slots.
+
+    ``slot[t,k]``: flat slot index ``e*C + pos`` for assignment (t,k);
+    ``keep[t,k]``: False for capacity-overflow assignments;
+    ``token_of_slot[E*C]``: inverse map (token index feeding each slot, or
+    T for empty slots — callers pad token arrays with one zero row)."""
+
+    slot: jax.Array  # (T, K) int32
+    keep: jax.Array  # (T, K) bool
+    token_of_slot: jax.Array  # (E*C,) int32 in [0, T]
+    num_experts: int
+    capacity: int
+
+
+def capacity_for(tokens: int, topk: int, num_experts: int, factor: float = 1.25, align: int = 8) -> int:
+    """Per-expert slot count: ceil(T*K/E * factor), aligned up (MXU tiles)."""
+    c = int(tokens * topk / num_experts * factor) + 1
+    return max(align, (c + align - 1) // align * align)
+
+
+def make_routing_plan(
+    expert_idx: jax.Array,  # (T, K) int32 — chosen expert per assignment
+    num_experts: int,
+    capacity: int,
+) -> RoutingPlan:
+    """Build the sort-based routing plan (all static shapes, jit-safe)."""
+    t, k = expert_idx.shape
+    flat_e = expert_idx.reshape(-1)  # (T*K,)
+    # Stable sort by expert: positions within each expert run are FIFO in
+    # token order (the reference's aligned scatter is also stable, moe_utils.cu).
+    order = jnp.argsort(flat_e, stable=True)
+    sorted_e = flat_e[order]
+    # Position of each sorted element within its expert run.
+    run_start = jnp.searchsorted(sorted_e, sorted_e, side="left")
+    pos_sorted = jnp.arange(t * k, dtype=jnp.int32) - run_start.astype(jnp.int32)
+    # Scatter positions back to assignment order.
+    pos = jnp.zeros((t * k,), jnp.int32).at[order].set(pos_sorted)
+    keep = pos < capacity
+    slot = jnp.where(keep, flat_e * capacity + pos, 0)
+
+    # Inverse map: token feeding each slot (T for empty slots).
+    token_ids = jnp.arange(t * k, dtype=jnp.int32) // k
+    token_of_slot = jnp.full((num_experts * capacity,), t, jnp.int32)
+    token_of_slot = token_of_slot.at[jnp.where(keep, slot, num_experts * capacity)].set(
+        token_ids, mode="drop"
+    )
+    return RoutingPlan(
+        slot=slot.reshape(t, k),
+        keep=keep.reshape(t, k),
+        token_of_slot=token_of_slot,
+        num_experts=num_experts,
+        capacity=capacity,
+    )
+
+
+def dispatch(x: jax.Array, plan: RoutingPlan) -> jax.Array:
+    """Gather tokens into (E, C, d) expert buffers (zero rows for empties)."""
+    t, d = x.shape
+    x_pad = jnp.concatenate([x, jnp.zeros((1, d), x.dtype)], axis=0)
+    buf = x_pad[plan.token_of_slot]  # (E*C, d)
+    return buf.reshape(plan.num_experts, plan.capacity, d)
+
+
+def combine(
+    y: jax.Array,  # (E, C, d) expert outputs
+    plan: RoutingPlan,
+    weights: jax.Array,  # (T, K) combine weights (gating probs)
+    num_tokens: int,
+) -> jax.Array:
+    """Weighted gather back to token order: out[t] = Σ_k w[t,k]·y[slot[t,k]]
+    (dropped assignments contribute zero)."""
+    flat = y.reshape(-1, y.shape[-1])  # (E*C, d)
+    gathered = flat[plan.slot.reshape(-1)]  # (T*K, d)
+    w = (weights * plan.keep).reshape(-1, 1).astype(jnp.float32)
+    out = (gathered.astype(jnp.float32) * w).reshape(num_tokens, -1, y.shape[-1]).sum(axis=1)
+    return out.astype(y.dtype)
+
+
+def topk_routing(logits: jax.Array, k: int, *, renormalize: bool = True):
+    """Top-k gating: returns (expert_idx (T,K), weights (T,K)).
+
+    Reference router behavior (``models/qwen_moe.py`` softmax-topk)."""
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    w, idx = jax.lax.top_k(probs, k)
+    if renormalize:
+        w = w / jnp.maximum(w.sum(-1, keepdims=True), 1e-20)
+    return idx.astype(jnp.int32), w.astype(logits.dtype)

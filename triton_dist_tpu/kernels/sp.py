@@ -1,0 +1,207 @@
+"""Sequence parallelism: ring (AG-SP) attention + Ulysses head-scatter a2a.
+
+Reference long-context mechanisms (SURVEY §5):
+(a) AG-SP "ring" attention — KV all-gathered shard-by-shard into flash-attn
+    consumers (``sp_ag_attention_intra_node.py:106-433``, inter-node :595);
+(b) Ulysses — all2all re-shard seq↔heads fused around QKV/O GEMMs
+    (``ulysses_sp_dispatch.py:39-606``, ``sp_ulysess_qkv_gemm_all2all.py``);
+(c) distributed flash-decode (in ``flash_decode.py``).
+
+TPU redesign:
+
+* **ring attention** — blockwise-causal ring: Q stays put, the KV shard
+  rotates ``world`` times over the ICI ring (``ppermute``); each step runs the
+  Pallas flash kernel on (Q_local, KV_visiting) with the right mask (full for
+  earlier shards, causal for the diagonal, skipped above it) and partials
+  merge by log-sum-exp — numerically identical to one global softmax. XLA
+  overlaps the ppermute with the flash kernel of the step in flight.
+* **Ulysses** — one all_to_all flips (seq-sharded, all heads) ↔ (head-sharded,
+  full seq); attention then runs *unsharded over sequence* per head group.
+  The a2a rides ``all_to_all_single_shard`` (pallas one-shot) or XLA.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from triton_dist_tpu.kernels.flash_attn import flash_attention
+from triton_dist_tpu.kernels.ep_a2a import all_to_all_single_shard
+
+
+def _merge_partials(o1, lse1, o2, lse2):
+    """Merge two normalised attention partials by their LSEs (fp32)."""
+    m = jnp.maximum(lse1, lse2)
+    w1 = jnp.exp(lse1 - m)
+    w2 = jnp.exp(lse2 - m)
+    denom = w1 + w2
+    o = (
+        o1.astype(jnp.float32) * (w1 / denom)[..., None]
+        + o2.astype(jnp.float32) * (w2 / denom)[..., None]
+    )
+    return o.astype(o1.dtype), m + jnp.log(denom)
+
+
+def ring_attention_shard(
+    q: jax.Array,  # (B, Hq, S_local, D) — this rank's query shard
+    k: jax.Array,  # (B, Hkv, S_local, D) — this rank's KV shard
+    v: jax.Array,
+    *,
+    axis: str = "sp",
+    causal: bool = True,
+    scale: float | None = None,
+    block_q: int = 256,
+    block_k: int = 256,
+) -> jax.Array:
+    """Exact attention over the full (world·S_local) sequence with Q/K/V
+    sequence-sharded. Usable inside shard_map.
+
+    Blockwise-causal schedule: KV shard j (global position block j) vs my Q
+    shard ``me``: j < me → unmasked, j == me → causal, j > me → skipped
+    (weight exp(-inf) via the LSE merge). Equivalent to the reference's
+    AG-SP attention where flash consumes shards as they arrive.
+    """
+    world = jax.lax.axis_size(axis)
+    me = jax.lax.axis_index(axis)
+    if world == 1:
+        return flash_attention(q, k, v, causal=causal, scale=scale, block_q=block_q, block_k=block_k)
+
+    perm = [(i, (i + 1) % world) for i in range(world)]
+    b, hq, s_loc, d = q.shape
+
+    o = None
+    lse = None
+    k_cur, v_cur = k, v
+    for step in range(world):  # static unroll; ppermute overlaps flash compute
+        j = jnp.mod(me - step, world)  # owner of the visiting KV shard
+        if causal:
+            # One branch executes per step (lax.cond on the traced shard
+            # owner): diagonal → causal flash, past → full flash, future →
+            # no compute at all (zero weight via -inf LSE).
+            def diag_fn(kc, vc):
+                return flash_attention(
+                    q, kc, vc, causal=True, scale=scale,
+                    block_q=block_q, block_k=block_k, return_lse=True,
+                )
+
+            def past_fn(kc, vc):
+                return flash_attention(
+                    q, kc, vc, causal=False, scale=scale,
+                    block_q=block_q, block_k=block_k, return_lse=True,
+                )
+
+            def future_fn(kc, vc):
+                zero_o = jnp.zeros((b, hq, q.shape[2], d), q.dtype)
+                neg_lse = jnp.full((b, hq, q.shape[2]), -jnp.inf, jnp.float32)
+                return zero_o, neg_lse
+
+            o_step, lse_step = jax.lax.cond(
+                j == me,
+                diag_fn,
+                lambda kc, vc: jax.lax.cond(j < me, past_fn, future_fn, kc, vc),
+                k_cur,
+                v_cur,
+            )
+        else:
+            o_step, lse_step = flash_attention(
+                q, k_cur, v_cur, causal=False, scale=scale,
+                block_q=block_q, block_k=block_k, return_lse=True,
+            )
+
+        if o is None:
+            o, lse = o_step, lse_step
+        else:
+            o, lse = _merge_partials(o, lse, o_step, lse_step)
+
+        if step + 1 < world:
+            k_cur = jax.lax.ppermute(k_cur, axis, perm)
+            v_cur = jax.lax.ppermute(v_cur, axis, perm)
+
+    return o
+
+
+def ulysses_a2a_qkv(
+    x: jax.Array,  # (B, S_local, H, D) — seq-sharded, all heads
+    *,
+    axis: str = "sp",
+    mesh_axes=None,
+    use_pallas: bool = False,
+) -> jax.Array:
+    """Seq→head re-shard: returns (B, S_full, H_local, D).
+
+    Reference ``ulysses_sp_dispatch.py:39-269`` (fused QKV pack + a2a)."""
+    world = jax.lax.axis_size(axis)
+    b, s_loc, h, d = x.shape
+    assert h % world == 0, (h, world)
+    h_loc = h // world
+    # (world, B·S_local·H_local·D) chunks: chunk p = heads of group p.
+    send = (
+        x.reshape(b, s_loc, world, h_loc, d)
+        .transpose(2, 0, 1, 3, 4)
+        .reshape(world, b * s_loc * h_loc * d)
+    )
+    recv = all_to_all_single_shard(
+        send[..., None], axis=axis, mesh_axes=mesh_axes, use_pallas=use_pallas
+    )[..., 0]
+    # recv[p] = rank p's sequence block of my head group.
+    return (
+        recv.reshape(world, b, s_loc, h_loc, d)
+        .transpose(1, 0, 2, 3, 4)
+        .reshape(b, world * s_loc, h_loc, d)
+    )
+
+
+def ulysses_a2a_out(
+    x: jax.Array,  # (B, S_full, H_local, D) — head-sharded attention output
+    *,
+    axis: str = "sp",
+    mesh_axes=None,
+    use_pallas: bool = False,
+) -> jax.Array:
+    """Head→seq re-shard back: returns (B, S_local, H, D)
+    (reference ``sp_ulysess_o_all2all_gemm.py``)."""
+    world = jax.lax.axis_size(axis)
+    b, s_full, h_loc, d = x.shape
+    assert s_full % world == 0
+    s_loc = s_full // world
+    send = (
+        x.reshape(b, world, s_loc, h_loc, d)
+        .transpose(1, 0, 2, 3, 4)
+        .reshape(world, b * s_loc * h_loc * d)
+    )
+    recv = all_to_all_single_shard(
+        send[..., None], axis=axis, mesh_axes=mesh_axes, use_pallas=use_pallas
+    )[..., 0]
+    # recv[p] = head group p of my sequence block.
+    return (
+        recv.reshape(world, b, s_loc, h_loc, d)
+        .transpose(1, 2, 0, 3, 4)
+        .reshape(b, s_loc, world * h_loc, d)
+    )
+
+
+def ulysses_attention_shard(
+    q: jax.Array,  # (B, S_local, Hq, D)
+    k: jax.Array,  # (B, S_local, Hkv, D)
+    v: jax.Array,
+    *,
+    axis: str = "sp",
+    mesh_axes=None,
+    causal: bool = True,
+    scale: float | None = None,
+    use_pallas_a2a: bool = False,
+) -> jax.Array:
+    """Ulysses SP attention: a2a to head-sharding, full-sequence flash,
+    a2a back to sequence-sharding. Requires Hq and Hkv divisible by world
+    (reference ``UlyssesSP`` layer constraint)."""
+    qh = ulysses_a2a_qkv(q, axis=axis, mesh_axes=mesh_axes, use_pallas=use_pallas_a2a)
+    kh = ulysses_a2a_qkv(k, axis=axis, mesh_axes=mesh_axes, use_pallas=use_pallas_a2a)
+    vh = ulysses_a2a_qkv(v, axis=axis, mesh_axes=mesh_axes, use_pallas=use_pallas_a2a)
+    o = flash_attention(
+        qh.transpose(0, 2, 1, 3),
+        kh.transpose(0, 2, 1, 3),
+        vh.transpose(0, 2, 1, 3),
+        causal=causal,
+        scale=scale,
+    ).transpose(0, 2, 1, 3)
+    return ulysses_a2a_out(o, axis=axis, mesh_axes=mesh_axes, use_pallas=use_pallas_a2a)

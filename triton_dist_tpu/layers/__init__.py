@@ -1,0 +1,25 @@
+"""Parallelism-strategy model layers (reference ``python/triton_dist/layers/nvidia``).
+
+Layers are pytree dataclasses holding *local shards* of their weights and are
+applied **inside** ``jax.shard_map`` over the context mesh — the SPMD analog
+of the reference's per-rank ``nn.Module``s. Forward mode selection mirrors
+``set_fwd`` (``models/dense.py:84``): ``"xla"`` (compiler collectives, the
+torch-eager analog), ``"dist"`` (overlapped custom kernels), ``"dist_ar"``
+(allreduce-based replicated path for small batch).
+"""
+
+from triton_dist_tpu.layers.tp import TP_MLP, TP_Attn, TP_MoE, RMSNorm
+from triton_dist_tpu.layers.pp import PPCommLayer
+from triton_dist_tpu.layers.ep import EP_MoE
+from triton_dist_tpu.layers.sp import UlyssesSPAttn, RingSPAttn
+
+__all__ = [
+    "TP_MLP",
+    "TP_Attn",
+    "TP_MoE",
+    "RMSNorm",
+    "PPCommLayer",
+    "EP_MoE",
+    "UlyssesSPAttn",
+    "RingSPAttn",
+]

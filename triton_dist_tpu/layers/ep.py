@@ -1,0 +1,64 @@
+"""Expert-parallel MoE layer (dispatch → local experts → combine).
+
+Reference: ``layers/nvidia/ep_a2a_layer.py`` (592), ``ep_a2a_fused_layer.py``
+(1091), ``ep_ll_a2a_layer.py`` (251). TPU: experts sharded over the ``ep``
+axis; the a2a dispatch/combine rides ``kernels.ep_a2a`` (pallas one-sided or
+XLA transport). The fused dispatch+groupGEMM+combine megakernel
+(``ep_all2all_fused.py``) maps to the same composition under one jit scope —
+XLA fuses what profits.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from triton_dist_tpu.layers.tp import _pytree_dataclass, static_field
+from triton_dist_tpu.kernels.moe_utils import capacity_for, topk_routing
+from triton_dist_tpu.kernels.ep_a2a import ep_dispatch_shard, ep_combine_shard
+from triton_dist_tpu.kernels.group_gemm import group_gemm, group_gemm_swiglu
+
+
+@_pytree_dataclass
+class EP_MoE:
+    """MoE with experts sharded over ``ep``: rank r owns experts
+    [r·E_local, (r+1)·E_local). Weights are the local expert slabs."""
+
+    w_router: jax.Array  # (d, E) replicated
+    w_gate: jax.Array  # (E_local, d, ff)
+    w_up: jax.Array  # (E_local, d, ff)
+    w_down: jax.Array  # (E_local, ff, d)
+    num_experts: int = static_field(default=8)
+    top_k: int = static_field(default=2)
+    capacity_factor: float = static_field(default=2.0)
+    axis: str = static_field(default="ep")
+    mesh_axes: tuple | None = static_field(default=None)
+    use_pallas_a2a: bool = static_field(default=False)
+
+    def __call__(self, x: jax.Array) -> jax.Array:
+        """x: (T, d) this rank's tokens → (T, d). Inside shard_map."""
+        t, d = x.shape
+        logits = jnp.dot(x, self.w_router, preferred_element_type=jnp.float32)
+        idx, w = topk_routing(logits, self.top_k)
+        cap = capacity_for(t, self.top_k, self.num_experts, self.capacity_factor)
+        disp = ep_dispatch_shard(
+            x,
+            idx,
+            num_experts=self.num_experts,
+            capacity=cap,
+            axis=self.axis,
+            mesh_axes=self.mesh_axes,
+            use_pallas=self.use_pallas_a2a,
+        )
+        xe = disp.expert_inputs  # (E_local, world*C, d)
+        h = (
+            jax.nn.silu(group_gemm(xe, self.w_gate).astype(jnp.float32))
+            * group_gemm(xe, self.w_up).astype(jnp.float32)
+        ).astype(x.dtype)
+        y = group_gemm(h, self.w_down)
+        return ep_combine_shard(
+            y, disp, w, axis=self.axis, mesh_axes=self.mesh_axes,
+            use_pallas=self.use_pallas_a2a,
+        )

@@ -1,0 +1,50 @@
+"""Pipeline-parallel communication layer.
+
+Reference: ``layers/nvidia/pp_block.py:36-245`` — ``PyTorchP2P`` buffered
+send/recv and ``PPCommLayer`` with triton p2p put/get or torch backends.
+TPU: stage handoff is a ring shift over the ``pp`` mesh axis — the one-sided
+``p2p_put_shard`` kernel or ``jax.lax.ppermute``. GPipe-style microbatch
+scheduling lives in the model runner; this layer is only the transport,
+exactly like the reference's split.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+
+from triton_dist_tpu.kernels.p2p import p2p_put_shard
+
+
+@dataclasses.dataclass(frozen=True)
+class PPCommLayer:
+    """Transport between adjacent pipeline stages (reference ``PPCommLayer``,
+    ``pp_block.py:102``). ``backend``: "pallas" (one-sided DMA kernel) or
+    "xla" (collective-permute)."""
+
+    axis: str = "pp"
+    backend: str = "pallas"
+    mesh_axes: tuple | None = None
+
+    def send_next(self, x: jax.Array) -> jax.Array:
+        """Push activations to stage+1; returns what stage-1 pushed to us
+        (ring semantics — stage 0 receives stage N-1's output, which PP
+        schedules ignore). Usable inside shard_map."""
+        return p2p_put_shard(
+            x,
+            axis=self.axis,
+            offset=1,
+            mesh_axes=self.mesh_axes,
+            use_xla=self.backend == "xla",
+        )
+
+    def send_prev(self, x: jax.Array) -> jax.Array:
+        """Backward-pass direction (grads to stage-1)."""
+        return p2p_put_shard(
+            x,
+            axis=self.axis,
+            offset=-1,
+            mesh_axes=self.mesh_axes,
+            use_xla=self.backend == "xla",
+        )

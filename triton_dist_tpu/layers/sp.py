@@ -1,0 +1,48 @@
+"""Sequence-parallel attention layers (ring / Ulysses wrappers).
+
+Reference: ``layers/nvidia`` Ulysses layer (``ulysses_sp_a2a_layer.py``) and
+the fused SP-AG attention layers (``sp_ag_attention_*``); flash-decode SP
+layer (``sp_flash_decode_layer.py:185``) maps to
+``kernels.flash_decode.dist_flash_decode_shard``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+
+from triton_dist_tpu.kernels.sp import ring_attention_shard, ulysses_attention_shard
+
+
+@dataclasses.dataclass(frozen=True)
+class RingSPAttn:
+    """AG/ring sequence-parallel attention: Q/K/V sequence-sharded over
+    ``axis``; exact global attention via rotating KV."""
+
+    axis: str = "sp"
+    causal: bool = True
+    block_q: int = 256
+    block_k: int = 256
+
+    def __call__(self, q, k, v):
+        return ring_attention_shard(
+            q, k, v, axis=self.axis, causal=self.causal,
+            block_q=self.block_q, block_k=self.block_k,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class UlyssesSPAttn:
+    """Ulysses head-scatter attention: a2a seq↔heads around full-sequence
+    flash attention."""
+
+    axis: str = "sp"
+    causal: bool = True
+    use_pallas_a2a: bool = False
+
+    def __call__(self, q, k, v):
+        return ulysses_attention_shard(
+            q, k, v, axis=self.axis, causal=self.causal,
+            use_pallas_a2a=self.use_pallas_a2a,
+        )

@@ -1,0 +1,236 @@
+"""Tensor-parallel layers: attention, MLP, MoE (+ RMSNorm).
+
+Reference: ``layers/nvidia/tp_attn.py:80-321``, ``tp_mlp.py:52-270``,
+``tp_moe.py:48-279``. Weight layout (per rank, inside shard_map):
+
+* ``TP_Attn``: ``wqkv`` (d, (hq+2·hkv)_local·hd) column-shard — heads split
+  over tp; ``wo`` (hq_local·hd, d) row-shard.
+* ``TP_MLP``: ``w_gate``/``w_up`` (d, ff_local) column-shards; ``w_down``
+  (ff_local, d) row-shard.
+
+Forward modes: ``xla`` — plain matmuls + psum/psum_scatter (compiler
+collectives); ``dist`` — AG-GEMM + GEMM-RS overlapped path (x arrives
+sequence-sharded, returns sequence-sharded); ``dist_ar`` — GEMM-AR replicated
+path (x replicated, decode regime). Mode per call, like the reference's
+``set_fwd`` switch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from triton_dist_tpu.kernels.allgather_gemm import ag_gemm_shard, AGGemmMethod
+from triton_dist_tpu.kernels.gemm_reduce_scatter import gemm_rs_shard, GemmRSMethod
+from triton_dist_tpu.kernels.gemm_allreduce import gemm_ar_shard, GemmARMethod
+from triton_dist_tpu.kernels.flash_attn import flash_attention
+from triton_dist_tpu.kernels.flash_decode import flash_decode
+from triton_dist_tpu.kernels.moe_utils import (
+    capacity_for,
+    make_routing_plan,
+    dispatch,
+    combine,
+    topk_routing,
+)
+from triton_dist_tpu.kernels.group_gemm import group_gemm
+
+
+def _pytree_dataclass(cls):
+    cls = dataclasses.dataclass(cls)
+    fields = [f.name for f in dataclasses.fields(cls) if not f.metadata.get("static")]
+    meta = [f.name for f in dataclasses.fields(cls) if f.metadata.get("static")]
+    jax.tree_util.register_dataclass(cls, data_fields=fields, meta_fields=meta)
+    return cls
+
+
+def static_field(**kw):
+    return dataclasses.field(metadata={"static": True}, **kw)
+
+
+@_pytree_dataclass
+class RMSNorm:
+    """RMSNorm (reference models use Qwen3 RMSNorm semantics)."""
+
+    weight: jax.Array  # (d,)
+    eps: float = static_field(default=1e-6)
+
+    def __call__(self, x: jax.Array) -> jax.Array:
+        xf = x.astype(jnp.float32)
+        var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+        return (xf * jax.lax.rsqrt(var + self.eps)).astype(x.dtype) * self.weight
+
+
+def apply_rope(x: jax.Array, pos: jax.Array, theta: float = 1e6) -> jax.Array:
+    """Rotary embedding, interleaved-half convention (reference
+    ``apply_rotary_pos_emb`` ``tp_attn.py:165``; Qwen3 uses rotate-half).
+
+    x: (B, H, S, D); pos: (B, S) absolute positions."""
+    d = x.shape[-1]
+    half = d // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = pos[:, None, :, None].astype(jnp.float32) * freqs  # (B,1,S,half)
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    x1, x2 = x[..., :half], x[..., half:]
+    xr1 = x1.astype(jnp.float32) * cos - x2.astype(jnp.float32) * sin
+    xr2 = x2.astype(jnp.float32) * cos + x1.astype(jnp.float32) * sin
+    return jnp.concatenate([xr1, xr2], axis=-1).astype(x.dtype)
+
+
+@_pytree_dataclass
+class TP_MLP:
+    """Reference ``TP_MLP`` (``tp_mlp.py:52``)."""
+
+    w_gate: jax.Array  # (d, ff_local)
+    w_up: jax.Array  # (d, ff_local)
+    w_down: jax.Array  # (ff_local, d)
+    axis: str = static_field(default="tp")
+    mesh_axes: tuple | None = static_field(default=None)
+
+    def __call__(self, x: jax.Array, mode: str = "dist") -> jax.Array:
+        """x: (m_shard, d) for 'dist' (seq-sharded), (m, d) for
+        'xla'/'dist_ar' (replicated input). Output matches input sharding."""
+        axis = self.axis
+        if mode == "xla":
+            g = jnp.dot(x, self.w_gate, preferred_element_type=jnp.float32)
+            u = jnp.dot(x, self.w_up, preferred_element_type=jnp.float32)
+            h = (jax.nn.silu(g) * u).astype(x.dtype)
+            out = jnp.dot(h, self.w_down, preferred_element_type=jnp.float32)
+            return jax.lax.psum(out, axis).astype(x.dtype)
+        if mode == "dist":
+            # AG-GEMM up/gate (x seq-sharded), swiglu, GEMM-RS down.
+            g, xg = ag_gemm_shard(x, self.w_gate, axis=axis, mesh_axes=self.mesh_axes, return_gathered=True)
+            u = jnp.dot(xg, self.w_up, preferred_element_type=jnp.float32).astype(x.dtype)
+            h = (jax.nn.silu(g.astype(jnp.float32)) * u.astype(jnp.float32)).astype(x.dtype)
+            return gemm_rs_shard(h, self.w_down, axis=axis, mesh_axes=self.mesh_axes)
+        if mode == "dist_ar":
+            g = jnp.dot(x, self.w_gate, preferred_element_type=jnp.float32)
+            u = jnp.dot(x, self.w_up, preferred_element_type=jnp.float32)
+            h = (jax.nn.silu(g) * u).astype(x.dtype)
+            return gemm_ar_shard(h, self.w_down, axis=axis, mesh_axes=self.mesh_axes)
+        raise ValueError(f"unknown mode {mode}")
+
+
+@_pytree_dataclass
+class TP_Attn:
+    """Reference ``TP_Attn`` (``tp_attn.py:80``): QKV proj → RoPE → flash
+    attention / decode → O proj, head-sharded over tp."""
+
+    wqkv: jax.Array  # (d, (hq_l + 2*hkv_l) * hd)
+    wo: jax.Array  # (hq_l * hd, d)
+    q_norm: RMSNorm | None  # per-head-dim q/k norms (Qwen3)
+    k_norm: RMSNorm | None
+    num_q_heads_local: int = static_field(default=0)
+    num_kv_heads_local: int = static_field(default=0)
+    head_dim: int = static_field(default=128)
+    rope_theta: float = static_field(default=1e6)
+    axis: str = static_field(default="tp")
+    mesh_axes: tuple | None = static_field(default=None)
+
+    def _split_qkv(self, qkv: jax.Array, bsz: int, seq: int):
+        hq, hkv, hd = self.num_q_heads_local, self.num_kv_heads_local, self.head_dim
+        qkv = qkv.reshape(bsz, seq, (hq + 2 * hkv), hd)
+        q = qkv[:, :, :hq]
+        k = qkv[:, :, hq : hq + hkv]
+        v = qkv[:, :, hq + hkv :]
+        if self.q_norm is not None:
+            q = self.q_norm(q)
+        if self.k_norm is not None:
+            k = self.k_norm(k)
+        # (B, H, S, D)
+        return q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3)
+
+    def prefill(self, x: jax.Array, pos: jax.Array, mode: str = "dist", bsz: int = 1):
+        """x: (bsz·seq[_shard], d) tokens; pos: (bsz, seq) positions.
+        Returns (out, (k, v)) — out sharded like x, k/v local heads (B,H,S,D).
+        """
+        axis = self.axis
+        seq = pos.shape[1]
+        if mode == "dist":
+            qkv, _ = ag_gemm_shard(x, self.wqkv, axis=axis, mesh_axes=self.mesh_axes, return_gathered=True)
+        elif mode in ("xla", "dist_ar"):
+            qkv = jnp.dot(x, self.wqkv, preferred_element_type=jnp.float32).astype(x.dtype)
+        else:
+            raise ValueError(mode)
+        q, k, v = self._split_qkv(qkv, bsz, seq)
+        q = apply_rope(q, pos, self.rope_theta)
+        k = apply_rope(k, pos, self.rope_theta)
+        o = flash_attention(q, k, v, causal=True)
+        o = o.transpose(0, 2, 1, 3).reshape(bsz * seq, -1)
+        if mode == "dist":
+            out = gemm_rs_shard(o, self.wo, axis=axis, mesh_axes=self.mesh_axes)
+        elif mode == "xla":
+            out = jax.lax.psum(
+                jnp.dot(o, self.wo, preferred_element_type=jnp.float32), axis
+            ).astype(x.dtype)
+        else:
+            out = gemm_ar_shard(o, self.wo, axis=axis, mesh_axes=self.mesh_axes)
+        return out, (k, v)
+
+    def decode(self, x, pos, k_cache, v_cache, lengths, mode: str = "dist_ar"):
+        """One-token decode. x: (bsz, d) replicated; pos: (bsz,) positions;
+        caches (B, Hkv_l, S, D) fixed-size. Writes the new k/v into the cache
+        at ``lengths`` (static shapes — the XLA analog of the reference's
+        CUDA-graph-safe ``KV_Cache.inc_offset``) and returns
+        (out (bsz, d) replicated, (k_cache, v_cache) updated)."""
+        bsz = x.shape[0]
+        qkv = jnp.dot(x, self.wqkv, preferred_element_type=jnp.float32).astype(x.dtype)
+        q, k, v = self._split_qkv(qkv, bsz, 1)
+        q = apply_rope(q, pos[:, None], self.rope_theta)
+        k = apply_rope(k, pos[:, None], self.rope_theta)
+        batch_ids = jnp.arange(bsz)
+        k_cache = k_cache.at[batch_ids, :, lengths].set(k[:, :, 0])
+        v_cache = v_cache.at[batch_ids, :, lengths].set(v[:, :, 0])
+        o = flash_decode(
+            q[:, :, 0], k_cache, v_cache, lengths + 1,
+            block_k=min(256, k_cache.shape[2]),
+        )
+        o = o.reshape(bsz, -1)
+        if mode == "dist_ar":
+            out = gemm_ar_shard(o, self.wo, axis=self.axis, mesh_axes=self.mesh_axes)
+        elif mode == "xla":
+            out = jax.lax.psum(
+                jnp.dot(o, self.wo, preferred_element_type=jnp.float32), self.axis
+            ).astype(x.dtype)
+        else:
+            raise ValueError(f"decode supports xla/dist_ar, got {mode}")
+        return out, (k_cache, v_cache)
+
+
+@_pytree_dataclass
+class TP_MoE:
+    """Tensor-parallel MoE: experts replicated across ranks, the ff dim of
+    every expert column-sharded (reference ``TP_MoE`` ``tp_moe.py:48`` with
+    ag-moe + moe-rs contexts). Routing is computed identically on all ranks;
+    the down-projection partial sums reduce over tp."""
+
+    w_router: jax.Array  # (d, E)
+    w_gate: jax.Array  # (E, d, ff_local)
+    w_up: jax.Array  # (E, d, ff_local)
+    w_down: jax.Array  # (E, ff_local, d)
+    top_k: int = static_field(default=8)
+    capacity_factor: float = static_field(default=1.5)
+    axis: str = static_field(default="tp")
+    mesh_axes: tuple | None = static_field(default=None)
+
+    def __call__(self, x: jax.Array, mode: str = "dist") -> jax.Array:
+        """x: (T, d) replicated tokens → (T, d) replicated output."""
+        t, d = x.shape
+        e = self.w_router.shape[1]
+        logits = jnp.dot(x, self.w_router, preferred_element_type=jnp.float32)
+        idx, w = topk_routing(logits, self.top_k)
+        cap = capacity_for(t, self.top_k, e, self.capacity_factor)
+        plan = make_routing_plan(idx, e, cap)
+        xe = dispatch(x, plan)  # (E, C, d)
+        g = group_gemm(xe, self.w_gate)
+        u = group_gemm(xe, self.w_up)
+        h = (jax.nn.silu(g.astype(jnp.float32)) * u.astype(jnp.float32)).astype(x.dtype)
+        y = group_gemm(h, self.w_down)  # (E, C, d) partial over tp (ff shard)
+        out = combine(y, plan, w, t)
+        if mode == "xla":
+            return jax.lax.psum(out.astype(jnp.float32), self.axis).astype(x.dtype)
+        from triton_dist_tpu.kernels.allreduce import all_reduce_shard, AllReduceMethod
+
+        return all_reduce_shard(out, axis=self.axis, mesh_axes=self.mesh_axes, method=AllReduceMethod.AUTO)

@@ -1,0 +1,24 @@
+"""Models package: Qwen3-class dense + MoE, engine, HF weight loading.
+
+Reference: ``python/triton_dist/models/__init__.py:33-60`` (``AutoLLM``
+loading HF checkpoints into the TP layout).
+"""
+
+from triton_dist_tpu.models.config import ModelConfig, PRESETS
+from triton_dist_tpu.models.kv_cache import KVCache
+from triton_dist_tpu.models.dense import DenseLLM, Qwen3MoE, DenseParams, init_params
+from triton_dist_tpu.models.engine import Engine
+from triton_dist_tpu.models.weights import AutoLLM, load_hf_weights
+
+__all__ = [
+    "ModelConfig",
+    "PRESETS",
+    "KVCache",
+    "DenseLLM",
+    "Qwen3MoE",
+    "DenseParams",
+    "init_params",
+    "Engine",
+    "AutoLLM",
+    "load_hf_weights",
+]

@@ -1,0 +1,261 @@
+"""Qwen3-class dense LLM (and the MoE variant) — SPMD forward over a mesh.
+
+Reference: ``python/triton_dist/models/dense.py:117`` (``DenseLLM``, per-layer
+``set_fwd`` mode switch :84, per-mode ctx init :169-201) and
+``qwen_moe.py:108`` (``Qwen3MoE``). TPU redesign:
+
+* One parameter pytree with **stacked layers** (leading L dim) so the whole
+  depth compiles as one ``lax.scan`` — the XLA analog of the reference's
+  CUDA-graph capture (``engine.py:75``): trace once, replay forever.
+* The forward runs inside a single ``shard_map`` over the tp axis; per-mode
+  behavior matches the reference backends: ``xla`` (= torch eager),
+  ``dist`` (AG-GEMM + GEMM-RS overlapped), ``dist_ar`` (GEMM-AR decode path).
+* KV caches are fixed-shape (L, B, Hkv_local, S_max, D) arrays donated
+  through jit — in-place on TPU.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from triton_dist_tpu.models.config import ModelConfig
+from triton_dist_tpu.layers.tp import TP_Attn, TP_MLP, TP_MoE, RMSNorm, _pytree_dataclass, static_field
+from triton_dist_tpu.runtime.mesh import DistContext
+
+
+@_pytree_dataclass
+class DenseParams:
+    """Stacked-layer parameter pytree (arrays are global, mesh-sharded)."""
+
+    embed: jax.Array  # (V, d) replicated
+    ln1: jax.Array  # (L, d)
+    wqkv: jax.Array  # (L, d, (hq_l+2hkv_l)*hd · world) — col-sharded on tp
+    wo: jax.Array  # (L, hq·hd, d) — row-sharded on tp
+    q_norm: jax.Array  # (L, hd) (Qwen3 per-head RMS) or ones
+    k_norm: jax.Array  # (L, hd)
+    ln2: jax.Array  # (L, d)
+    mlp_gate: jax.Array  # dense: (L, d, ff) col-sharded | moe: (L, E, d, ff_e)
+    mlp_up: jax.Array
+    mlp_down: jax.Array  # dense: (L, ff, d) row-sharded | moe: (L, E, ff_e, d)
+    router: jax.Array | None  # moe only: (L, d, E)
+    final_norm: jax.Array  # (d,)
+    lm_head: jax.Array  # (d, V) col-sharded
+
+
+def _specs(config: ModelConfig) -> DenseParams:
+    """PartitionSpec pytree matching DenseParams over a ("tp",) mesh."""
+    moe = config.is_moe
+    return DenseParams(
+        embed=P(),
+        ln1=P(),
+        wqkv=P(None, None, "tp"),
+        wo=P(None, "tp", None),
+        q_norm=P(),
+        k_norm=P(),
+        ln2=P(),
+        mlp_gate=P(None, None, None, "tp") if moe else P(None, None, "tp"),
+        mlp_up=P(None, None, None, "tp") if moe else P(None, None, "tp"),
+        mlp_down=P(None, None, "tp", None) if moe else P(None, "tp", None),
+        router=P() if moe else None,
+        final_norm=P(),
+        lm_head=P(None, "tp"),
+    )
+
+
+def init_params(config: ModelConfig, key: jax.Array, ctx: DistContext) -> DenseParams:
+    """Random init with mesh shardings applied (test/bench weights; real
+    weights come from ``AutoLLM``/HF loading, ``models/__init__.py``)."""
+    c = config
+    dt = jnp.dtype(c.dtype)
+    L, d, hd = c.num_layers, c.hidden_size, c.head_dim
+    qkv_cols = (c.num_q_heads + 2 * c.num_kv_heads) * hd
+    keys = jax.random.split(key, 8)
+
+    def mk(k, shape, scale=None):
+        scale = scale if scale is not None else (1.0 / math.sqrt(shape[-2] if len(shape) > 1 else shape[-1]))
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dt)
+
+    if c.is_moe:
+        e, ffe = c.num_experts, c.moe_intermediate_size
+        mlp_gate = mk(keys[3], (L, e, d, ffe))
+        mlp_up = mk(keys[4], (L, e, d, ffe))
+        mlp_down = mk(keys[5], (L, e, ffe, d))
+        router = mk(keys[6], (L, d, e), scale=0.02)
+    else:
+        ff = c.intermediate_size
+        mlp_gate = mk(keys[3], (L, d, ff))
+        mlp_up = mk(keys[4], (L, d, ff))
+        mlp_down = mk(keys[5], (L, ff, d))
+        router = None
+
+    params = DenseParams(
+        embed=mk(keys[0], (c.vocab_size, d), scale=0.02),
+        ln1=jnp.ones((L, d), dt),
+        wqkv=mk(keys[1], (L, d, qkv_cols)),
+        wo=mk(keys[2], (L, c.num_q_heads * hd, d)),
+        q_norm=jnp.ones((L, hd), dt),
+        k_norm=jnp.ones((L, hd), dt),
+        ln2=jnp.ones((L, d), dt),
+        mlp_gate=mlp_gate,
+        mlp_up=mlp_up,
+        mlp_down=mlp_down,
+        router=router,
+        final_norm=jnp.ones((d,), dt),
+        lm_head=mk(keys[7], (d, c.vocab_size)),
+    )
+    specs = _specs(c)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, ctx.sharding(*s)) if x is not None else None,
+        params,
+        specs,
+        is_leaf=lambda x: x is None,
+    )
+
+
+class DenseLLM:
+    """Qwen3-dense-style model. ``Qwen3MoE`` below shares the machinery with
+    MoE MLP blocks (reference keeps two classes; the forward here switches on
+    ``config.is_moe``)."""
+
+    def __init__(self, config: ModelConfig, ctx: DistContext, params: DenseParams | None = None, key=None):
+        self.config = config
+        self.ctx = ctx
+        self.axis = "tp"
+        self.world = ctx.num_ranks(self.axis)
+        assert config.num_q_heads % self.world == 0
+        assert config.num_kv_heads % self.world == 0
+        if params is None:
+            params = init_params(config, key if key is not None else jax.random.PRNGKey(0), ctx)
+        self.params = params
+
+    # ------------------------------------------------------------ shard-local
+    def _attn(self, lp, mode_decode=False) -> TP_Attn:
+        c = self.config
+        return TP_Attn(
+            wqkv=lp["wqkv"],
+            wo=lp["wo"],
+            q_norm=RMSNorm(weight=lp["q_norm"], eps=c.rms_eps),
+            k_norm=RMSNorm(weight=lp["k_norm"], eps=c.rms_eps),
+            num_q_heads_local=c.num_q_heads // self.world,
+            num_kv_heads_local=c.num_kv_heads // self.world,
+            head_dim=c.head_dim,
+            rope_theta=c.rope_theta,
+            axis=self.axis,
+            mesh_axes=self.ctx.axis_names,
+        )
+
+    def _mlp(self, lp):
+        c = self.config
+        if c.is_moe:
+            return TP_MoE(
+                w_router=lp["router"], w_gate=lp["mlp_gate"], w_up=lp["mlp_up"],
+                w_down=lp["mlp_down"], top_k=c.top_k, capacity_factor=2.0, axis=self.axis,
+                mesh_axes=self.ctx.axis_names,
+            )
+        return TP_MLP(
+            w_gate=lp["mlp_gate"], w_up=lp["mlp_up"], w_down=lp["mlp_down"],
+            axis=self.axis, mesh_axes=self.ctx.axis_names,
+        )
+
+    def _layer_stack(self, p: DenseParams):
+        lp = {
+            "ln1": p.ln1, "wqkv": p.wqkv, "wo": p.wo, "q_norm": p.q_norm,
+            "k_norm": p.k_norm, "ln2": p.ln2, "mlp_gate": p.mlp_gate,
+            "mlp_up": p.mlp_up, "mlp_down": p.mlp_down,
+        }
+        if self.config.is_moe:
+            lp["router"] = p.router
+        return lp
+
+    def prefill_shard(self, p: DenseParams, tokens: jax.Array, mode: str):
+        """Inside shard_map. tokens (B, S) replicated → (last-token logits
+        (B, V_local), stacked caches (L, B, Hkv_l, S, D))."""
+        c = self.config
+        bsz, seq = tokens.shape
+        me = jax.lax.axis_index(self.axis)
+        x = p.embed[tokens].reshape(bsz * seq, c.hidden_size)
+        pos = jnp.broadcast_to(jnp.arange(seq, dtype=jnp.int32)[None], (bsz, seq))
+        if mode == "dist":
+            chunk = (bsz * seq) // self.world
+            x = jax.lax.dynamic_slice(x, (me * chunk, 0), (chunk, x.shape[1]))
+
+        eps = c.rms_eps
+
+        def layer_fn(x, lp):
+            attn = self._attn(lp)
+            h = RMSNorm(weight=lp["ln1"], eps=eps)(x)
+            a, (k, v) = attn.prefill(h, pos, mode=mode, bsz=bsz)
+            x = x + a
+            h = RMSNorm(weight=lp["ln2"], eps=eps)(x)
+            if c.is_moe:
+                # TP-MoE shards the expert ff dim: every rank must see the
+                # same tokens. Under seq-sharded "dist" flow, gather → MoE →
+                # take my chunk back (reference runs MoE on the gathered
+                # activations too, tp_moe.py ag_moe path).
+                if mode == "dist":
+                    h_full = jax.lax.all_gather(h, self.axis, tiled=True)
+                    m_full = self._mlp(lp)(h_full, mode="dist_ar")
+                    chunk = h.shape[0]
+                    m = jax.lax.dynamic_slice(
+                        m_full, (me * chunk, 0), (chunk, m_full.shape[1])
+                    )
+                else:
+                    m = self._mlp(lp)(h, mode="xla" if mode == "xla" else "dist_ar")
+            else:
+                m = self._mlp(lp)(h, mode=mode)
+            return x + m, (k, v)
+
+        x, (ks, vs) = jax.lax.scan(
+            lambda carry, lp: layer_fn(carry, lp), x, self._layer_stack(p)
+        )
+        x = RMSNorm(weight=p.final_norm, eps=eps)(x)
+        if mode == "dist":
+            # Gather the sequence back; last token logits only.
+            x = jax.lax.all_gather(x, self.axis, tiled=True)
+        x = x.reshape(bsz, seq, -1)[:, -1]
+        logits = jnp.dot(x, p.lm_head, preferred_element_type=jnp.float32)
+        return logits, (ks, vs)
+
+    def decode_shard(self, p: DenseParams, token: jax.Array, ks, vs, lengths, mode: str):
+        """Inside shard_map. token (B,) → (logits (B, V_local), updated caches).
+        mode: "xla" | "dist_ar"."""
+        c = self.config
+        bsz = token.shape[0]
+        x = p.embed[token]
+        pos = lengths
+        eps = c.rms_eps
+
+        def layer_fn(x, layer):
+            lp, k_c, v_c = layer
+            attn = self._attn(lp)
+            h = RMSNorm(weight=lp["ln1"], eps=eps)(x)
+            a, (k_c, v_c) = attn.decode(h, pos, k_c, v_c, lengths, mode=mode)
+            x = x + a
+            h = RMSNorm(weight=lp["ln2"], eps=eps)(x)
+            if c.is_moe:
+                m = self._mlp(lp)(h, mode="xla" if mode == "xla" else "dist_ar")
+            else:
+                m = self._mlp(lp)(h, mode="dist_ar" if mode != "xla" else "xla")
+            return x + m, (k_c, v_c)
+
+        x, (ks, vs) = jax.lax.scan(
+            lambda carry, layer: layer_fn(carry, layer), x, (self._layer_stack(p), ks, vs)
+        )
+        x = RMSNorm(weight=p.final_norm, eps=eps)(x)
+        logits = jnp.dot(x, p.lm_head, preferred_element_type=jnp.float32)
+        return logits, ks, vs
+
+
+class Qwen3MoE(DenseLLM):
+    """Reference ``Qwen3MoE`` (``models/qwen_moe.py:108``): same skeleton,
+    MoE MLP. Constructed with a MoE config (``config.num_experts`` set)."""
+
+    def __init__(self, config: ModelConfig, ctx, params=None, key=None):
+        assert config.is_moe, "Qwen3MoE needs a MoE config"
+        super().__init__(config, ctx, params, key)

@@ -1,0 +1,144 @@
+"""Inference engine: jit-compiled prefill + decode loop with backend switch.
+
+Reference: ``python/triton_dist/models/engine.py:37-189`` — ``serve()`` does
+HF prefill, switches the model to a triton_dist backend, captures the decode
+step in a CUDA graph, then replays it per token (:75,:113,:166). TPU: jit
+compilation *is* the graph capture — the decode step is traced once under
+``shard_map`` and replayed; caches are donated so XLA updates them in place.
+
+Backends (reference ``engine.py:80`` backend switch):
+  "xla"      — compiler collectives everywhere (the torch-eager analog)
+  "dist"     — AG-GEMM/GEMM-RS prefill + GEMM-AR/one-shot-AR decode
+  "dist_ar"  — GEMM-AR replicated path for both
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from triton_dist_tpu.models.dense import DenseLLM
+from triton_dist_tpu.models.kv_cache import KVCache
+
+
+_BACKENDS = ("xla", "dist", "dist_ar")
+
+
+class Engine:
+    """Reference ``Engine`` (``models/engine.py:37``)."""
+
+    def __init__(self, model: DenseLLM, backend: str = "dist", max_len: int = 512):
+        assert backend in _BACKENDS, backend
+        self.model = model
+        self.backend = backend
+        self.max_len = max_len
+        ctx = model.ctx
+        mesh = ctx.mesh
+        c = model.config
+        axis = model.axis
+
+        prefill_mode = {"xla": "xla", "dist": "dist", "dist_ar": "dist_ar"}[backend]
+        decode_mode = {"xla": "xla", "dist": "dist_ar", "dist_ar": "dist_ar"}[backend]
+
+        p_specs = jax.tree.map(
+            lambda s: s, modelspecs(model), is_leaf=lambda x: isinstance(x, P) or x is None
+        )
+        # Data parallelism: if the mesh has a "dp" axis, the batch dim of
+        # tokens/caches shards over it (reference engine.py:80,127 splits the
+        # batch by world size); tp groups replicate within each dp slice.
+        dp = "dp" if "dp" in ctx.axis_names else None
+        tok_spec = P(dp)
+        len_spec = P(dp)
+        kv_spec = P(None, dp, "tp")  # (L, B over dp, Hkv over tp, S, D)
+
+        def prefill_fn(params, tokens):
+            logits, (ks, vs) = model.prefill_shard(params, tokens, prefill_mode)
+            return jax.lax.all_gather(logits, axis, axis=1, tiled=True), ks, vs
+
+        self._prefill = jax.jit(
+            jax.shard_map(
+                prefill_fn, mesh=mesh,
+                in_specs=(p_specs, tok_spec),
+                out_specs=(tok_spec, kv_spec, kv_spec),
+                check_vma=False,
+            )
+        )
+
+        def decode_fn(params, token, ks, vs, lengths):
+            logits, ks, vs = model.decode_shard(params, token, ks, vs, lengths, decode_mode)
+            return jax.lax.all_gather(logits, axis, axis=1, tiled=True), ks, vs
+
+        self._decode = jax.jit(
+            jax.shard_map(
+                decode_fn, mesh=mesh,
+                in_specs=(p_specs, tok_spec, kv_spec, kv_spec, len_spec),
+                out_specs=(tok_spec, kv_spec, kv_spec),
+                check_vma=False,
+            ),
+            donate_argnums=(2, 3),
+        )
+
+    # ----------------------------------------------------------------- serve
+    def serve(self, input_ids: jax.Array, gen_len: int, sample: str = "greedy"):
+        """Generate ``gen_len`` tokens (greedy). Returns (B, gen_len) int32.
+        Reference ``Engine.serve`` (``engine.py:113``)."""
+        model = self.model
+        c = model.config
+        bsz, seq = input_ids.shape
+        assert seq + gen_len <= self.max_len
+
+        logits, ks, vs = self._prefill(model.params, input_ids)
+        # Pad caches to max_len (prefill produced length == seq).
+        pad = self.max_len - ks.shape[3]
+        if pad > 0:
+            pad_block = jnp.zeros(
+                (ks.shape[0], ks.shape[1], ks.shape[2], pad, ks.shape[4]), ks.dtype
+            )
+            ks = jnp.concatenate([ks, pad_block], axis=3)
+            vs = jnp.concatenate([vs, pad_block], axis=3)
+        lengths = jnp.full((bsz,), seq, jnp.int32)
+
+        out = []
+        token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        out.append(token)
+        for _ in range(gen_len - 1):
+            logits, ks, vs = self._decode(model.params, token, ks, vs, lengths)
+            lengths = lengths + 1
+            token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            out.append(token)
+        return jnp.stack(out, axis=1)
+
+    # ------------------------------------------------------------- profiling
+    def bench_decode(self, bsz: int = 1, prompt_len: int = 64, iters: int = 20):
+        """Steady-state decode latency (reference perf mode of
+        ``test_e2e_inference.py``)."""
+        ids = jnp.zeros((bsz, prompt_len), jnp.int32)
+        logits, ks, vs = self._prefill(self.model.params, ids)
+        pad = self.max_len - ks.shape[3]
+        if pad > 0:
+            pad_block = jnp.zeros(
+                (ks.shape[0], ks.shape[1], ks.shape[2], pad, ks.shape[4]), ks.dtype
+            )
+            ks = jnp.concatenate([ks, pad_block], axis=3)
+            vs = jnp.concatenate([vs, pad_block], axis=3)
+        lengths = jnp.full((bsz,), prompt_len, jnp.int32)
+        token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        # warmup
+        logits, ks, vs = self._decode(self.model.params, token, ks, vs, lengths)
+        jax.block_until_ready(logits)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            logits, ks, vs = self._decode(self.model.params, token, ks, vs, lengths)
+        jax.block_until_ready(logits)
+        return (time.perf_counter() - t0) / iters
+
+
+def modelspecs(model: DenseLLM):
+    from triton_dist_tpu.models.dense import _specs
+
+    return _specs(model.config)

@@ -1,0 +1,45 @@
+"""KV cache (reference ``python/triton_dist/models/kv_cache.py:29``).
+
+The reference keeps a preallocated per-layer (B, Hkv, S_max, D) cache with an
+offset bumped per decode step (CUDA-graph-safe). The TPU analog is identical
+in spirit: fixed-shape arrays + an int32 ``lengths`` vector, functionally
+updated (donated through jit so XLA updates in place).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class KVCache:
+    """Host-side handle: stacked per-layer caches (L, B, Hkv_local, S, D)."""
+
+    k: jax.Array
+    v: jax.Array
+    lengths: jax.Array  # (B,) int32
+
+    @staticmethod
+    def create(num_layers, bsz, num_kv_heads, max_len, head_dim, dtype=jnp.bfloat16, sharding=None):
+        shape = (num_layers, bsz, num_kv_heads, max_len, head_dim)
+        if sharding is not None:
+            zeros = jax.jit(lambda: jnp.zeros(shape, dtype), out_shardings=sharding)()
+        else:
+            zeros = jnp.zeros(shape, dtype)
+        return KVCache(k=zeros, v=jnp.copy(zeros), lengths=jnp.zeros((bsz,), jnp.int32))
+
+    @property
+    def max_len(self) -> int:
+        return self.k.shape[3]
+
+    def inc_offset(self, n: int = 1) -> "KVCache":
+        """Reference ``kv_cache.inc_offset`` (``engine.py:170``)."""
+        return dataclasses.replace(self, lengths=self.lengths + n)
+
+
+jax.tree_util.register_dataclass(
+    KVCache, data_fields=["k", "v", "lengths"], meta_fields=[]
+)

@@ -1,0 +1,162 @@
+"""HF checkpoint loading into the TP parameter layout.
+
+Reference: ``python/triton_dist/models/__init__.py:33-60`` (``AutoLLM``) and
+``dense.py:150-168`` (per-rank shard extraction from HF state dicts). TPU:
+weights load once on host (safetensors), get fused/transposed into
+``DenseParams`` layout, then ``jax.device_put`` with the mesh shardings —
+XLA splits each array across chips, no per-rank files.
+
+Qwen3 HF names → DenseParams mapping:
+  model.embed_tokens.weight                  → embed (V, d)
+  model.layers.N.input_layernorm.weight      → ln1[N]
+  model.layers.N.self_attn.{q,k,v}_proj      → wqkv[N] (fused, col-reordered
+                                                so a tp shard holds
+                                                [q_loc|k_loc|v_loc] heads)
+  model.layers.N.self_attn.{q,k}_norm.weight → q_norm/k_norm[N]
+  model.layers.N.self_attn.o_proj.weight     → wo[N] (transposed)
+  model.layers.N.mlp.{gate,up,down}_proj     → mlp_*[N] (transposed)
+  model.layers.N.mlp.experts.E.*             → stacked expert slabs (MoE)
+  model.layers.N.mlp.gate.weight             → router[N] (MoE)
+  model.norm.weight / lm_head.weight         → final_norm / lm_head
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from triton_dist_tpu.models.config import ModelConfig
+from triton_dist_tpu.models.dense import DenseLLM, Qwen3MoE, DenseParams, _specs
+from triton_dist_tpu.runtime.mesh import DistContext
+
+
+def _reorder_qkv(q, k, v, hq, hkv, hd, world):
+    """Fuse q/k/v projections; reorder columns so each tp column-shard is
+    [q_local | k_local | v_local] (HF stores q then k then v globally).
+    Inputs are (d, h*hd) *already transposed* to matmul layout."""
+    d = q.shape[0]
+    qs = q.reshape(d, world, (hq // world) * hd)
+    ks = k.reshape(d, world, (hkv // world) * hd)
+    vs = v.reshape(d, world, (hkv // world) * hd)
+    return np.concatenate([qs, ks, vs], axis=2).reshape(d, -1)
+
+
+def _load_state_dict(path: str):
+    """Read all safetensors shards under ``path`` into a name→np.ndarray map."""
+    try:
+        from safetensors import safe_open  # ships with transformers
+    except ImportError as e:  # pragma: no cover
+        raise RuntimeError("safetensors required for HF loading") from e
+    tensors = {}
+    files = sorted(f for f in os.listdir(path) if f.endswith(".safetensors"))
+    if not files:
+        raise FileNotFoundError(f"no .safetensors under {path}")
+    for fname in files:
+        with safe_open(os.path.join(path, fname), framework="np") as f:
+            for key in f.keys():
+                tensors[key] = f.get_tensor(key)
+    return tensors
+
+
+def config_from_hf(path: str) -> ModelConfig:
+    with open(os.path.join(path, "config.json")) as f:
+        hf = json.load(f)
+    moe = "num_experts" in hf and hf.get("num_experts")
+    return ModelConfig(
+        vocab_size=hf["vocab_size"],
+        hidden_size=hf["hidden_size"],
+        intermediate_size=hf.get("intermediate_size", 0),
+        num_layers=hf["num_hidden_layers"],
+        num_q_heads=hf["num_attention_heads"],
+        num_kv_heads=hf["num_key_value_heads"],
+        head_dim=hf.get("head_dim", hf["hidden_size"] // hf["num_attention_heads"]),
+        rope_theta=hf.get("rope_theta", 1e6),
+        rms_eps=hf.get("rms_norm_eps", 1e-6),
+        tie_word_embeddings=hf.get("tie_word_embeddings", False),
+        num_experts=hf.get("num_experts"),
+        top_k=hf.get("num_experts_per_tok", 8),
+        moe_intermediate_size=hf.get("moe_intermediate_size"),
+        norm_topk_prob=hf.get("norm_topk_prob", True),
+    )
+
+
+def load_hf_weights(path: str, config: ModelConfig, ctx: DistContext, dtype=None) -> DenseParams:
+    """Build the sharded DenseParams pytree from a local HF checkpoint dir."""
+    sd = _load_state_dict(path)
+    c = config
+    dt = jnp.dtype(dtype or c.dtype)
+    world = ctx.num_ranks("tp")
+    hd = c.head_dim
+    L = c.num_layers
+
+    def T(name):  # HF stores (out, in); we use (in, out)
+        return sd[name].astype(np.float32).T
+
+    wqkv, wo, ln1, ln2, qn, kn = [], [], [], [], [], []
+    mg, mu, md, router = [], [], [], []
+    for i in range(L):
+        pre = f"model.layers.{i}."
+        q = T(pre + "self_attn.q_proj.weight")
+        k = T(pre + "self_attn.k_proj.weight")
+        v = T(pre + "self_attn.v_proj.weight")
+        wqkv.append(_reorder_qkv(q, k, v, c.num_q_heads, c.num_kv_heads, hd, world))
+        wo.append(T(pre + "self_attn.o_proj.weight"))
+        ln1.append(sd[pre + "input_layernorm.weight"].astype(np.float32))
+        ln2.append(sd[pre + "post_attention_layernorm.weight"].astype(np.float32))
+        qn.append(sd.get(pre + "self_attn.q_norm.weight", np.ones(hd)).astype(np.float32))
+        kn.append(sd.get(pre + "self_attn.k_norm.weight", np.ones(hd)).astype(np.float32))
+        if c.is_moe:
+            router.append(T(pre + "mlp.gate.weight"))
+            eg = [T(pre + f"mlp.experts.{e}.gate_proj.weight") for e in range(c.num_experts)]
+            eu = [T(pre + f"mlp.experts.{e}.up_proj.weight") for e in range(c.num_experts)]
+            ed = [T(pre + f"mlp.experts.{e}.down_proj.weight") for e in range(c.num_experts)]
+            mg.append(np.stack(eg))
+            mu.append(np.stack(eu))
+            md.append(np.stack(ed))
+        else:
+            mg.append(T(pre + "mlp.gate_proj.weight"))
+            mu.append(T(pre + "mlp.up_proj.weight"))
+            md.append(T(pre + "mlp.down_proj.weight"))
+
+    embed = sd["model.embed_tokens.weight"].astype(np.float32)
+    lm_head = (
+        embed.T if c.tie_word_embeddings else T("lm_head.weight")
+    )
+    params = DenseParams(
+        embed=jnp.asarray(embed, dt),
+        ln1=jnp.asarray(np.stack(ln1), dt),
+        wqkv=jnp.asarray(np.stack(wqkv), dt),
+        wo=jnp.asarray(np.stack(wo), dt),
+        q_norm=jnp.asarray(np.stack(qn), dt),
+        k_norm=jnp.asarray(np.stack(kn), dt),
+        ln2=jnp.asarray(np.stack(ln2), dt),
+        mlp_gate=jnp.asarray(np.stack(mg), dt),
+        mlp_up=jnp.asarray(np.stack(mu), dt),
+        mlp_down=jnp.asarray(np.stack(md), dt),
+        router=jnp.asarray(np.stack(router), dt) if c.is_moe else None,
+        final_norm=jnp.asarray(sd["model.norm.weight"].astype(np.float32), dt),
+        lm_head=jnp.asarray(lm_head, dt),
+    )
+    specs = _specs(c)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, ctx.sharding(*s)) if x is not None else None,
+        params,
+        specs,
+        is_leaf=lambda x: x is None,
+    )
+
+
+class AutoLLM:
+    """Reference ``AutoLLM`` (``models/__init__.py:33``): build the right
+    model class from a local HF checkpoint directory."""
+
+    @staticmethod
+    def from_pretrained(path: str, ctx: DistContext, dtype=None) -> DenseLLM:
+        config = config_from_hf(path)
+        params = load_hf_weights(path, config, ctx, dtype=dtype)
+        cls = Qwen3MoE if config.is_moe else DenseLLM
+        return cls(config, ctx, params=params)

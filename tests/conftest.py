@@ -21,8 +21,56 @@ import jax  # noqa: E402
 
 jax.config.update("jax_enable_x64", False)
 
+import faulthandler  # noqa: E402
+import os  # noqa: E402
+import sys  # noqa: E402
+import threading  # noqa: E402
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+# ---------------------------------------------------------------------------
+# Per-test hang watchdog (the reference's --verify_hang discipline, SURVEY §4).
+# A watchdog *thread* (not SIGALRM — a hang stuck inside an XLA C++ collective
+# rendezvous never returns to the Python bytecode loop) dumps all stacks and
+# hard-kills the process so CI fails fast instead of stalling. Override the
+# default with @pytest.mark.timeout(seconds).
+# ---------------------------------------------------------------------------
+DEFAULT_TEST_TIMEOUT_S = int(os.environ.get("TDT_TEST_TIMEOUT", "180"))
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "timeout(seconds): per-test hang watchdog limit")
+
+
+@pytest.fixture(autouse=True)
+def _hang_watchdog(request):
+    marker = request.node.get_closest_marker("timeout")
+    limit = marker.args[0] if marker and marker.args else DEFAULT_TEST_TIMEOUT_S
+    if limit <= 0:  # 0 disables the watchdog (pytest-timeout convention)
+        yield
+        return
+    fired = threading.Event()
+
+    def _abort():
+        if fired.is_set():
+            return
+        sys.stderr.write(
+            f"\n*** HANG WATCHDOG: {request.node.nodeid} exceeded {limit}s — "
+            "dumping stacks and aborting ***\n"
+        )
+        faulthandler.dump_traceback(file=sys.stderr, all_threads=True)
+        sys.stderr.flush()
+        os._exit(98)  # hard kill: a stuck XLA rendezvous is not interruptible
+
+    timer = threading.Timer(limit, _abort)
+    timer.daemon = True
+    timer.start()
+    try:
+        yield
+    finally:
+        fired.set()
+        timer.cancel()
 
 from triton_dist_tpu.runtime.platform import cpu_mesh  # noqa: E402
 from triton_dist_tpu.runtime.mesh import DistContext, initialize_distributed  # noqa: E402

@@ -35,6 +35,7 @@ def fit_block(n: int, want: int) -> int:
 
 
 def _flash_kernel(
+    offs_ref,  # SMEM (2,) int32 [q_offset, kv_offset] or None (static offsets)
     q_ref,  # (1, bq, d)
     k_ref,  # (1, bk, d)
     v_ref,  # (1, bk, d)
@@ -54,6 +55,15 @@ def _flash_kernel(
 ):
     iq = pl.program_id(1)
     ik = pl.program_id(2)
+    if offs_ref is not None:
+        # Dynamic global positions (ring attention): query rows start at
+        # offs[0], keys at offs[1], in one shared coordinate system. Every
+        # rank/step runs this same program — masking is data, not control
+        # flow, so ring steps stay uniform across devices (no divergent
+        # branches around the collective rendezvous).
+        q_off = offs_ref[0] - offs_ref[1]  # relative offset: mask is q_off+qi >= ki
+    else:
+        q_off = kv_len - sq
 
     @pl.when(ik == 0)
     def _():
@@ -71,9 +81,9 @@ def _flash_kernel(
 
         if causal:
             # End-aligned (KV-cache) convention: query row i sits at absolute
-            # position kv_len - sq + iq*bq + i, so a prefill continuation
+            # position q_off + iq*bq + i (q_off = kv_len - sq statically, or
+            # the caller-supplied ring offset), so a prefill continuation
             # (sq < kv_len) still attends to the whole cached prefix.
-            q_off = kv_len - sq
             q_ids = q_off + iq * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
             )
@@ -96,8 +106,10 @@ def _flash_kernel(
         )
 
     if causal:
-        # Skip KV blocks entirely above the (end-aligned) diagonal.
-        @pl.when(ik * block_k <= (kv_len - sq) + iq * block_q + block_q - 1)
+        # Skip KV blocks entirely above the (end-aligned) diagonal. With
+        # dynamic offsets this is runtime predication inside a uniform grid —
+        # all devices still launch identical programs.
+        @pl.when(ik * block_k <= q_off + iq * block_q + block_q - 1)
         def _():
             compute()
     else:
@@ -123,9 +135,19 @@ def flash_attention(
     block_q: int = 256,
     block_k: int = 256,
     return_lse: bool = False,
+    q_offset: jax.Array | None = None,
+    kv_offset: jax.Array | None = None,
 ):
     """Flash attention forward. Returns ``o`` (B, Hq, Sq, D), plus the
-    log-sum-exp (B, Hq, Sq) when ``return_lse`` (fp32)."""
+    log-sum-exp (B, Hq, Sq) when ``return_lse`` (fp32).
+
+    ``q_offset``/``kv_offset`` (traced int32 scalars) place the Q rows and KV
+    columns in a shared global coordinate system for causal masking — the
+    ring-attention hook: every ring step calls the *same* program with a
+    step-dependent offset, keeping all devices' control flow uniform (the
+    reference's consumer is likewise uniform, ``sp_ag_attention_intra_node.py:257``).
+    A fully-masked shard yields o=0 and lse≈-inf, which the LSE merge weights
+    to zero."""
     b, hq, sq, d = q.shape
     _, hkv, sk, _ = k.shape
     assert hq % hkv == 0, (hq, hkv)
@@ -139,16 +161,17 @@ def flash_attention(
     kr = k.reshape(b * hkv, sk, d)
     vr = v.reshape(b * hkv, sk, d)
 
-    def kv_index(bh, iq_, ik_):
+    def kv_index(bh, iq_, ik_, *_):
         # q head bh = bi*hq + h → kv row bi*hkv + h // group
         return (bh // hq) * hkv + (bh % hq) // group, ik_, 0
 
     out_shape = [jax.ShapeDtypeStruct((b * hq, sq, d), q.dtype)]
-    out_specs = [pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0))]
+    out_specs = [pl.BlockSpec((1, block_q, d), lambda bh, iq, ik, *_: (bh, iq, 0))]
     if return_lse:
         out_shape.append(jax.ShapeDtypeStruct((b * hq, 1, sq), jnp.float32))
-        out_specs.append(pl.BlockSpec((1, 1, block_q), lambda bh, iq, ik: (bh, 0, iq)))
+        out_specs.append(pl.BlockSpec((1, 1, block_q), lambda bh, iq, ik, *_: (bh, 0, iq)))
 
+    dynamic = q_offset is not None or kv_offset is not None
     kernel = functools.partial(
         _flash_kernel,
         scale=scale,
@@ -159,31 +182,59 @@ def flash_attention(
         kv_len=sk,
         sq=sq,
     )
-    if not return_lse:
-        kernel_fn = lambda q_, k_, v_, o_, acc, m, l: kernel(q_, k_, v_, o_, None, acc, m, l)
+    if dynamic:
+        if return_lse:
+            kernel_fn = kernel
+        else:
+            kernel_fn = lambda offs, q_, k_, v_, o_, acc, m, l: kernel(
+                offs, q_, k_, v_, o_, None, acc, m, l
+            )
     else:
-        kernel_fn = kernel
+        if return_lse:
+            kernel_fn = lambda q_, k_, v_, o_, lse_, acc, m, l: kernel(
+                None, q_, k_, v_, o_, lse_, acc, m, l
+            )
+        else:
+            kernel_fn = lambda q_, k_, v_, o_, acc, m, l: kernel(
+                None, q_, k_, v_, o_, None, acc, m, l
+            )
 
+    grid = (b * hq, sq // block_q, n_kv)
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda bh, iq, ik, *_: (bh, iq, 0)),
+        pl.BlockSpec((1, block_k, d), kv_index),
+        pl.BlockSpec((1, block_k, d), kv_index),
+    ]
+    scratch_shapes = [
+        pltpu.VMEM((block_q, d), jnp.float32),
+        pltpu.VMEM((block_q, LANES), jnp.float32),
+        pltpu.VMEM((block_q, LANES), jnp.float32),
+    ]
+    operands = (qr, kr, vr)
+    if dynamic:
+        offs = jnp.array(
+            [
+                0 if q_offset is None else q_offset,
+                0 if kv_offset is None else kv_offset,
+            ],
+            jnp.int32,
+        )
+        operands = (offs,) + operands
     res = pl.pallas_call(
         kernel_fn,
-        grid=(b * hq, sq // block_q, n_kv),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0)),
-            pl.BlockSpec((1, block_k, d), kv_index),
-            pl.BlockSpec((1, block_k, d), kv_index),
-        ],
-        out_specs=out_specs if return_lse else out_specs[0],
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1 if dynamic else 0,
+            grid=grid,
+            in_specs=in_specs,
+            out_specs=out_specs if return_lse else out_specs[0],
+            scratch_shapes=scratch_shapes,
+        ),
         out_shape=out_shape if return_lse else out_shape[0],
-        scratch_shapes=[
-            pltpu.VMEM((block_q, d), jnp.float32),
-            pltpu.VMEM((block_q, LANES), jnp.float32),
-            pltpu.VMEM((block_q, LANES), jnp.float32),
-        ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret_mode_default(),
-    )(qr, kr, vr)
+    )(*operands)
 
     if return_lse:
         o, lse = res

@@ -67,7 +67,7 @@ def ring_attention_shard(
         return flash_attention(q, k, v, causal=causal, scale=scale, block_q=block_q, block_k=block_k)
 
     perm = [(i, (i + 1) % world) for i in range(world)]
-    b, hq, s_loc, d = q.shape
+    s_loc = q.shape[2]
 
     o = None
     lse = None
@@ -75,32 +75,17 @@ def ring_attention_shard(
     for step in range(world):  # static unroll; ppermute overlaps flash compute
         j = jnp.mod(me - step, world)  # owner of the visiting KV shard
         if causal:
-            # One branch executes per step (lax.cond on the traced shard
-            # owner): diagonal → causal flash, past → full flash, future →
-            # no compute at all (zero weight via -inf LSE).
-            def diag_fn(kc, vc):
-                return flash_attention(
-                    q, kc, vc, causal=True, scale=scale,
-                    block_q=block_q, block_k=block_k, return_lse=True,
-                )
-
-            def past_fn(kc, vc):
-                return flash_attention(
-                    q, kc, vc, causal=False, scale=scale,
-                    block_q=block_q, block_k=block_k, return_lse=True,
-                )
-
-            def future_fn(kc, vc):
-                zero_o = jnp.zeros((b, hq, q.shape[2], d), q.dtype)
-                neg_lse = jnp.full((b, hq, q.shape[2]), -jnp.inf, jnp.float32)
-                return zero_o, neg_lse
-
-            o_step, lse_step = jax.lax.cond(
-                j == me,
-                diag_fn,
-                lambda kc, vc: jax.lax.cond(j < me, past_fn, future_fn, kc, vc),
-                k_cur,
-                v_cur,
+            # UNIFORM program per step on every rank: one flash call with a
+            # step-dependent global-position mask (q rows start at me·S_loc,
+            # visiting KV columns at j·S_loc). j < me → fully unmasked,
+            # j == me → diagonal causal, j > me → fully masked (o=0,
+            # lse≈-inf, killed by the LSE merge). No per-rank lax.cond — a
+            # divergent branch around the ppermute rendezvous deadlocks the
+            # XLA CPU collective (and wastes a pipeline slot on real ICI).
+            o_step, lse_step = flash_attention(
+                q, k_cur, v_cur, causal=True, scale=scale,
+                block_q=block_q, block_k=block_k, return_lse=True,
+                q_offset=me * s_loc, kv_offset=j * s_loc,
             )
         else:
             o_step, lse_step = flash_attention(

@@ -70,7 +70,8 @@ def test_ag_gemm_return_gathered(ctx8, rng):
 
 
 @pytest.mark.parametrize(
-    "method", [GemmRSMethod.XLA_RING, GemmRSMethod.PALLAS, GemmRSMethod.XLA]
+    "method",
+    [GemmRSMethod.XLA_RING, GemmRSMethod.PALLAS_FUSED, GemmRSMethod.PALLAS, GemmRSMethod.XLA],
 )
 def test_gemm_rs_shard(ctx8, rng, method):
     m, k, n = 32, 8 * 32, 128  # K sharded: each rank (32, 32) @ .. -> rows 4
@@ -80,6 +81,29 @@ def test_gemm_rs_shard(ctx8, rng, method):
     f = shard(
         ctx8,
         lambda a_s, b_s: gemm_rs_shard(a_s, b_s, axis="tp", method=method),
+        (P(None, "tp"), P("tp")),
+        P("tp"),
+    )
+    out = np.asarray(f(a, b))
+    expect = np.asarray(a) @ np.asarray(b)
+    np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-4)
+
+
+def test_gemm_rs_fused_tiled(ctx8, rng):
+    """Multi-tile fused GEMM-RS: chunk Mt=2, Nt=2, Kt=2 so tile→send-buffer
+    DMAs, slot reuse, and credit backpressure all engage."""
+    from triton_dist_tpu.kernels.gemm import GemmConfig
+
+    m, k, n = 8 * 16, 8 * 16, 32  # chunk = 16 rows/rank
+    a = jnp.asarray(rng.standard_normal((m, k)), jnp.float32)
+    b = jnp.asarray(rng.standard_normal((k, n)), jnp.float32)
+
+    f = shard(
+        ctx8,
+        lambda a_s, b_s: gemm_rs_shard(
+            a_s, b_s, axis="tp", method=GemmRSMethod.PALLAS_FUSED,
+            gemm_config=GemmConfig(block_m=8, block_n=16, block_k=8),
+        ),
         (P(None, "tp"), P("tp")),
         P("tp"),
     )
@@ -106,6 +130,31 @@ def test_gemm_ar_shard(ctx8, rng, method):
     expect = np.asarray(a) @ np.asarray(b)
     for r in range(WORLD):
         np.testing.assert_allclose(out[r], expect, rtol=1e-4, atol=1e-4, err_msg=f"rank {r}")
+
+
+def test_ag_gemm_pallas_tiled(ctx8, rng):
+    """Multi-tile grid through the fused kernel: per-shard M, N, K all larger
+    than the tile so Mt=2, Nt=2, Kt=2 — exercises the panel double-buffering,
+    B/out streaming, and per-chunk arrival waits at prefill-like structure
+    (tiny absolute sizes per the interpret-substrate ceiling)."""
+    from triton_dist_tpu.kernels.gemm import GemmConfig
+
+    m_shard, k, n_shard = 16, 32, 32
+    a = jnp.asarray(rng.standard_normal((WORLD * m_shard, k)), jnp.float32)
+    b = jnp.asarray(rng.standard_normal((k, WORLD * n_shard)), jnp.float32)
+
+    f = shard(
+        ctx8,
+        lambda a_s, b_s: ag_gemm_shard(
+            a_s, b_s, axis="tp", method=AGGemmMethod.PALLAS_FUSED,
+            config=GemmConfig(block_m=8, block_n=16, block_k=16),
+        ),
+        (P("tp"), P(None, "tp")),
+        P(None, "tp"),
+    )
+    out = np.asarray(f(a, b))
+    expect = np.asarray(a) @ np.asarray(b)
+    np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-4)
 
 
 def test_ag_gemm_bf16_pallas(ctx8, rng):

@@ -16,11 +16,14 @@ overlap engines:
   the collective-matmul in XLA SPMD). Rank-swizzle falls out for free: step 0
   computes on the local shard, exactly like the reference's swizzled tile
   order (``allgather_gemm.py:227-241``).
-* **pallas_fused** — one kernel: ring-forward remote DMA of A chunks, MXU
-  GEMM on the chunk in hand while the next chunk is in flight; per-chunk
-  arrival waits are the semaphore analog of ``dl.wait`` + ``consume_token``.
-  Whole (m, k) and (k, n_local) panels live in VMEM — the small/medium-M
-  regime (decode, the regime where the reference's custom path wins most).
+* **pallas_fused** — one grid-tiled kernel: ring-forward remote DMA of A
+  chunks through an HBM workspace, while the MXU consumes the chunk in hand
+  tile-by-tile — B tiles and output tiles stream through HBM via BlockSpec
+  pipelining, A row-panels double-buffer HBM→VMEM, and the per-chunk arrival
+  wait is the semaphore analog of ``dl.wait`` + ``consume_token``
+  (reference persistent consumer ``allgather_gemm.py:165-270``, wait :242).
+  Covers decode (Mt=Nt=1) through prefill (8k×4k×4k per chip) without any
+  whole-panel VMEM residency requirement.
 
 Also returns the gathered A when requested (reference ``ag_gemm`` returns the
 AG result for reuse in later layers, ``allgather_gemm.py:534``).
@@ -40,7 +43,7 @@ from jax.sharding import PartitionSpec as P
 
 import triton_dist_tpu.language as tpl
 from triton_dist_tpu.runtime.mesh import DistContext
-from triton_dist_tpu.shmem.kernel import dist_pallas_call
+from triton_dist_tpu.shmem.kernel import collective_id_for, dist_pallas_call
 
 
 class AGGemmMethod(enum.Enum):
@@ -66,17 +69,45 @@ def create_ag_gemm_context(
     return AGGemmContext(ctx=ctx, axis=axis, method=method)
 
 
+def _fused_tiles(m: int, k: int, n: int, dtype, config=None):
+    """Pick (bm, bn, bk) for the fused kernel, shrinking bm until the VMEM
+    working set (A panel ×2, B tile ×2, out tile ×2, fp32 acc) fits. Returns
+    None when no tiling fits (pathologically large k) — caller falls back."""
+    from triton_dist_tpu.kernels.gemm import fit_block
+
+    itemsize = jnp.dtype(dtype).itemsize
+    want_m, want_n, want_k = (
+        (config.block_m, config.block_n, config.block_k) if config else (256, 512, 512)
+    )
+    bn, bk = fit_block(n, want_n), fit_block(k, want_k)
+    bm = fit_block(m, want_m)
+    budget = 12 * 1024 * 1024
+    while True:
+        need = (
+            2 * bm * k * itemsize  # double-buffered A row panel
+            + 2 * bk * bn * itemsize  # pipelined B tile
+            + 2 * bm * bn * itemsize  # pipelined out tile
+            + bm * bn * 4  # fp32 accumulator
+        )
+        if need <= budget:
+            return bm, bn, bk
+        if bm > 8:
+            bm = fit_block(m, bm // 2)
+        elif bn > 128:
+            bn = fit_block(n, bn // 2)
+        else:
+            return None
+
+
 def _resolve_method(
-    method: AGGemmMethod, m_shard: int, k: int, n: int, world: int, dtype
+    method: AGGemmMethod, m_shard: int, k: int, n: int, dtype
 ) -> AGGemmMethod:
     if method is not AGGemmMethod.AUTO:
         return method
-    # The fused kernel pins in VMEM: the (k, n) B panel, the (world·m, n)
-    # output, and the (2, m, k) A staging buffers. Use it only when the whole
-    # working set fits comfortably (small-M decode regime); XLA ring otherwise.
-    itemsize = jnp.dtype(dtype).itemsize
-    vmem_bytes = (k * n + world * m_shard * n + 2 * m_shard * k) * itemsize
-    if vmem_bytes <= 10 * 1024 * 1024:
+    # The tiled fused kernel streams B and the output through HBM, so it
+    # covers decode through prefill; fall back to the XLA ring only when no
+    # tiling fits VMEM (see _fused_tiles).
+    if _fused_tiles(m_shard, k, n, dtype) is not None:
         return AGGemmMethod.PALLAS_FUSED
     return AGGemmMethod.XLA_RING
 
@@ -115,108 +146,173 @@ def _ag_gemm_xla_ring(a, b, *, axis, accum_dtype=jnp.float32, return_gathered=Fa
 
 
 def _ag_gemm_fused_kernel(
+    order_ref,  # SMEM (world,) int32 — order[s] = (me - s) % world
     a_ref,  # (m, k) ANY — local shard
-    b_ref,  # (k, n) VMEM — local weight panel
-    out_ref,  # (world*m, n) VMEM
+    b_ref,  # (bk, bn) VMEM — pipelined B tile
+    out_ref,  # (bm, bn) VMEM — pipelined out tile at rows order[s]*m + im*bm
     a_buf,  # (world, m, k) ANY dummy output — symmetric gather workspace
-    a_vmem,  # (2, m, k) VMEM — compute staging, double-buffered
+    a_panel,  # VMEM (2, bm, k) — A row panels, double-buffered
+    acc,  # VMEM (bm, bn) f32
+    panel_sem,  # DMA (2,)
     send_sem,  # DMA (world-1,)
     recv_sem,  # DMA (world-1,)
-    copy_sem,  # DMA (2,)
     *,
     axis,
     mesh_axes,
+    n_m: int,
+    n_n: int,
+    n_k: int,
+    block_k: int,
 ):
-    """Ring-forward producer fused with per-chunk GEMM consumer.
+    """Grid-tiled ring-AG producer fused with a streaming GEMM consumer.
 
-    Step ``s`` computes on chunk ``(me - s) % world`` while the ring DMA for
-    the next chunk is in flight — compute hides communication exactly like the
-    reference's persistent consumer waiting per-tile signals
-    (``allgather_gemm.py:242-243``).
+    Grid ``(world, Mt, Nt, Kt)``: chunk step ``s`` computes on shard
+    ``order[s] = (me - s) % world`` (rank-swizzle — step 0 is the local
+    shard) while the ring DMA for the next chunk is in flight. The per-chunk
+    arrival wait at each step's first tile is the ``dl.wait`` analog of the
+    reference's persistent consumer (``allgather_gemm.py:242-243``); B and
+    output tiles stream through HBM via BlockSpec pipelining, so nothing
+    requires whole-panel VMEM residency — this covers the prefill regime.
     """
+    s, im, jn, kk = (pl.program_id(i) for i in range(4))
     me = tpl.rank(axis)
     world = tpl.num_ranks(axis)
     right = tpl.ring_neighbor(axis, +1, mesh_axes=mesh_axes)
-    m = a_ref.shape[0]
+    bm = a_panel.shape[1]
+    src = order_ref[s]
 
-    cp = pltpu.make_async_copy(a_ref, a_buf.at[me], copy_sem.at[0])
-    cp.start()
-    cp.wait()
-    tpl.barrier_all(axis, mesh_axes=mesh_axes)
+    def stage_panel(row, slot):
+        return pltpu.make_async_copy(
+            a_buf.at[src, pl.ds(row * bm, bm)], a_panel.at[slot], panel_sem.at[slot]
+        )
 
-    def stage_in(s, src, slot):
-        cpv = pltpu.make_async_copy(a_buf.at[src], a_vmem.at[slot], copy_sem.at[slot])
-        cpv.start()
-        return cpv
+    @pl.when(jnp.logical_and(im == 0, jnp.logical_and(jn == 0, kk == 0)))
+    def _step_start():
+        @pl.when(s == 0)
+        def _():
+            # Publish my shard into the gather workspace; barrier so ring
+            # sends never race a peer still writing its own shard.
+            cp = pltpu.make_async_copy(a_ref, a_buf.at[me], panel_sem.at[0])
+            cp.start()
+            cp.wait()
+            tpl.barrier_all(axis, mesh_axes=mesh_axes)
 
-    # Prefetch my own chunk into VMEM slot 0.
-    stage_in(0, me, 0).wait()
-
-    def step(s, _):
-        src = jax.lax.rem(me - s + world, world)
-        slot = jax.lax.rem(s, 2)
+        @pl.when(s > 0)
+        def _():
+            # Arrival of this step's chunk (dl.wait analog) + completion of
+            # the previous ring send before its semaphore slot retires.
+            tpl.wait_recv(recv_sem.at[s - 1], a_buf.at[src])
+            tpl.wait_send(send_sem.at[s - 1], a_buf.at[src])
 
         @pl.when(s < world - 1)
         def _():
-            # Ring-forward the chunk I hold (per-step sem slots: ranks drift).
-            dma = pltpu.make_async_remote_copy(
+            # Ring-forward the chunk just consumed-from to the right neighbor
+            # (per-step semaphore slots: ranks drift through steps together).
+            pltpu.make_async_remote_copy(
                 src_ref=a_buf.at[src],
                 dst_ref=a_buf.at[src],
                 send_sem=send_sem.at[s],
                 recv_sem=recv_sem.at[s],
                 device_id=right,
                 device_id_type=pltpu.DeviceIdType.LOGICAL,
-            )
-            dma.start()
+            ).start()
 
-        # MXU work on the chunk in hand — overlaps the DMA above.
-        token = jnp.int32(0)
-        prod = jnp.dot(
-            tpl.consume_token(a_vmem[slot], token),
-            b_ref[...],
-            preferred_element_type=jnp.float32,
-        )
-        out_ref[pl.ds(src * m, m), :] = prod.astype(out_ref.dtype)
+        # First A panel of the step: synchronous stage (a one-panel HBM→VMEM
+        # bubble per chunk step; the inter-step ring DMA itself is hidden).
+        p = stage_panel(0, 0)
+        p.start()
+        p.wait()
 
-        @pl.when(s < world - 1)
-        def _():
-            nxt = jax.lax.rem(me - s - 1 + world, world)
-            # Wait arrival of the next chunk (dl.wait analog), then stage it.
-            pltpu.make_async_copy(a_buf.at[nxt], a_buf.at[nxt], recv_sem.at[s]).wait()
-            pltpu.make_async_copy(a_buf.at[src], a_buf.at[src], send_sem.at[s]).wait()
-            stage_in(s + 1, nxt, jax.lax.rem(s + 1, 2)).wait()
+    @pl.when(jnp.logical_and(im > 0, jnp.logical_and(jn == 0, kk == 0)))
+    def _panel_start():
+        # The panel was prefetched while the previous panel computed.
+        pltpu.make_async_copy(
+            a_buf.at[src, pl.ds(im * bm, bm)],
+            a_panel.at[jax.lax.rem(im, 2)],
+            panel_sem.at[jax.lax.rem(im, 2)],
+        ).wait()
 
-        return 0
+    @pl.when(jnp.logical_and(im + 1 < n_m, jnp.logical_and(jn == 0, kk == 0)))
+    def _prefetch_next_panel():
+        stage_panel(im + 1, jax.lax.rem(im + 1, 2)).start()
 
-    jax.lax.fori_loop(0, world, step, 0)
-    tpl.barrier_all(axis, mesh_axes=mesh_axes)
+    @pl.when(kk == 0)
+    def _():
+        acc[...] = jnp.zeros_like(acc)
+
+    slot = jax.lax.rem(im, 2)
+    a_tile = a_panel[slot, :, pl.ds(kk * block_k, block_k)]
+    acc[...] += jax.lax.dot_general(
+        a_tile, b_ref[...], (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+    @pl.when(kk == n_k - 1)
+    def _():
+        out_ref[...] = acc[...].astype(out_ref.dtype)
+
+    is_last = jnp.logical_and(
+        s == world - 1,
+        jnp.logical_and(im == n_m - 1, jnp.logical_and(jn == n_n - 1, kk == n_k - 1)),
+    )
+
+    @pl.when(is_last)
+    def _():
+        # No rank leaves while a peer might still read its workspace.
+        tpl.barrier_all(axis, mesh_axes=mesh_axes)
 
 
-def _ag_gemm_pallas(a, b, *, axis, mesh_axes):
+def _ag_gemm_pallas(a, b, *, axis, mesh_axes, config=None):
     world = jax.lax.axis_size(axis)
+    me = jax.lax.axis_index(axis)
     m, k = a.shape
     n = b.shape[1]
+    tiles = _fused_tiles(m, k, n, a.dtype, config)
+    assert tiles is not None, "no VMEM-fitting tiling; use XLA_RING"
+    bm, bn, bk = tiles
+    n_m, n_n, n_k = m // bm, n // bn, k // bk
+    order = jnp.mod(me - jnp.arange(world, dtype=jnp.int32), world).astype(jnp.int32)
+
     out, a_buf = dist_pallas_call(
-        functools.partial(_ag_gemm_fused_kernel, axis=axis, mesh_axes=mesh_axes),
+        functools.partial(
+            _ag_gemm_fused_kernel,
+            axis=axis,
+            mesh_axes=mesh_axes,
+            n_m=n_m,
+            n_n=n_n,
+            n_k=n_k,
+            block_k=bk,
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(world, n_m, n_n, n_k),
+            in_specs=[
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec((bk, bn), lambda s, im, jn, kk, order: (kk, jn)),
+            ],
+            out_specs=(
+                pl.BlockSpec(
+                    (bm, bn), lambda s, im, jn, kk, order: (order[s] * (a.shape[0] // bm) + im, jn)
+                ),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((2, bm, k), a.dtype),
+                pltpu.VMEM((bm, bn), jnp.float32),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((max(world - 1, 1),)),
+                pltpu.SemaphoreType.DMA((max(world - 1, 1),)),
+            ],
+        ),
         out_shape=(
             jax.ShapeDtypeStruct((world * m, n), a.dtype),
             jax.ShapeDtypeStruct((world, m, k), a.dtype),
         ),
-        in_specs=[
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pltpu.VMEM),
-        ],
-        out_specs=(
-            pl.BlockSpec(memory_space=pltpu.VMEM),
-            pl.BlockSpec(memory_space=pl.ANY),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary", "arbitrary", "arbitrary"),
+            has_side_effects=True,
+            collective_id=collective_id_for("_ag_gemm_fused_kernel"),
         ),
-        scratch_shapes=[
-            pltpu.VMEM((2, m, k), a.dtype),
-            pltpu.SemaphoreType.DMA((max(world - 1, 1),)),
-            pltpu.SemaphoreType.DMA((max(world - 1, 1),)),
-            pltpu.SemaphoreType.DMA((2,)),
-        ],
-    )(a, b)
+    )(order, a, b)
     return out, a_buf.reshape(world * m, k)
 
 
@@ -231,6 +327,7 @@ def ag_gemm_shard(
     mesh_axes=None,
     method: AGGemmMethod = AGGemmMethod.AUTO,
     return_gathered: bool = False,
+    config=None,
 ):
     """Compute ``all_gather(A) @ B_local`` with comm/compute overlap.
 
@@ -239,7 +336,7 @@ def ag_gemm_shard(
     ``ag_gemm`` (``allgather_gemm.py:534``).
     """
     world = jax.lax.axis_size(axis)
-    method = _resolve_method(method, a.shape[0], a.shape[1], b.shape[1], world, a.dtype)
+    method = _resolve_method(method, a.shape[0], a.shape[1], b.shape[1], a.dtype)
     if world == 1:
         out = jnp.dot(a, b, preferred_element_type=jnp.float32).astype(a.dtype)
         return (out, a) if return_gathered else out
@@ -250,7 +347,7 @@ def ag_gemm_shard(
         return (out, ag) if return_gathered else out
 
     if method is AGGemmMethod.PALLAS_FUSED:
-        out, ag = _ag_gemm_pallas(a, b, axis=axis, mesh_axes=mesh_axes)
+        out, ag = _ag_gemm_pallas(a, b, axis=axis, mesh_axes=mesh_axes, config=config)
         return (out, ag) if return_gathered else out
 
     return _ag_gemm_xla_ring(a, b, axis=axis, return_gathered=return_gathered)

@@ -25,13 +25,8 @@ LANES = 128
 NEG_INF = -1e30
 
 
-def fit_block(n: int, want: int) -> int:
-    """Largest power-of-two-shrunk block ≤ ``want`` dividing ``n`` (falls back
-    to n itself for awkward lengths) — callers never trip divisibility."""
-    b = min(want, n)
-    while b > 1 and n % b:
-        b //= 2
-    return b if n % b == 0 else n
+# Re-exported for backward compatibility; canonical home is kernels/gemm.py.
+from triton_dist_tpu.kernels.gemm import fit_block  # noqa: E402,F401
 
 
 def _flash_kernel(

@@ -113,7 +113,7 @@ def flash_decode(
     assert hq % hkv == 0
     group = hq // hkv
     scale = scale if scale is not None else d ** -0.5
-    from triton_dist_tpu.kernels.flash_attn import fit_block
+    from triton_dist_tpu.kernels.gemm import fit_block
 
     block_k = fit_block(s, block_k)
     n_kv = s // block_k

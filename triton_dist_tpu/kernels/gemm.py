@@ -24,6 +24,15 @@ from jax.experimental.pallas import tpu as pltpu
 from triton_dist_tpu.runtime.platform import interpret_mode_default
 
 
+def fit_block(n: int, want: int) -> int:
+    """Largest power-of-two-shrunk block ≤ ``want`` dividing ``n`` (falls back
+    to n itself for awkward lengths) — callers never trip divisibility."""
+    b = min(want, n)
+    while b > 1 and n % b:
+        b //= 2
+    return b if n % b == 0 else n
+
+
 @dataclasses.dataclass(frozen=True)
 class GemmConfig:
     """One point of the tuning space (reference ``get_config_space``)."""
@@ -31,6 +40,9 @@ class GemmConfig:
     block_m: int = 512
     block_n: int = 512
     block_k: int = 512
+    # Scoped-VMEM budget for this kernel (None = Mosaic's default 16 MiB).
+    # Large row-panel configs need more; the chip has far more physical VMEM.
+    vmem_limit_mb: int | None = None
 
     def key(self) -> str:
         return f"bm{self.block_m}_bn{self.block_n}_bk{self.block_k}"
@@ -49,6 +61,20 @@ def get_config_space(max_m: int | None = None) -> list[GemmConfig]:
                     continue
                 space.append(GemmConfig(bm, bn, bk))
     return space
+
+
+def gemm_config_for(m: int, k: int, n: int, dtype) -> GemmConfig:
+    """Trace-time tuned-config lookup (offline ``tools.tune_gemm`` fills the
+    cache; reference ``tune.py:175-255``). Falls back to the default tile."""
+    import jax
+
+    from triton_dist_tpu.tools.tune import lookup
+
+    hit = lookup(
+        "gemm",
+        [jax.ShapeDtypeStruct((m, k), dtype), jax.ShapeDtypeStruct((k, n), dtype)],
+    )
+    return GemmConfig(**hit) if hit else GemmConfig()
 
 
 def _matmul_kernel(a_ref, b_ref, o_ref, acc_ref, *, n_k: int, epilogue):
@@ -103,6 +129,9 @@ def gemm(
         scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
+            vmem_limit_bytes=(
+                cfg.vmem_limit_mb * 1024 * 1024 if cfg.vmem_limit_mb else None
+            ),
         ),
         interpret=interpret_mode_default(),
         cost_estimate=pl.CostEstimate(
@@ -170,6 +199,9 @@ def gemm_swiglu(
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
+            vmem_limit_bytes=(
+                cfg.vmem_limit_mb * 1024 * 1024 if cfg.vmem_limit_mb else None
+            ),
         ),
         interpret=interpret_mode_default(),
         cost_estimate=pl.CostEstimate(

@@ -11,31 +11,44 @@ stream scatters, locally reduces, and ring-reduces across nodes
   incoming accumulator. XLA overlaps each step's ``ppermute`` with the next
   chunk-GEMM — compute hides the scatter exactly like the reference's
   per-tile-signal consumer.
+* **pallas_fused** — ONE grid-tiled kernel (grid ``(world, Mt, Nt, Kt)``):
+  the fp32 accumulator chunk travels the ring while the K-loop runs — each
+  output tile's final K-iteration adds the incoming partial tile and DMAs
+  the result into the outgoing send buffer, so ring traffic interleaves with
+  GEMM progress at tile granularity (the TPU analog of the reference's
+  per-tile scatter signals, ``gemm_reduce_scatter.py:122,273`` +
+  ``reduce_scatter.py:822``). Credit semaphores give the ring backpressure.
 * **pallas** — pallas GEMM producing the full partial, then the one-sided
-  ring-RS kernel (kernel-granular overlap only; the fused per-tile variant is
-  the planned successor).
+  ring-RS kernel (kernel-granular overlap only; kept as a baseline).
 * **xla** — ``dot + psum_scatter`` unoverlapped baseline.
 
-Accumulation is fp32 on-chip; the ring wire carries the output dtype.
+Accumulation is fp32 on-chip; the fused ring wire carries fp32 partials
+(exactness parity with the fp32-accum RS kernel).
 """
 
 from __future__ import annotations
 
 import dataclasses
 import enum
+import functools
 
 import jax
 import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import PartitionSpec as P
 
+import triton_dist_tpu.language as tpl
 from triton_dist_tpu.runtime.mesh import DistContext
 from triton_dist_tpu.kernels.gemm import gemm, GemmConfig
 from triton_dist_tpu.kernels.reduce_scatter import reduce_scatter_shard
+from triton_dist_tpu.shmem.kernel import collective_id_for, dist_pallas_call
 
 
 class GemmRSMethod(enum.Enum):
     AUTO = "auto"
     XLA_RING = "xla_ring"
+    PALLAS_FUSED = "pallas_fused"
     PALLAS = "pallas"
     XLA = "xla"
 
@@ -79,6 +92,253 @@ def _gemm_rs_xla_ring(a, b, *, axis, accum_dtype=jnp.float32):
     return acc.astype(a.dtype)
 
 
+def _gemm_rs_fused_kernel(
+    sched_ref,  # SMEM (world,) int32 — sched[s] = (me - 1 - s) % world
+    a_ref,  # (bm, bk) VMEM — pipelined A tile (rows of chunk sched[s])
+    b_ref,  # (bk, bn) VMEM — pipelined B tile
+    o_ref,  # (chunk, n) ANY — final reduced chunk, tile-DMA'd at s==world-1
+    send_buf,  # (2, chunk, n) f32 ANY — outgoing partial chunk, per-slot
+    recv_buf,  # (2, chunk, n) f32 ANY — incoming partial chunk, per-slot
+    acc,  # VMEM (bm, bn) f32
+    recv_tile,  # VMEM (bm, bn) f32 — staged incoming tile
+    send_stage,  # VMEM (2, bm, bn) f32 — outgoing tile, double-buffered
+    out_stage,  # VMEM (2, bm, bn) out dtype — final tile, double-buffered
+    recv_sem,  # DMA (2,)
+    send_sem,  # DMA (2,) — remote send completion
+    tile_out_sem,  # DMA (2,) — local copies into send_buf (byte-counted)
+    tile_in_sem,  # DMA (1,) — recv tile staging
+    out_sem,  # DMA (2,) — final tile copies into o_ref
+    credit_sem,  # REGULAR (2,) — receiver → left: slot consumed
+    *,
+    axis,
+    mesh_axes,
+    n_m: int,
+    n_n: int,
+    n_k: int,
+):
+    """Fused ring reduce-scatter matmul (see module doc). Step ``s`` computes
+    the chunk-GEMM for chunk ``sched[s]``, adding the partial received from
+    the left neighbor; every finished tile is DMA'd into the outgoing buffer
+    immediately (K-loop-interleaved ring traffic), and the chunk-complete
+    remote send overlaps the next step's GEMM."""
+    s, im, jn, kk = (pl.program_id(i) for i in range(4))
+    world = tpl.num_ranks(axis)
+    right = tpl.ring_neighbor(axis, +1, mesh_axes=mesh_axes)
+    left = tpl.ring_neighbor(axis, -1, mesh_axes=mesh_axes)
+    bm, bn = acc.shape
+    cur = jax.lax.rem(s, 2)  # outgoing slot of this step
+    prev = jax.lax.rem(s - 1 + 2, 2)  # incoming slot (left's step s-1)
+
+    @pl.when(jnp.logical_and(im == 0, jnp.logical_and(jn == 0, kk == 0)))
+    def _step_start():
+        @pl.when(s > 0)
+        def _():
+            # Incoming partial chunk fully arrived (dl.wait analog).
+            tpl.wait_recv(recv_sem.at[prev], recv_buf.at[prev])
+
+        @pl.when(s >= 2)
+        def _():
+            # Slot reuse: our send of step s-2 completed locally, and the
+            # right neighbor consumed it (credit backpressure).
+            tpl.wait_send(send_sem.at[cur], send_buf.at[cur])
+            tpl.wait(credit_sem.at[cur], 1)
+
+    # Stage the incoming tile for this (im, jn) early — overlaps the K-loop.
+    @pl.when(jnp.logical_and(s > 0, kk == 0))
+    def _():
+        pltpu.make_async_copy(
+            recv_buf.at[prev, pl.ds(im * bm, bm), pl.ds(jn * bn, bn)],
+            recv_tile,
+            tile_in_sem.at[0],
+        ).start()
+
+    @pl.when(kk == 0)
+    def _():
+        acc[...] = jnp.zeros_like(acc)
+
+    acc[...] += jax.lax.dot_general(
+        a_ref[...], b_ref[...], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(kk == n_k - 1)
+    def _tile_done():
+        @pl.when(s > 0)
+        def _():
+            pltpu.make_async_copy(
+                recv_buf.at[prev, pl.ds(im * bm, bm), pl.ds(jn * bn, bn)],
+                recv_tile,
+                tile_in_sem.at[0],
+            ).wait()
+
+        # where(), not arithmetic: recv_tile is uninitialized garbage at s==0
+        # and garbage*0 could be NaN.
+        val = acc[...] + jnp.where(s > 0, recv_tile[...], jnp.zeros_like(recv_tile))
+
+        tile_idx = im * n_n + jn
+
+        @pl.when(s == world - 1)
+        def _():
+            # Output must be an ANY buffer written by tile DMAs: a pipelined
+            # out BlockSpec would revisit its blocks once per ring step,
+            # which Pallas forbids.
+            t = jax.lax.rem(tile_idx, 2)
+
+            @pl.when(tile_idx >= 2)
+            def _():
+                pltpu.make_async_copy(
+                    out_stage.at[t], out_stage.at[t], out_sem.at[t]
+                ).wait()
+
+            out_stage[t] = val.astype(out_stage.dtype)
+            pltpu.make_async_copy(
+                out_stage.at[t],
+                o_ref.at[pl.ds(im * bm, bm), pl.ds(jn * bn, bn)],
+                out_sem.at[t],
+            ).start()
+
+        @pl.when(s < world - 1)
+        def _():
+            # Ship this tile into the outgoing chunk buffer right away — the
+            # per-tile producer signal analog; the byte-counting semaphore
+            # doubles as the chunk-complete signal.
+            t = jax.lax.rem(im * n_n + jn, 2)
+
+            @pl.when(im * n_n + jn >= 2)
+            def _():
+                pltpu.make_async_copy(
+                    send_stage.at[t], send_stage.at[t], tile_out_sem.at[t]
+                ).wait()
+
+            send_stage[t] = val
+            pltpu.make_async_copy(
+                send_stage.at[t],
+                send_buf.at[cur, pl.ds(im * bm, bm), pl.ds(jn * bn, bn)],
+                tile_out_sem.at[t],
+            ).start()
+
+        is_chunk_end = jnp.logical_and(im == n_m - 1, jn == n_n - 1)
+
+        @pl.when(jnp.logical_and(is_chunk_end, s < world - 1))
+        def _chunk_send():
+            # Drain outstanding tile copies (the last tile's, and — when the
+            # chunk has ≥2 tiles — the second-to-last tile's on the other
+            # slot; everything older was waited before slot reuse), then push
+            # the whole chunk. Tile count is static, so slots are too.
+            t_last = (n_m * n_n - 1) % 2
+            if n_m * n_n >= 2:
+                pltpu.make_async_copy(
+                    send_stage.at[1 - t_last], send_stage.at[1 - t_last],
+                    tile_out_sem.at[1 - t_last],
+                ).wait()
+            pltpu.make_async_copy(
+                send_stage.at[t_last], send_stage.at[t_last], tile_out_sem.at[t_last]
+            ).wait()
+            pltpu.make_async_remote_copy(
+                src_ref=send_buf.at[cur],
+                dst_ref=recv_buf.at[cur],
+                send_sem=send_sem.at[cur],
+                recv_sem=recv_sem.at[cur],
+                device_id=right,
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            ).start()
+
+        @pl.when(jnp.logical_and(is_chunk_end, s > 0))
+        def _():
+            # Free the consumed slot back to the left neighbor.
+            tpl.notify(credit_sem.at[prev], left)
+
+    is_last = jnp.logical_and(
+        s == world - 1,
+        jnp.logical_and(im == n_m - 1, jnp.logical_and(jn == n_n - 1, kk == n_k - 1)),
+    )
+
+    @pl.when(is_last)
+    def _():
+        # Drain: outstanding output-tile copies, our last send (step
+        # world-2), and the credit the right neighbor signalled when
+        # consuming it (its step world-1 chunk end runs before this wait on
+        # every rank — signal-before-wait, no cycle).
+        t_last = (n_m * n_n - 1) % 2
+        if n_m * n_n >= 2:
+            pltpu.make_async_copy(
+                out_stage.at[1 - t_last], out_stage.at[1 - t_last],
+                out_sem.at[1 - t_last],
+            ).wait()
+        pltpu.make_async_copy(
+            out_stage.at[t_last], out_stage.at[t_last], out_sem.at[t_last]
+        ).wait()
+        tpl.wait_send(send_sem.at[(world - 2) % 2], send_buf.at[0])
+        tpl.wait(credit_sem.at[(world - 2) % 2], 1)
+        tpl.barrier_all(axis, mesh_axes=mesh_axes)
+
+
+def _gemm_rs_fused(a, b, *, axis, mesh_axes, config=None):
+    world = jax.lax.axis_size(axis)
+    me = jax.lax.axis_index(axis)
+    m, k = a.shape
+    n = b.shape[1]
+    assert m % world == 0, (m, world)
+    chunk = m // world
+    from triton_dist_tpu.kernels.gemm import fit_block
+
+    cfg = config or GemmConfig(256, 512, 512)
+    bm = fit_block(chunk, cfg.block_m)
+    bn = fit_block(n, cfg.block_n)
+    bk = fit_block(k, cfg.block_k)
+    n_m, n_n, n_k = chunk // bm, n // bn, k // bk
+    sched = jnp.mod(me - 1 - jnp.arange(world, dtype=jnp.int32), world).astype(jnp.int32)
+
+    out, _, _ = dist_pallas_call(
+        functools.partial(
+            _gemm_rs_fused_kernel,
+            axis=axis,
+            mesh_axes=mesh_axes,
+            n_m=n_m,
+            n_n=n_n,
+            n_k=n_k,
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(world, n_m, n_n, n_k),
+            in_specs=[
+                pl.BlockSpec(
+                    (bm, bk), lambda s, im, jn, kk, sched: (sched[s] * (a.shape[0] // world // bm) + im, kk)
+                ),
+                pl.BlockSpec((bk, bn), lambda s, im, jn, kk, sched: (kk, jn)),
+            ],
+            out_specs=(
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((bm, bn), jnp.float32),
+                pltpu.VMEM((bm, bn), jnp.float32),
+                pltpu.VMEM((2, bm, bn), jnp.float32),
+                pltpu.VMEM((2, bm, bn), a.dtype),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((1,)),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.REGULAR((2,)),
+            ],
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((chunk, n), a.dtype),
+            jax.ShapeDtypeStruct((2, chunk, n), jnp.float32),
+            jax.ShapeDtypeStruct((2, chunk, n), jnp.float32),
+        ),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary", "arbitrary", "arbitrary"),
+            has_side_effects=True,
+            collective_id=collective_id_for("_gemm_rs_fused_kernel"),
+        ),
+    )(sched, a, b)
+    return out
+
+
 def gemm_rs_shard(
     a: jax.Array,  # (m, k_shard) — A column-shard of this rank
     b: jax.Array,  # (k_shard, n) — B row-shard of this rank
@@ -102,6 +362,9 @@ def gemm_rs_shard(
         return jax.lax.psum_scatter(
             partial, axis, scatter_dimension=0, tiled=True
         ).astype(a.dtype)
+
+    if method is GemmRSMethod.PALLAS_FUSED:
+        return _gemm_rs_fused(a, b, axis=axis, mesh_axes=mesh_axes, config=gemm_config)
 
     if method is GemmRSMethod.PALLAS:
         partial = gemm(a, b, config=gemm_config)

@@ -1,0 +1,29 @@
+"""Tooling layer: autotuner + tune cache, timing, profiler, perf models.
+
+Reference: ``python/triton_dist/{autotuner,tune}.py`` and
+``python/triton_dist/tools/`` (AOT compiler, intra-kernel profiler, offline
+GEMM tuner). TPU redesign notes:
+
+* The reference's *contextual* autotuner re-runs the whole distributed op so
+  ``triton.autotune`` candidates get timed collectively, allreducing timings
+  across ranks (``autotuner.py:43-250``). Our runtime is single-controller
+  (one process drives every device in the mesh), so host wall-clock around a
+  jitted sharded op *is* the collective time — candidates are timed whole-op
+  with no cross-rank reduction needed.
+* Tuning can't happen under ``jit`` tracing (configs are static Python), so
+  tuning is offline: ``autotune()`` measures candidates eagerly and persists
+  the winner in a JSON cache keyed by op/shape/dtype/device-kind
+  (reference ``tune.py:175-255``); hot paths read the cache via
+  ``lookup()``/``gemm_config_for()`` at trace time.
+"""
+
+from triton_dist_tpu.tools.timing import bench_device_time
+from triton_dist_tpu.tools.tune import TuneCache, autotune, lookup, default_cache
+
+__all__ = [
+    "bench_device_time",
+    "TuneCache",
+    "autotune",
+    "lookup",
+    "default_cache",
+]

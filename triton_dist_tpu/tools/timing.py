@@ -1,0 +1,101 @@
+"""Device-time measurement that survives a tunneled TPU.
+
+Two gotchas of driving a remote chip: host→device dispatch latency is large
+and noisy, and ``block_until_ready`` returns when the *dispatch* completes,
+not the device work — only a device→host readback fences execution. So every
+measurement here jits a ``fori_loop`` chain of N dependent steps, forces one
+scalar readback, and differences a long chain against a short one: dispatch
+and readback costs cancel, leaving per-iteration device time.
+
+The chain feeds each step's output back into the next step's input (caller
+supplies ``chain`` saying how), which keeps every iteration's full output
+live — XLA cannot DCE or algebraically narrow the work the way it could if
+we only read one element.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def _walltime(thunk) -> float:
+    t0 = time.perf_counter()
+    thunk()
+    return time.perf_counter() - t0
+
+
+# Tunnel dispatch/readback jitter: measured rep-to-rep swings on the tunneled
+# chip reach tens of ms, so a long-minus-short difference below this is
+# indistinguishable from noise and must not be trusted (a garbage ~0 diff
+# would otherwise *win* an autotune sweep).
+NOISE_FLOOR_S = 50e-3
+
+
+def bench_device_time(
+    step: Callable,
+    args: Sequence[jax.Array],
+    *,
+    chain: Callable | None = None,
+    iters: int = 256,
+    base: int = 64,
+    reps: int = 5,
+    max_iters: int = 16384,
+) -> float:
+    """Per-iteration device seconds of ``step(*args)``.
+
+    ``chain(out, args) -> args`` threads step N's output into step N+1's
+    inputs (default: replace ``args[0]`` with ``clip(out, -1, 1)``, which fits
+    self-shaped ops like square GEMMs and attention; the clip keeps chained
+    values finite). Pass a custom ``chain`` when shapes differ.
+
+    If the long-minus-short difference is below the noise floor the chain
+    length escalates (up to ``max_iters``); a measurement that never clears
+    the floor returns +inf so autotune sweeps can never pick it.
+    """
+    if chain is None:
+        chain = lambda out, a: (jnp.clip(out, -1, 1).astype(a[0].dtype),) + tuple(a[1:])
+
+    def make(n):
+        @jax.jit
+        def run(*xs):
+            def body(_, carry):
+                out = step(*carry)
+                return tuple(chain(out, carry))
+
+            final = jax.lax.fori_loop(0, n, body, tuple(xs))
+            return final[0].astype(jnp.float32).sum()
+
+        return run
+
+    short = make(base)
+    float(short(*args))  # compile + warm once; base never changes
+    while True:
+        long_ = make(base + iters)
+        float(long_(*args))
+        # PAIRED differences, alternating measurement order, median-combined:
+        # the tunneled chip's speed drifts on ~seconds timescales (shared
+        # tenancy), so min-of-short vs min-of-long taken at different moments
+        # can produce faster-than-peak garbage. A same-moment pair cancels
+        # the drift; the median rejects outlier pairs.
+        diffs = []
+        for r in range(reps):
+            if r % 2 == 0:
+                t_l = _walltime(lambda: float(long_(*args)))
+                t_s = _walltime(lambda: float(short(*args)))
+            else:
+                t_s = _walltime(lambda: float(short(*args)))
+                t_l = _walltime(lambda: float(long_(*args)))
+            diffs.append(t_l - t_s)
+        diffs.sort()
+        diff = diffs[len(diffs) // 2]
+        if diff > NOISE_FLOOR_S:
+            return diff / iters
+        if iters >= max_iters:
+            # Even at the longest chain the diff never cleared the floor —
+            # jitter, not signal. +inf keeps autotune from ever picking it.
+            return float("inf")
+        iters *= 4

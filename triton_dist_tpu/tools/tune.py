@@ -1,0 +1,140 @@
+"""Contextual autotuner + persistent tune cache.
+
+Reference: ``python/triton_dist/autotuner.py:43-250`` (whole-op contextual
+timing, failures scored +inf) and ``tune.py:175-255`` (JSON cache keyed by
+shapes/dtypes + hardware fingerprint). See package docstring for the TPU
+redesign (offline tuning, cache consulted at trace time).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import pathlib
+from typing import Any, Callable, Sequence
+
+from triton_dist_tpu.tools.timing import bench_device_time
+from triton_dist_tpu.version import __version__
+
+_CACHE_ENV = "TDT_TUNE_CACHE"
+_DEFAULT_DIR = pathlib.Path(__file__).parent / "tuned"
+
+
+def device_fingerprint() -> str:
+    """Hardware key for cache entries (reference fingerprints git/deps/hw)."""
+    import jax
+
+    d = jax.devices()[0]
+    kind = getattr(d, "device_kind", d.platform)
+    return kind.lower().replace(" ", "_")
+
+
+def _cache_path() -> pathlib.Path:
+    if _CACHE_ENV in os.environ:
+        return pathlib.Path(os.environ[_CACHE_ENV])
+    return _DEFAULT_DIR / f"{device_fingerprint()}.json"
+
+
+class TuneCache:
+    """JSON-file cache: {key: {"cfg": {...}, "time_s": t, "version": v}}."""
+
+    def __init__(self, path: str | os.PathLike | None = None):
+        self.path = pathlib.Path(path) if path is not None else _cache_path()
+        self._data: dict[str, Any] = {}
+        if self.path.exists():
+            try:
+                self._data = json.loads(self.path.read_text())
+            except (json.JSONDecodeError, OSError):
+                self._data = {}
+
+    def get(self, key: str) -> dict | None:
+        return self._data.get(key)
+
+    def put(self, key: str, value: dict) -> None:
+        self._data[key] = value
+
+    def save(self) -> None:
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        self.path.write_text(json.dumps(self._data, indent=1, sort_keys=True))
+
+
+_default_cache: TuneCache | None = None
+
+
+def default_cache() -> TuneCache:
+    global _default_cache
+    if _default_cache is None or _default_cache.path != _cache_path():
+        _default_cache = TuneCache()
+    return _default_cache
+
+
+def arg_signature(args: Sequence) -> str:
+    parts = []
+    for a in args:
+        shape = getattr(a, "shape", ())
+        dtype = getattr(a, "dtype", type(a).__name__)
+        parts.append(f"{'x'.join(map(str, shape))}:{dtype}")
+    return ",".join(parts)
+
+
+def _as_dict(cfg) -> dict:
+    return dataclasses.asdict(cfg) if dataclasses.is_dataclass(cfg) else dict(cfg)
+
+
+def lookup(op_name: str, args: Sequence, cache: TuneCache | None = None) -> dict | None:
+    """Trace-time cache read: the tuned config dict for ``op|args`` on this
+    device, or None. Call from op wrappers to pick static configs under jit."""
+    cache = cache or default_cache()
+    hit = cache.get(f"{op_name}|{arg_signature(args)}")
+    return dict(hit["cfg"]) if hit else None
+
+
+def autotune(
+    op_name: str,
+    candidates: Sequence,
+    build: Callable[[Any], Callable],
+    args: Sequence,
+    *,
+    cache: TuneCache | None = None,
+    use_cache: bool = True,
+    chain: Callable | None = None,
+    iters: int = 32,
+    reps: int = 3,
+    verbose: bool = False,
+):
+    """Pick the fastest candidate config for ``build(cfg)(*args)``.
+
+    Times each candidate whole-op on the device (collective ops included —
+    single-controller wall time is the collective time); a candidate that
+    raises scores +inf, matching the reference autotuner's error handling.
+    Returns ``(best_candidate, best_time_s)`` and persists the winner.
+    """
+    cache = cache or default_cache()
+    key = f"{op_name}|{arg_signature(args)}"
+    if use_cache:
+        hit = cache.get(key)
+        if hit is not None:
+            want = hit["cfg"]
+            for c in candidates:
+                if _as_dict(c) == want:
+                    return c, hit["time_s"]
+            # cfg no longer in the candidate space → re-tune below
+
+    best, best_t = None, float("inf")
+    for c in candidates:
+        try:
+            t = bench_device_time(build(c), args, chain=chain, iters=iters, reps=reps)
+        except Exception as e:  # noqa: BLE001 — bad tile config → skip, like ref
+            if verbose:
+                print(f"[tune] {op_name} {c}: FAIL {type(e).__name__}: {e}")
+            continue
+        if verbose:
+            print(f"[tune] {op_name} {c}: {t * 1e6:.1f} us")
+        if t < best_t:
+            best, best_t = c, t
+    if best is None:
+        raise RuntimeError(f"autotune({op_name}): every candidate failed")
+    cache.put(key, {"cfg": _as_dict(best), "time_s": best_t, "version": __version__})
+    cache.save()
+    return best, best_t

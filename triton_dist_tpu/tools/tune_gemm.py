@@ -1,0 +1,52 @@
+"""Offline GEMM tuner CLI (reference ``tools/tune/tune_gemm.py``).
+
+Sweeps the GEMM config space on the current device for the given shapes and
+persists winners in the device's tune cache, which ``gemm_config_for`` then
+reads at trace time:
+
+    python -m triton_dist_tpu.tools.tune_gemm --mkn 4096 4096 4096 --dtype bfloat16
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+
+from triton_dist_tpu.kernels.gemm import GemmConfig, gemm, get_config_space
+from triton_dist_tpu.tools.tune import autotune, default_cache
+
+
+def tune_square_gemm(size: int, dtype, *, verbose: bool = True):
+    key = jax.random.PRNGKey(0)
+    a = jax.random.normal(key, (size, size), jnp.float32).astype(dtype)
+    b = jax.random.normal(key, (size, size), jnp.float32).astype(dtype)
+    space = [c for c in get_config_space(max_m=size) if size % c.block_k == 0 and size % c.block_n == 0]
+    best, t = autotune(
+        "gemm",
+        space,
+        lambda cfg: (lambda x, y: gemm(x, y, config=cfg)),
+        (a, b),
+        verbose=verbose,
+    )
+    tflops = 2.0 * size**3 / t / 1e12
+    if verbose:
+        print(f"[tune_gemm] {size}^3 {jnp.dtype(dtype).name}: best {best} {tflops:.1f} TFLOP/s")
+    return best, t
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--mkn", type=int, nargs="+", default=[2048, 4096, 8192])
+    p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("-q", "--quiet", action="store_true")
+    args = p.parse_args()
+    dtype = jnp.dtype(args.dtype)
+    for s in args.mkn:
+        tune_square_gemm(s, dtype, verbose=not args.quiet)
+    print(f"cache: {default_cache().path}")
+
+
+if __name__ == "__main__":
+    main()

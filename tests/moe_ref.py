@@ -1,0 +1,49 @@
+"""Shared dense-loop MoE references for tests (single source of truth).
+
+Mirrors the reference tests' torch-eager comparisons
+(``test/nvidia/test_tp_moe.py``): a per-token python loop in float32.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from triton_dist_tpu.kernels.moe_utils import (
+    capacity_for,
+    make_routing_plan,
+    topk_routing,
+)
+
+
+def moe_dense_ref(x, wr, wg, wu, wd, k, keep=None):
+    """out[t] = Σ_k w[t,k] · (silu(x@wg_e) * (x@wu_e)) @ wd_e, e = idx[t,k].
+
+    ``keep`` (T, K) bool optionally zeroes dropped assignments (capacity)."""
+    t, d = np.asarray(x).shape
+    idx, w = topk_routing(jnp.dot(jnp.asarray(x), jnp.asarray(wr)), k)
+    ref = np.zeros((t, d), np.float32)
+    for ti in range(t):
+        for ki in range(k):
+            if keep is not None and not bool(keep[ti, ki]):
+                continue
+            ei = int(idx[ti, ki])
+            g = np.asarray(x[ti]) @ np.asarray(wg[ei])
+            u = np.asarray(x[ti]) @ np.asarray(wu[ei])
+            act = (g / (1 + np.exp(-g))) * u
+            ref[ti] += float(w[ti, ki]) * (act @ np.asarray(wd[ei]))
+    return ref
+
+
+def chunk_local_keep(x, wr, k, world, capacity_factor):
+    """The keep mask under GShard-style per-chunk capacity: tokens split into
+    ``world`` chunks, each routed with capacity_for(T/world)."""
+    t = np.asarray(x).shape[0]
+    e = np.asarray(wr).shape[1]
+    tc = t // world
+    idx, _ = topk_routing(jnp.dot(jnp.asarray(x), jnp.asarray(wr)), k)
+    cap = capacity_for(tc, k, e, capacity_factor)
+    keeps = []
+    for c in range(world):
+        plan = make_routing_plan(idx[c * tc : (c + 1) * tc], e, cap)
+        keeps.append(np.asarray(plan.keep))
+    return np.concatenate(keeps, axis=0)

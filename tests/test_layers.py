@@ -156,19 +156,9 @@ def test_tp_moe_vs_dense(ctx4, rng):
         )(x, wr, wg, wu, wd)
     )
 
-    # Dense reference
-    from triton_dist_tpu.kernels.moe_utils import topk_routing
+    from moe_ref import moe_dense_ref
 
-    idx, w = topk_routing(jnp.dot(x, wr), k)
-    ref = np.zeros((t, d), np.float32)
-    for ti in range(t):
-        for ki in range(k):
-            ei = int(idx[ti, ki])
-            h = np.asarray(x[ti]) @ np.asarray(wg[ei])
-            u = np.asarray(x[ti]) @ np.asarray(wu[ei])
-            act = (h / (1 + np.exp(-h))) * u
-            ref[ti] += float(w[ti, ki]) * (act @ np.asarray(wd[ei]))
-    np.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(out, moe_dense_ref(x, wr, wg, wu, wd, k), rtol=1e-3, atol=1e-3)
 
 
 def test_ep_moe_vs_dense(ctx4, rng):

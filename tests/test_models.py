@@ -49,7 +49,10 @@ def test_moe_model_runs(dense_model):
     model = Qwen3MoE(cfg, ctx, key=jax.random.PRNGKey(2))
     eng_x = Engine(model, backend="xla", max_len=16)
     eng_d = Engine(model, backend="dist_ar", max_len=16)
+    eng_s = Engine(model, backend="dist", max_len=16)  # seq-sharded MoE rings
     ids = jnp.asarray([[5, 9, 13, 2]], jnp.int32)
     out_x = np.asarray(eng_x.serve(ids, gen_len=4))
     out_d = np.asarray(eng_d.serve(ids, gen_len=4))
+    out_s = np.asarray(eng_s.serve(ids, gen_len=4))
     np.testing.assert_array_equal(out_d, out_x)
+    np.testing.assert_array_equal(out_s, out_x)

@@ -115,30 +115,43 @@ def _resolve_method(
 # ------------------------------------------------------------------- xla ring
 
 
-def _ag_gemm_xla_ring(a, b, *, axis, accum_dtype=jnp.float32, return_gathered=False):
+def ring_ag_chunks(x: jax.Array, axis: str):
+    """Yield the ``world`` shards of ``all_gather(x)`` one ring step at a
+    time: step ``s`` yields rank ``(me - s) % world``'s chunk, with the
+    ``ppermute`` for step ``s+1`` already issued — unrolled callers get
+    per-chunk compute that hides each hop (the collective-matmul ring shared
+    by AG-GEMM, AG-swiglu, and AG-MoE)."""
+    world = jax.lax.axis_size(axis)
+    perm = [(i, (i + 1) % world) for i in range(world)]
+    x_cur = x
+    for s in range(world):
+        yield x_cur
+        if s + 1 < world:
+            x_cur = jax.lax.ppermute(x_cur, axis, perm)
+
+
+def ring_ag_concat(parts: list[jax.Array], axis: str) -> jax.Array:
+    """Reassemble per-step ring results into gather order: ``parts[s]``
+    belongs to rank ``(me - s) % world``; returns the (world·m, n) stack."""
     world = jax.lax.axis_size(axis)
     me = jax.lax.axis_index(axis)
-    m, _ = a.shape
-    n = b.shape[1]
+    m, n = parts[0].shape
+    order = jnp.mod(me - jnp.arange(world), world)
+    out = jnp.zeros((world, m, n), parts[0].dtype).at[order].set(jnp.stack(parts))
+    return out.reshape(world * m, n)
 
+
+def _ag_gemm_xla_ring(a, b, *, axis, accum_dtype=jnp.float32, return_gathered=False):
     parts = []
     chunks = []
-    a_cur = a
-    perm = [(i, (i + 1) % world) for i in range(world)]
-    for s in range(world):  # static unroll: maximum scheduling freedom
+    for a_cur in ring_ag_chunks(a, axis):  # static unroll: max scheduling freedom
         parts.append(jnp.dot(a_cur, b, preferred_element_type=accum_dtype).astype(a.dtype))
         if return_gathered:
             chunks.append(a_cur)
-        if s + 1 < world:
-            a_cur = jax.lax.ppermute(a_cur, axis, perm)
 
-    # parts[s] is the product with rank (me - s) % world's shard.
-    order = jnp.mod(me - jnp.arange(world), world)
-    out = jnp.zeros((world, m, n), a.dtype).at[order].set(jnp.stack(parts))
-    out = out.reshape(world * m, n)
+    out = ring_ag_concat(parts, axis)
     if return_gathered:
-        ag = jnp.zeros((world, m, a.shape[1]), a.dtype).at[order].set(jnp.stack(chunks))
-        return out, ag.reshape(world * m, a.shape[1])
+        return out, ring_ag_concat(chunks, axis)
     return out
 
 
@@ -314,6 +327,32 @@ def _ag_gemm_pallas(a, b, *, axis, mesh_axes, config=None):
         ),
     )(order, a, b)
     return out, a_buf.reshape(world * m, k)
+
+
+def ag_gemm_swiglu_shard(
+    x: jax.Array,  # (m_shard, k) — A row-shard of this rank
+    w_gate: jax.Array,  # (k, n_shard) — gate column-shard
+    w_up: jax.Array,  # (k, n_shard) — up column-shard
+    *,
+    axis: str = "tp",
+) -> jax.Array:
+    """Fused AllGather → gate/up GEMMs → SwiGLU in one overlapped ring:
+    ``silu(AG(x) @ w_gate) * (AG(x) @ w_up)`` → (world·m, n_shard).
+
+    The TP-MLP gate+up pair shares one AG pass — both chunk-GEMMs of step
+    ``s`` hide the ``ppermute`` bringing chunk ``s+1``, and the SwiGLU runs
+    on the fp32 accumulators (reference ``TP_MLP`` gate_up AG-GEMM + fused
+    swiglu, ``layers/nvidia/tp_mlp.py:143-204``)."""
+
+    def chunk_swiglu(xc):
+        g = jnp.dot(xc, w_gate, preferred_element_type=jnp.float32)
+        u = jnp.dot(xc, w_up, preferred_element_type=jnp.float32)
+        return (jax.nn.silu(g) * u).astype(x.dtype)
+
+    if jax.lax.axis_size(axis) == 1:
+        return chunk_swiglu(x)
+    parts = [chunk_swiglu(xc) for xc in ring_ag_chunks(x, axis)]
+    return ring_ag_concat(parts, axis)
 
 
 # ----------------------------------------------------------------- public API

@@ -65,10 +65,11 @@ def group_gemm_swiglu(
 
     Reference: the ag-moe grouped GEMM feeding swiglu
     (``group_gemm.py`` + ``swiglu.py``); one Pallas pass here."""
+    from triton_dist_tpu.kernels.gemm import fit_block
+
     e, c, d = x.shape
     _, _, f = w_gate.shape
-    bc, bf, bk = min(block_c, c), min(block_f, f), min(block_k, d)
-    assert c % bc == 0 and f % bf == 0 and d % bk == 0, (x.shape, w_gate.shape)
+    bc, bf, bk = fit_block(c, block_c), fit_block(f, block_f), fit_block(d, block_k)
     n_k = d // bk
 
     return pl.pallas_call(

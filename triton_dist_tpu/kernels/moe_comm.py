@@ -1,0 +1,194 @@
+"""Overlapped TP-MoE communication kernels: AG-MoE and MoE-reduce-RS/AR.
+
+Reference: ``python/triton_dist/kernels/nvidia/allgather_group_gemm.py`` (996
+LoC — AllGather overlapped into the grouped gate/up GEMM via tile-rank
+swizzle), ``moe_reduce_rs.py`` (961 — grouped down-projection GEMM whose
+output tiles feed the ReduceScatter ring), ``moe_reduce_ar.py`` (692 — same
+with AllReduce for the replicated decode regime). TPU redesign — two ring
+phases, both unrolled so XLA's latency-hiding scheduler overlaps every
+``ppermute`` with the neighbouring chunk's MXU work (the same
+collective-matmul decomposition as ``ag_gemm_shard`` / ``gemm_rs_shard``):
+
+* **AG-MoE ring** (``ag_moe_gate_up_shard``): the seq-sharded token chunk
+  travels the ring; at each step the chunk in hand is routed (top-k →
+  static-capacity plan), dispatched, and pushed through the **fused
+  gate/up + SwiGLU grouped GEMM** — compute on chunk ``s`` hides the
+  ``ppermute`` bringing chunk ``s+1``, the XLA analog of the reference's
+  rank-swizzled tile schedule (``allgather_group_gemm.py``).
+* **MoE-RS ring** (``moe_reduce_rs_shard``): the fp32 token-partial chunk
+  travels the ring while each step runs that chunk's down-projection grouped
+  GEMM + weighted combine; after ``world`` steps every rank holds its own
+  fully tp-reduced chunk (``moe_reduce_rs.py`` per-tile scatter signals →
+  ring schedule here).
+
+Because the expert ff dimension is tp-sharded, every rank runs every chunk's
+grouped GEMMs on its ff slab — per-rank FLOPs are 1/world of the total, with
+zero replicated expert compute and only (Tc, d)-sized wires.
+
+Routing is **per chunk** (capacity = f(T/world)), so capacity-overflow drops
+are decided chunk-locally; tests compare against a chunk-local dense
+reference.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from triton_dist_tpu.kernels.moe_utils import (
+    RoutingPlan,
+    capacity_for,
+    combine,
+    dispatch,
+    make_routing_plan,
+    topk_routing,
+)
+from triton_dist_tpu.kernels.group_gemm import group_gemm, group_gemm_swiglu
+from triton_dist_tpu.kernels.allgather_gemm import ring_ag_chunks
+
+
+def _chunk_gate_up(x_chunk, w_router, w_gate, w_up, *, top_k, capacity_factor,
+                   use_fused_swiglu):
+    """Route one token chunk and run the gate/up grouped GEMM + SwiGLU.
+
+    Returns (plan, combine_weights, h) with h: (E, C, ff_local)."""
+    tc = x_chunk.shape[0]
+    e = w_router.shape[1]
+    logits = jnp.dot(x_chunk, w_router, preferred_element_type=jnp.float32)
+    idx, w = topk_routing(logits, top_k)
+    cap = capacity_for(tc, top_k, e, capacity_factor)
+    plan = make_routing_plan(idx, e, cap)
+    xe = dispatch(x_chunk, plan)  # (E, C, d)
+    if use_fused_swiglu:
+        h = group_gemm_swiglu(xe, w_gate, w_up)
+    else:
+        h = (
+            jax.nn.silu(group_gemm(xe, w_gate).astype(jnp.float32))
+            * group_gemm(xe, w_up).astype(jnp.float32)
+        ).astype(x_chunk.dtype)
+    return plan, w, h
+
+
+def ag_moe_gate_up_shard(
+    x: jax.Array,  # (Tc, d) — this rank's seq-shard of the tokens
+    w_router: jax.Array,  # (d, E) replicated
+    w_gate: jax.Array,  # (E, d, ff_local) — expert ff tp-shard
+    w_up: jax.Array,  # (E, d, ff_local)
+    *,
+    top_k: int,
+    capacity_factor: float = 2.0,
+    axis: str = "tp",
+    use_fused_swiglu: bool = True,
+) -> list[tuple[RoutingPlan, jax.Array, jax.Array]]:
+    """Ring-AG of token chunks overlapped with per-chunk routing + gate/up.
+
+    Returns ``states`` with ``states[s]`` = (plan, weights, h) of chunk
+    ``(me - s) % world`` — step 0 is the local chunk (rank-swizzle for free).
+    Reference ``allgather_group_gemm.py`` (tile-rank swizzled consumer).
+    """
+    return [
+        _chunk_gate_up(
+            x_cur, w_router, w_gate, w_up,
+            top_k=top_k, capacity_factor=capacity_factor,
+            use_fused_swiglu=use_fused_swiglu,
+        )
+        for x_cur in ring_ag_chunks(x, axis)  # unrolled: GEMM s hides hop s+1
+    ]
+
+
+def _chunk_down_combine(state, w_down):
+    """Down-projection grouped GEMM + fp32 weighted combine for one chunk."""
+    plan, w, h = state
+    y = group_gemm(h, w_down)  # (E, C, d) — partial over tp (ff shard)
+    return combine(y, plan, w, plan.slot.shape[0], out_dtype=jnp.float32)
+
+
+def moe_reduce_rs_shard(
+    states: list[tuple[RoutingPlan, jax.Array, jax.Array]],
+    w_down: jax.Array,  # (E, ff_local, d)
+    *,
+    axis: str = "tp",
+    out_dtype=None,
+) -> jax.Array:
+    """Ring reduce-scatter overlapped with the per-chunk down grouped GEMM.
+
+    ``states`` as produced by :func:`ag_moe_gate_up_shard` (states[s] holds
+    chunk ``(me - s) % world``). The fp32 partial chunk travels the ring: the
+    RS schedule needs chunk ``(me - 1 - t) % world`` at step ``t``, i.e.
+    ``states[t + 1]`` — every index is static. After ``world`` steps this
+    rank holds its **own** chunk fully reduced over tp. Reference
+    ``moe_reduce_rs.py`` (grouped GEMM feeding the RS ring per tile).
+    """
+    world = jax.lax.axis_size(axis)
+    dtype = out_dtype or states[0][2].dtype
+    if world == 1:
+        return _chunk_down_combine(states[0], w_down).astype(dtype)
+    perm = [(i, (i + 1) % world) for i in range(world)]
+    acc = _chunk_down_combine(states[1], w_down)  # chunk me-1
+    for t in range(world - 1):
+        acc = jax.lax.ppermute(acc, axis, perm)
+        acc = acc + _chunk_down_combine(states[(t + 2) % world], w_down)
+    return acc.astype(dtype)  # chunk me, tp-reduced
+
+
+def tp_moe_rs_shard(
+    x: jax.Array,  # (Tc, d) seq-sharded tokens
+    w_router: jax.Array,
+    w_gate: jax.Array,
+    w_up: jax.Array,
+    w_down: jax.Array,
+    *,
+    top_k: int,
+    capacity_factor: float = 2.0,
+    axis: str = "tp",
+    use_fused_swiglu: bool = True,
+) -> jax.Array:
+    """Fully overlapped TP-MoE for the seq-sharded ("dist") regime:
+    AG-MoE ring → MoE-RS ring. Returns this rank's (Tc, d) output chunk."""
+    states = ag_moe_gate_up_shard(
+        x, w_router, w_gate, w_up,
+        top_k=top_k, capacity_factor=capacity_factor, axis=axis,
+        use_fused_swiglu=use_fused_swiglu,
+    )
+    return moe_reduce_rs_shard(states, w_down, axis=axis, out_dtype=x.dtype)
+
+
+def tp_moe_ar_shard(
+    x: jax.Array,  # (T, d) replicated tokens
+    w_router: jax.Array,
+    w_gate: jax.Array,
+    w_up: jax.Array,
+    w_down: jax.Array,
+    *,
+    top_k: int,
+    capacity_factor: float = 2.0,
+    axis: str = "tp",
+    use_fused_swiglu: bool = True,
+) -> jax.Array:
+    """Overlapped TP-MoE for the replicated ("dist_ar" decode) regime.
+
+    No AG phase is needed — the input is replicated, so each rank slices the
+    chunk the RS schedule asks for directly (``states[s]`` = chunk
+    ``(me - s) % world``), runs the ring-RS overlapped with the down GEMMs,
+    and a final all-gather rebuilds the replicated output (two-shot AR, the
+    RS leg fully hidden behind grouped-GEMM compute). Reference
+    ``moe_reduce_ar.py``. Requires ``T % world == 0``; callers fall back to
+    the unchunked path otherwise."""
+    world = jax.lax.axis_size(axis)
+    me = jax.lax.axis_index(axis)
+    t, d = x.shape
+    assert t % world == 0, (t, world)
+    chunk = t // world
+    states = []
+    for s in range(world):
+        c = jnp.mod(me - s, world)
+        x_chunk = jax.lax.dynamic_slice(x, (c * chunk, 0), (chunk, d))
+        states.append(
+            _chunk_gate_up(
+                x_chunk, w_router, w_gate, w_up,
+                top_k=top_k, capacity_factor=capacity_factor,
+                use_fused_swiglu=use_fused_swiglu,
+            )
+        )
+    out_chunk = moe_reduce_rs_shard(states, w_down, axis=axis, out_dtype=x.dtype)
+    return jax.lax.all_gather(out_chunk, axis, tiled=True)

@@ -87,14 +87,16 @@ def combine(
     plan: RoutingPlan,
     weights: jax.Array,  # (T, K) combine weights (gating probs)
     num_tokens: int,
+    out_dtype=None,
 ) -> jax.Array:
     """Weighted gather back to token order: out[t] = Σ_k w[t,k]·y[slot[t,k]]
-    (dropped assignments contribute zero)."""
+    (dropped assignments contribute zero). ``out_dtype=jnp.float32`` keeps the
+    fp32 accumulation on the wire (ring-RS partial sums)."""
     flat = y.reshape(-1, y.shape[-1])  # (E*C, d)
     gathered = flat[plan.slot.reshape(-1)]  # (T*K, d)
     w = (weights * plan.keep).reshape(-1, 1).astype(jnp.float32)
     out = (gathered.astype(jnp.float32) * w).reshape(num_tokens, -1, y.shape[-1]).sum(axis=1)
-    return out.astype(y.dtype)
+    return out.astype(out_dtype or y.dtype)
 
 
 def topk_routing(logits: jax.Array, k: int, *, renormalize: bool = True):

@@ -53,10 +53,7 @@ class EP_MoE:
             use_pallas=self.use_pallas_a2a,
         )
         xe = disp.expert_inputs  # (E_local, world*C, d)
-        h = (
-            jax.nn.silu(group_gemm(xe, self.w_gate).astype(jnp.float32))
-            * group_gemm(xe, self.w_up).astype(jnp.float32)
-        ).astype(x.dtype)
+        h = group_gemm_swiglu(xe, self.w_gate, self.w_up)
         y = group_gemm(h, self.w_down)
         return ep_combine_shard(
             y, disp, w, axis=self.axis, mesh_axes=self.mesh_axes,

@@ -23,7 +23,11 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-from triton_dist_tpu.kernels.allgather_gemm import ag_gemm_shard, AGGemmMethod
+from triton_dist_tpu.kernels.allgather_gemm import (
+    ag_gemm_shard,
+    ag_gemm_swiglu_shard,
+    AGGemmMethod,
+)
 from triton_dist_tpu.kernels.gemm_reduce_scatter import gemm_rs_shard, GemmRSMethod
 from triton_dist_tpu.kernels.gemm_allreduce import gemm_ar_shard, GemmARMethod
 from triton_dist_tpu.kernels.flash_attn import flash_attention
@@ -100,10 +104,10 @@ class TP_MLP:
             out = jnp.dot(h, self.w_down, preferred_element_type=jnp.float32)
             return jax.lax.psum(out, axis).astype(x.dtype)
         if mode == "dist":
-            # AG-GEMM up/gate (x seq-sharded), swiglu, GEMM-RS down.
-            g, xg = ag_gemm_shard(x, self.w_gate, axis=axis, mesh_axes=self.mesh_axes, return_gathered=True)
-            u = jnp.dot(xg, self.w_up, preferred_element_type=jnp.float32).astype(x.dtype)
-            h = (jax.nn.silu(g.astype(jnp.float32)) * u.astype(jnp.float32)).astype(x.dtype)
+            # One AG pass feeding BOTH gate and up chunk-GEMMs with a fused
+            # SwiGLU (x seq-sharded), then GEMM-RS down — no unoverlapped
+            # matmul anywhere in the MLP.
+            h = ag_gemm_swiglu_shard(x, self.w_gate, self.w_up, axis=axis)
             return gemm_rs_shard(h, self.w_down, axis=axis, mesh_axes=self.mesh_axes)
         if mode == "dist_ar":
             g = jnp.dot(x, self.w_gate, preferred_element_type=jnp.float32)
@@ -215,22 +219,67 @@ class TP_MoE:
     axis: str = static_field(default="tp")
     mesh_axes: tuple | None = static_field(default=None)
 
-    def __call__(self, x: jax.Array, mode: str = "dist") -> jax.Array:
-        """x: (T, d) replicated tokens → (T, d) replicated output."""
+    def __call__(self, x: jax.Array, mode: str = "dist_ar") -> jax.Array:
+        """Modes (matching the reference ag-moe / moe-rs / moe-ar contexts):
+
+        * ``xla`` — x (T, d) replicated → (T, d) replicated; plain grouped
+          GEMMs + psum (compiler-collective baseline).
+        * ``dist_ar`` — x (T, d) replicated → (T, d) replicated; chunked
+          ring-RS overlapped with the down grouped GEMMs + final AG
+          (``moe_reduce_ar`` analog). Falls back to grouped-GEMM + one-sided
+          AR when T isn't divisible by world.
+        * ``dist`` — x (Tc, d) **seq-sharded** → (Tc, d) seq-sharded; the
+          fully overlapped AG-MoE → MoE-RS ring pair
+          (``allgather_group_gemm`` + ``moe_reduce_rs`` analog).
+
+        Capacity semantics: the chunked ring paths apply the capacity limit
+        **per token chunk** (GShard/Switch-style per-group capacity — the
+        idiomatic TPU MoE contract), so under capacity pressure they drop
+        different tokens than the global-capacity ``xla``/fallback paths.
+        With ample capacity (no drops) all modes agree exactly.
+        """
+        from triton_dist_tpu.kernels.moe_comm import tp_moe_ar_shard, tp_moe_rs_shard
+
+        world = jax.lax.axis_size(self.axis)
         t, d = x.shape
+        if mode == "dist":
+            return tp_moe_rs_shard(
+                x, self.w_router, self.w_gate, self.w_up, self.w_down,
+                top_k=self.top_k, capacity_factor=self.capacity_factor,
+                axis=self.axis,
+            )
+        # Chunked AR only when per-chunk tokens are large enough that the
+        # align-8 capacity padding doesn't multiply the grouped-GEMM work
+        # (small-T decode stays on the unchunked grouped-GEMM + AR path).
+        if mode == "dist_ar" and t % world == 0 and t // world >= 8:
+            return tp_moe_ar_shard(
+                x, self.w_router, self.w_gate, self.w_up, self.w_down,
+                top_k=self.top_k, capacity_factor=self.capacity_factor,
+                axis=self.axis,
+            )
+
         e = self.w_router.shape[1]
         logits = jnp.dot(x, self.w_router, preferred_element_type=jnp.float32)
         idx, w = topk_routing(logits, self.top_k)
         cap = capacity_for(t, self.top_k, e, self.capacity_factor)
         plan = make_routing_plan(idx, e, cap)
         xe = dispatch(x, plan)  # (E, C, d)
-        g = group_gemm(xe, self.w_gate)
-        u = group_gemm(xe, self.w_up)
-        h = (jax.nn.silu(g.astype(jnp.float32)) * u.astype(jnp.float32)).astype(x.dtype)
-        y = group_gemm(h, self.w_down)  # (E, C, d) partial over tp (ff shard)
-        out = combine(y, plan, w, t)
+        from triton_dist_tpu.kernels.group_gemm import group_gemm_swiglu
+
         if mode == "xla":
-            return jax.lax.psum(out.astype(jnp.float32), self.axis).astype(x.dtype)
+            g = group_gemm(xe, self.w_gate)
+            u = group_gemm(xe, self.w_up)
+            h = (jax.nn.silu(g.astype(jnp.float32)) * u.astype(jnp.float32)).astype(x.dtype)
+        else:
+            h = group_gemm_swiglu(xe, self.w_gate, self.w_up)
+        y = group_gemm(h, self.w_down)  # (E, C, d) partial over tp (ff shard)
+        # fp32 partials on the wire in every mode: bf16-rounded per-rank
+        # partials would make dist_ar diverge from the fp32 psum baseline.
+        out = combine(y, plan, w, t, out_dtype=jnp.float32)
+        if mode == "xla":
+            return jax.lax.psum(out, self.axis).astype(x.dtype)
         from triton_dist_tpu.kernels.allreduce import all_reduce_shard, AllReduceMethod
 
-        return all_reduce_shard(out, axis=self.axis, mesh_axes=self.mesh_axes, method=AllReduceMethod.AUTO)
+        return all_reduce_shard(
+            out, axis=self.axis, mesh_axes=self.mesh_axes, method=AllReduceMethod.AUTO
+        ).astype(x.dtype)

@@ -193,20 +193,14 @@ class DenseLLM:
             a, (k, v) = attn.prefill(h, pos, mode=mode, bsz=bsz)
             x = x + a
             h = RMSNorm(weight=lp["ln2"], eps=eps)(x)
-            if c.is_moe:
-                # TP-MoE shards the expert ff dim: every rank must see the
-                # same tokens. Under seq-sharded "dist" flow, gather → MoE →
-                # take my chunk back (reference runs MoE on the gathered
-                # activations too, tp_moe.py ag_moe path).
-                if mode == "dist":
-                    h_full = jax.lax.all_gather(h, self.axis, tiled=True)
-                    m_full = self._mlp(lp)(h_full, mode="dist_ar")
-                    chunk = h.shape[0]
-                    m = jax.lax.dynamic_slice(
-                        m_full, (me * chunk, 0), (chunk, m_full.shape[1])
-                    )
-                else:
-                    m = self._mlp(lp)(h, mode="xla" if mode == "xla" else "dist_ar")
+            if c.is_moe and mode == "dist":
+                # Seq-sharded MoE: the AG-MoE → MoE-RS ring pair gathers
+                # chunks into the gate/up grouped GEMMs and reduce-scatters
+                # the down partials — no replicated compute, no full-T AR
+                # (reference ag_moe + moe_rs contexts, tp_moe.py).
+                m = self._mlp(lp)(h, mode="dist")
+            elif c.is_moe:
+                m = self._mlp(lp)(h, mode="xla" if mode == "xla" else "dist_ar")
             else:
                 m = self._mlp(lp)(h, mode=mode)
             return x + m, (k, v)

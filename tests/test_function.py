@@ -1,0 +1,182 @@
+"""Training autograd: custom_vjp collective matmuls + fused EP MoE fwd/bwd.
+
+Parity model: reference ``function/nvidia/ep_moe_fused.py`` bwd correctness;
+here each VJP is checked against ``jax.grad`` of the pure-XLA composition
+(native autodiff through ``all_gather``/``psum_scatter``/``psum``), the
+gold-standard gradient on the same mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from triton_dist_tpu.function import (
+    ag_gemm_fn,
+    gemm_ar_fn,
+    gemm_rs_fn,
+    group_gemm_swiglu_fn,
+    ep_moe_fused_fn,
+)
+
+WORLD = 4
+
+
+def grads_of(ctx, loss_shard, in_specs, args):
+    """grad of sum-over-mesh loss wrt every arg, via shard_map."""
+    f = jax.jit(
+        jax.grad(
+            lambda *a: jax.shard_map(
+                loss_shard, mesh=ctx.mesh, in_specs=in_specs, out_specs=P(),
+                check_vma=False,
+            )(*a)[()],
+            argnums=tuple(range(len(args))),
+        )
+    )
+    return f(*args)
+
+
+def test_ag_gemm_grad(ctx4, rng):
+    m, k, n = 8, 16, 12  # per-shard m, full k, per-shard n
+    x = jnp.asarray(rng.standard_normal((WORLD * m, k)), jnp.float32) * 0.3
+    b = jnp.asarray(rng.standard_normal((k, WORLD * n)), jnp.float32) * 0.3
+    c = jnp.asarray(rng.standard_normal((WORLD * m, WORLD * n)), jnp.float32)
+
+    def loss_dist(x_, b_, c_):
+        out = ag_gemm_fn(x_, b_, "tp")  # (world*m, n_local)
+        return jax.lax.psum(jnp.sum(out * c_), "tp")[None][0].reshape(())
+
+    def loss_ref(x_, b_, c_):
+        ag = jax.lax.all_gather(x_, "tp", tiled=True)
+        out = jnp.dot(ag, b_, preferred_element_type=jnp.float32).astype(x_.dtype)
+        return jax.lax.psum(jnp.sum(out * c_), "tp").reshape(())
+
+    specs = (P("tp"), P(None, "tp"), P(None, "tp"))
+    gx, gb, _ = grads_of(ctx4, loss_dist, specs, (x, b, c))
+    rx, rb, _ = grads_of(ctx4, loss_ref, specs, (x, b, c))
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(rx), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(gb), np.asarray(rb), rtol=1e-4, atol=1e-4)
+
+
+def test_gemm_rs_grad(ctx4, rng):
+    m, k, n = WORLD * 8, 16, 12  # full m (div by world), per-shard k, full n
+    a = jnp.asarray(rng.standard_normal((m, WORLD * k)), jnp.float32) * 0.3
+    b = jnp.asarray(rng.standard_normal((WORLD * k, n)), jnp.float32) * 0.3
+    c = jnp.asarray(rng.standard_normal((m, n)), jnp.float32)
+
+    def loss_dist(a_, b_, c_):
+        out = gemm_rs_fn(a_, b_, "tp")  # (m/world, n)
+        return jax.lax.psum(jnp.sum(out * c_), "tp").reshape(())
+
+    def loss_ref(a_, b_, c_):
+        partial = jnp.dot(a_, b_, preferred_element_type=jnp.float32)
+        out = jax.lax.psum_scatter(partial, "tp", scatter_dimension=0, tiled=True).astype(a_.dtype)
+        return jax.lax.psum(jnp.sum(out * c_), "tp").reshape(())
+
+    specs = (P(None, "tp"), P("tp"), P("tp"))
+    ga, gb, _ = grads_of(ctx4, loss_dist, specs, (a, b, c))
+    ra, rb, _ = grads_of(ctx4, loss_ref, specs, (a, b, c))
+    np.testing.assert_allclose(np.asarray(ga), np.asarray(ra), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(gb), np.asarray(rb), rtol=1e-4, atol=1e-4)
+
+
+def test_gemm_ar_grad(ctx4, rng):
+    m, k, n = 16, 8, 12
+    a = jnp.asarray(rng.standard_normal((m, WORLD * k)), jnp.float32) * 0.3
+    b = jnp.asarray(rng.standard_normal((WORLD * k, n)), jnp.float32) * 0.3
+    c = jnp.asarray(rng.standard_normal((m, n)), jnp.float32)
+
+    def loss_dist(a_, b_, c_):
+        out = gemm_ar_fn(a_, b_, "tp")  # (m, n) replicated
+        return jnp.sum(out * c_).reshape(())
+
+    # Gold standard: single-device full-matmul gradient (the mesh-native
+    # autodiff reference would inherit a spurious world× factor from
+    # check_vma=False psum transposition).
+    def loss_full(a_, b_, c_):
+        return jnp.sum(jnp.dot(a_, b_, preferred_element_type=jnp.float32) * c_)
+
+    specs = (P(None, "tp"), P("tp"), P())
+    ga, gb, _ = grads_of(ctx4, loss_dist, specs, (a, b, c))
+    ra, rb, _ = jax.grad(loss_full, argnums=(0, 1, 2))(a, b, c)
+    np.testing.assert_allclose(np.asarray(ga), np.asarray(ra), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(gb), np.asarray(rb), rtol=1e-4, atol=1e-4)
+
+
+def test_group_gemm_swiglu_grad(rng):
+    e, c, d, f = 4, 16, 24, 32
+    x = jnp.asarray(rng.standard_normal((e, c, d)), jnp.float32) * 0.3
+    wg = jnp.asarray(rng.standard_normal((e, d, f)), jnp.float32) * 0.2
+    wu = jnp.asarray(rng.standard_normal((e, d, f)), jnp.float32) * 0.2
+
+    def loss_fused(x_, wg_, wu_):
+        return jnp.sum(group_gemm_swiglu_fn(x_, wg_, wu_) ** 2)
+
+    def loss_ref(x_, wg_, wu_):
+        dims = (((2,), (1,)), ((0,), (0,)))
+        g = jax.lax.dot_general(x_, wg_, dims, preferred_element_type=jnp.float32)
+        u = jax.lax.dot_general(x_, wu_, dims, preferred_element_type=jnp.float32)
+        return jnp.sum((jax.nn.silu(g) * u).astype(x_.dtype) ** 2)
+
+    got = jax.grad(loss_fused, argnums=(0, 1, 2))(x, wg, wu)
+    ref = jax.grad(loss_ref, argnums=(0, 1, 2))(x, wg, wu)
+    for g_, r_ in zip(got, ref):
+        np.testing.assert_allclose(np.asarray(g_), np.asarray(r_), rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("use_pallas", [False, True])
+def test_ep_moe_fused_grad(ctx8, rng, use_pallas):
+    """EP MoE fwd+bwd on the 8-device mesh: distributed grads match the
+    pure-XLA autodiff composition (router grads included)."""
+    d, ff, e, t, k = 16, 24, 8, 8, 2
+    world = 8
+    x = jnp.asarray(rng.standard_normal((world * t, d)), jnp.float32) * 0.3
+    wr = jnp.asarray(rng.standard_normal((d, e)), jnp.float32)
+    wg = jnp.asarray(rng.standard_normal((e, d, ff)), jnp.float32) * 0.2
+    wu = jnp.asarray(rng.standard_normal((e, d, ff)), jnp.float32) * 0.2
+    wd = jnp.asarray(rng.standard_normal((e, ff, d)), jnp.float32) * 0.2
+
+    def loss_dist(x_, wr_, wg_, wu_, wd_):
+        out = ep_moe_fused_fn(
+            x_, wr_, wg_, wu_, wd_,
+            num_experts=e, top_k=k, capacity_factor=4.0,
+            axis="tp", mesh_axes=("tp",), use_pallas_a2a=use_pallas,
+        )
+        return jax.lax.psum(jnp.sum(out**2), "tp").reshape(())
+
+    def loss_ref(x_, wr_, wg_, wu_, wd_):
+        from triton_dist_tpu.kernels.moe_utils import (
+            capacity_for, combine, dispatch, make_routing_plan, topk_routing,
+        )
+
+        logits = jnp.dot(x_, wr_, preferred_element_type=jnp.float32)
+        idx, w = topk_routing(logits, k)
+        cap = capacity_for(t, k, e, 4.0)
+        plan = make_routing_plan(idx, e, cap)
+        buf = dispatch(x_, plan).reshape(world, (e // world) * cap, d)
+        recv = jax.lax.all_to_all(buf, "tp", split_axis=0, concat_axis=0, tiled=False)
+        xe = recv.reshape(world, e // world, cap, d).transpose(1, 0, 2, 3).reshape(
+            e // world, world * cap, d
+        )
+        dims = (((2,), (1,)), ((0,), (0,)))
+        g = jax.lax.dot_general(xe, wg_, dims, preferred_element_type=jnp.float32)
+        u = jax.lax.dot_general(xe, wu_, dims, preferred_element_type=jnp.float32)
+        h = (jax.nn.silu(g) * u).astype(x_.dtype)
+        y = jax.lax.dot_general(h, wd_, dims, preferred_element_type=jnp.float32).astype(x_.dtype)
+        back = y.reshape(e // world, world, cap, d).transpose(1, 0, 2, 3).reshape(
+            world, (e // world) * cap, d
+        )
+        recv_b = jax.lax.all_to_all(back, "tp", split_axis=0, concat_axis=0, tiled=False)
+        out = combine(recv_b.reshape(e, cap, d), plan, w, t)
+        return jax.lax.psum(jnp.sum(out**2), "tp").reshape(())
+
+    ctx = ctx8
+    specs = (P("tp"), P(), P("tp"), P("tp"), P("tp"))  # expert slabs sharded on dim 0
+    args = (x, wr, wg, wu, wd)
+    got = grads_of(ctx, loss_dist, specs, args)
+    ref = grads_of(ctx, loss_ref, specs, args)
+    for g_, r_, name in zip(got, ref, ["x", "wr", "wg", "wu", "wd"]):
+        np.testing.assert_allclose(
+            np.asarray(g_), np.asarray(r_), rtol=2e-4, atol=2e-4, err_msg=name
+        )

@@ -184,18 +184,10 @@ def test_ep_moe_vs_dense(ctx4, rng):
         )(x, wr, wg, wu, wd)
     )
 
-    from triton_dist_tpu.kernels.moe_utils import topk_routing
+    from moe_ref import moe_dense_ref
 
     for r in range(WORLD):
-        idx, w = topk_routing(jnp.dot(x[r], wr), k)
-        ref = np.zeros((t, d), np.float32)
-        for ti in range(t):
-            for ki in range(k):
-                ei = int(idx[ti, ki])
-                h = np.asarray(x[r, ti]) @ np.asarray(wg[ei])
-                u = np.asarray(x[r, ti]) @ np.asarray(wu[ei])
-                act = (h / (1 + np.exp(-h))) * u
-                ref[ti] += float(w[ti, ki]) * (act @ np.asarray(wd[ei]))
+        ref = moe_dense_ref(x[r], wr, wg, wu, wd, k)
         np.testing.assert_allclose(out[r], ref, rtol=1e-3, atol=1e-3, err_msg=f"rank {r}")
 
 

@@ -1,0 +1,27 @@
+"""Training autograd functions over the distributed kernels (reference L9,
+``python/triton_dist/function/nvidia/``).
+
+The forward paths are the overlapped collective-matmul kernels; each
+``custom_vjp`` picks the **dual overlapped kernel** for the backward pass
+(AG-GEMM's input-gradient is a GEMM-RS and vice versa), so training steps
+keep comm/compute overlap in both directions instead of falling back to
+compiler-default collectives.
+"""
+
+from triton_dist_tpu.function.collectives import (
+    ag_gemm_fn,
+    gemm_rs_fn,
+    gemm_ar_fn,
+    all_to_all_single_fn,
+    group_gemm_swiglu_fn,
+)
+from triton_dist_tpu.function.ep_moe import ep_moe_fused_fn
+
+__all__ = [
+    "ag_gemm_fn",
+    "gemm_rs_fn",
+    "gemm_ar_fn",
+    "all_to_all_single_fn",
+    "group_gemm_swiglu_fn",
+    "ep_moe_fused_fn",
+]

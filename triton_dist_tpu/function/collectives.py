@@ -1,0 +1,203 @@
+"""``custom_vjp`` rules for the overlapped collective matmuls.
+
+Reference: the reference makes its EP MoE trainable with a hand-written
+fwd+bwd pair (``function/nvidia/ep_moe_fused.py:42-186``); its TP matmuls
+are inference-only. Here every collective matmul gets a VJP whose backward
+is itself an overlapped kernel — the AG↔RS duality:
+
+* ``ag_gemm``  out = AG(x) @ B        ⇒  dx = RS(g @ Bᵀ)   (a GEMM-RS ring)
+* ``gemm_rs``  out = RS(A @ B)        ⇒  dA = AG(g) @ Bᵀ   (an AG-GEMM ring)
+* ``gemm_ar``  out = AR(A @ B)        ⇒  dA = g @ Bᵀ        (local; g replicated)
+
+Weight gradients ride the same AG ring (recompute-in-backward — nothing
+world-sized is saved as a residual). All functions are shard-local
+(inside ``shard_map``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from triton_dist_tpu.kernels.allgather_gemm import (
+    AGGemmMethod,
+    ag_gemm_shard,
+    ring_ag_chunks,
+)
+from triton_dist_tpu.kernels.gemm_allreduce import gemm_ar_shard
+from triton_dist_tpu.kernels.gemm_reduce_scatter import gemm_rs_shard
+
+
+def _ring_weight_grad(x: jax.Array, g: jax.Array, axis: str) -> jax.Array:
+    """dB = AG(x)ᵀ @ G computed chunkwise on the AG ring: step ``s`` holds
+    rank ``(me - s) % world``'s x-chunk and multiplies it against that rank's
+    row-block of G — each hop hides behind the previous chunk's GEMM."""
+    world = jax.lax.axis_size(axis)
+    me = jax.lax.axis_index(axis)
+    m = x.shape[0]
+    db = jnp.zeros((x.shape[1], g.shape[1]), jnp.float32)
+    for s, xc in enumerate(ring_ag_chunks(x, axis)):
+        j = jnp.mod(me - s, world)
+        gj = jax.lax.dynamic_slice(g, (j * m, 0), (m, g.shape[1]))
+        db = db + jnp.dot(xc.T, gj, preferred_element_type=jnp.float32)
+    return db
+
+
+# ------------------------------------------------------------------- ag_gemm
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def ag_gemm_fn(x: jax.Array, b: jax.Array, axis: str = "tp", mesh_axes=None) -> jax.Array:
+    """Differentiable ``all_gather(x) @ B_local``; x: (m, k) row-shard,
+    b: (k, n_local) column-shard → (world·m, n_local). ``mesh_axes`` must be
+    the full mesh axis tuple on multi-axis meshes (the fused Pallas path's
+    barriers need it to address the right device group)."""
+    return ag_gemm_shard(x, b, axis=axis, mesh_axes=mesh_axes)
+
+
+def _ag_gemm_fwd(x, b, axis, mesh_axes):
+    return ag_gemm_shard(x, b, axis=axis, mesh_axes=mesh_axes), (x, b)
+
+
+def _ag_gemm_bwd(axis, mesh_axes, res, g):
+    x, b = res
+    # dx = RS(g @ bᵀ): the dual overlapped ring (rows scatter back to owners).
+    dx = gemm_rs_shard(g, b.T, axis=axis, mesh_axes=mesh_axes).astype(x.dtype)
+    db = _ring_weight_grad(x, g, axis).astype(b.dtype)
+    return dx, db
+
+
+ag_gemm_fn.defvjp(_ag_gemm_fwd, _ag_gemm_bwd)
+
+
+# ------------------------------------------------------------------- gemm_rs
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def gemm_rs_fn(a: jax.Array, b: jax.Array, axis: str = "tp", mesh_axes=None) -> jax.Array:
+    """Differentiable ``reduce_scatter(A_local @ B_local)``; a: (m, k_local),
+    b: (k_local, n) → (m/world, n) row-chunk."""
+    return gemm_rs_shard(a, b, axis=axis, mesh_axes=mesh_axes)
+
+
+def _gemm_rs_fwd(a, b, axis, mesh_axes):
+    return gemm_rs_shard(a, b, axis=axis, mesh_axes=mesh_axes), (a, b)
+
+
+def _gemm_rs_bwd(axis, mesh_axes, res, g):
+    a, b = res
+    # dA = AG(g) @ bᵀ: the dual overlapped ring.
+    da = ag_gemm_shard(
+        g, b.T, axis=axis, mesh_axes=mesh_axes, method=AGGemmMethod.XLA_RING
+    ).astype(a.dtype)
+    # dB = Aᵀ @ AG(g) = (AG(g)ᵀ @ A)ᵀ — the same ring-weight-grad, transposed.
+    db = _ring_weight_grad(g, a, axis).T.astype(b.dtype)
+    return da, db
+
+
+gemm_rs_fn.defvjp(_gemm_rs_fwd, _gemm_rs_bwd)
+
+
+# ------------------------------------------------------------------- gemm_ar
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def gemm_ar_fn(a: jax.Array, b: jax.Array, axis: str = "tp", mesh_axes=None) -> jax.Array:
+    """Differentiable ``all_reduce(A_local @ B_local)``; a: (m, k_local),
+    b: (k_local, n) → (m, n) replicated.
+
+    The transpose of the trailing all-reduce is an all-reduce (under
+    shard_map's ``check_vma=False`` convention the replicated output's
+    cotangent arrives split 1/world per rank; the psum reconstitutes it),
+    after which dA = g @ bᵀ and dB = aᵀ @ g are purely local."""
+    return gemm_ar_shard(a, b, axis=axis, mesh_axes=mesh_axes)
+
+
+def _gemm_ar_fwd(a, b, axis, mesh_axes):
+    return gemm_ar_shard(a, b, axis=axis, mesh_axes=mesh_axes), (a, b)
+
+
+def _gemm_ar_bwd(axis, mesh_axes, res, g):
+    a, b = res
+    g = jax.lax.psum(g, axis)
+    da = jnp.dot(g, b.T, preferred_element_type=jnp.float32).astype(a.dtype)
+    db = jnp.dot(a.T, g, preferred_element_type=jnp.float32).astype(b.dtype)
+    return da, db
+
+
+gemm_ar_fn.defvjp(_gemm_ar_fwd, _gemm_ar_bwd)
+
+
+# ------------------------------------------------- all_to_all (EP transport)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def all_to_all_single_fn(x: jax.Array, axis: str = "ep", mesh_axes=None,
+                         use_pallas: bool = True) -> jax.Array:
+    """Differentiable EP all-to-all: x (world, chunk, d), row p → peer p.
+    The transpose of an all-to-all is the same all-to-all (it is a global
+    permutation), so the backward reuses the one-sided kernel."""
+    from triton_dist_tpu.kernels.ep_a2a import all_to_all_single_shard
+
+    return all_to_all_single_shard(x, axis=axis, mesh_axes=mesh_axes, use_pallas=use_pallas)
+
+
+def _a2a_fwd(x, axis, mesh_axes, use_pallas):
+    from triton_dist_tpu.kernels.ep_a2a import all_to_all_single_shard
+
+    return all_to_all_single_shard(x, axis=axis, mesh_axes=mesh_axes, use_pallas=use_pallas), None
+
+
+def _a2a_bwd(axis, mesh_axes, use_pallas, _, g):
+    from triton_dist_tpu.kernels.ep_a2a import all_to_all_single_shard
+
+    return (all_to_all_single_shard(g, axis=axis, mesh_axes=mesh_axes, use_pallas=use_pallas),)
+
+
+all_to_all_single_fn.defvjp(_a2a_fwd, _a2a_bwd)
+
+
+# ------------------------------------------------------- fused swiglu (pallas)
+
+
+@jax.custom_vjp
+def group_gemm_swiglu_fn(x: jax.Array, w_gate: jax.Array, w_up: jax.Array) -> jax.Array:
+    """Differentiable fused per-expert gate/up+SwiGLU (the Pallas forward is
+    not traceable by autodiff; the VJP recomputes the two projections —
+    activation rematerialization, nothing (E, C, f)-sized saved)."""
+    from triton_dist_tpu.kernels.group_gemm import group_gemm_swiglu
+
+    return group_gemm_swiglu(x, w_gate, w_up)
+
+
+def _ggsw_fwd(x, w_gate, w_up):
+    from triton_dist_tpu.kernels.group_gemm import group_gemm_swiglu
+
+    return group_gemm_swiglu(x, w_gate, w_up), (x, w_gate, w_up)
+
+
+def _ggsw_bwd(res, dh):
+    x, w_gate, w_up = res
+    dims = (((2,), (1,)), ((0,), (0,)))  # (E,C,d) @ (E,d,f)
+    g = jax.lax.dot_general(x, w_gate, dims, preferred_element_type=jnp.float32)
+    u = jax.lax.dot_general(x, w_up, dims, preferred_element_type=jnp.float32)
+    sg = jax.nn.sigmoid(g)
+    silu_g = g * sg
+    dh32 = dh.astype(jnp.float32)
+    du = dh32 * silu_g  # ∂/∂u [silu(g)·u]
+    dg = dh32 * u * (sg * (1.0 + g * (1.0 - sg)))  # silu'(g)
+    # dx = dg @ wgᵀ + du @ wuᵀ  (batched over experts)
+    dimsT = (((2,), (2,)), ((0,), (0,)))  # (E,C,f) @ (E,d,f)ᵀ
+    dx = (
+        jax.lax.dot_general(dg, w_gate, dimsT, preferred_element_type=jnp.float32)
+        + jax.lax.dot_general(du, w_up, dimsT, preferred_element_type=jnp.float32)
+    ).astype(x.dtype)
+    dimsW = (((1,), (1,)), ((0,), (0,)))  # (E,C,d)ᵀ @ (E,C,f)
+    dwg = jax.lax.dot_general(x, dg, dimsW, preferred_element_type=jnp.float32).astype(w_gate.dtype)
+    dwu = jax.lax.dot_general(x, du, dimsW, preferred_element_type=jnp.float32).astype(w_up.dtype)
+    return dx, dwg, dwu
+
+
+group_gemm_swiglu_fn.defvjp(_ggsw_fwd, _ggsw_bwd)

@@ -243,6 +243,14 @@ class TP_MoE:
         world = jax.lax.axis_size(self.axis)
         t, d = x.shape
         if mode == "dist":
+            if t < 8:
+                # Tiny seq-shards: per-chunk align-8 capacity padding would
+                # multiply the grouped-GEMM work — gather once, run the
+                # (possibly unchunked) replicated path, take my chunk back.
+                x_full = jax.lax.all_gather(x, self.axis, tiled=True)
+                out_full = self(x_full, mode="dist_ar")
+                me = jax.lax.axis_index(self.axis)
+                return jax.lax.dynamic_slice(out_full, (me * t, 0), (t, d))
             return tp_moe_rs_shard(
                 x, self.w_router, self.w_gate, self.w_up, self.w_down,
                 top_k=self.top_k, capacity_factor=self.capacity_factor,

@@ -150,3 +150,94 @@ def test_ep_dispatch_combine_e2e(ctx4, rng, use_pallas):
         scale = np.asarray(expert_scale)[np.asarray(idx[r])]  # (t, k)
         expect = np.asarray(x[r]) * (np.asarray(w[r]) * scale).sum(-1, keepdims=True)
         np.testing.assert_allclose(out[r], expect, rtol=1e-4, atol=1e-4, err_msg=f"rank {r}")
+
+
+# ----------------------------------------------------------- low-latency v2
+
+
+def test_fp8_quant_roundtrip(rng):
+    from triton_dist_tpu.kernels.low_latency_a2a import quantize_fp8, dequantize_fp8
+
+    x = jnp.asarray(rng.standard_normal((32, 64)), jnp.float32) * 3.0
+    q, s = quantize_fp8(x)
+    back = dequantize_fp8(q, s, jnp.float32)
+    # e4m3 has ~2 decimal digits; absmax scaling bounds relative row error.
+    np.testing.assert_allclose(np.asarray(back), np.asarray(x), rtol=0.07, atol=0.05)
+    # zero rows survive
+    x0 = jnp.zeros((4, 8), jnp.float32)
+    q0, s0 = quantize_fp8(x0)
+    assert np.all(np.asarray(dequantize_fp8(q0, s0, jnp.float32)) == 0)
+
+
+@pytest.mark.parametrize("use_pallas", [False, True])
+def test_ll_dispatch_combine_fp8(ctx4, rng, use_pallas):
+    """fp8-wire dispatch/combine roundtrip: identity experts must return
+    x·Σw within fp8 tolerance (reference test_low_latency_a2a --check)."""
+    from triton_dist_tpu.kernels.low_latency_a2a import (
+        ll_dispatch_shard, ll_combine_shard,
+    )
+    from triton_dist_tpu.kernels.moe_utils import capacity_for
+
+    world, t, d, e, k = 4, 8, 32, 8, 2
+    x = jnp.asarray(rng.standard_normal((world, t, d)), jnp.float32)
+    idx = jnp.asarray(rng.integers(0, e, (world, t, k)), jnp.int32)
+    w = jnp.asarray(rng.random((world, t, k)), jnp.float32)
+    cap = capacity_for(t, k, e, 8.0)
+
+    def fn(x_, idx_, w_):
+        disp = ll_dispatch_shard(
+            x_[0], idx_[0], num_experts=e, capacity=cap,
+            axis="tp", mesh_axes=("tp",), use_pallas=use_pallas,
+        )
+        out = ll_combine_shard(
+            disp.expert_inputs, disp, w_[0], axis="tp", mesh_axes=("tp",),
+            use_pallas=use_pallas,
+        )
+        return out[None]
+
+    out = np.asarray(
+        jax.jit(
+            jax.shard_map(
+                fn, mesh=ctx4.mesh,
+                in_specs=(P("tp"), P("tp"), P("tp")),
+                out_specs=P("tp"), check_vma=False,
+            )
+        )(x, idx, w)
+    )
+    expect = np.asarray(x) * np.asarray(w.sum(-1, keepdims=True))
+    np.testing.assert_allclose(out, expect, rtol=0.08, atol=0.08)
+
+
+def test_ep_moe_low_latency_vs_dense(ctx4, rng):
+    """Fused LL EP MoE (fp8 wire) matches the dense reference to fp8 tolerance."""
+    from triton_dist_tpu.layers import EP_MoE
+    from moe_ref import moe_dense_ref
+
+    WORLD, d, ff, e, t, k = 4, 32, 48, 8, 8, 2
+    x = jnp.asarray(rng.standard_normal((WORLD, t, d)), jnp.float32) * 0.3
+    wr = jnp.asarray(rng.standard_normal((d, e)), jnp.float32)
+    wg = jnp.asarray(rng.standard_normal((e, d, ff)), jnp.float32) * 0.1
+    wu = jnp.asarray(rng.standard_normal((e, d, ff)), jnp.float32) * 0.1
+    wd = jnp.asarray(rng.standard_normal((e, ff, d)), jnp.float32) * 0.1
+
+    def fn(x_, wr_, wg_, wu_, wd_):
+        moe = EP_MoE(
+            w_router=wr_, w_gate=wg_, w_up=wu_, w_down=wd_,
+            num_experts=e, top_k=k, capacity_factor=8.0, axis="tp",
+            mesh_axes=("tp",), low_latency=True,
+        )
+        return moe(x_[0])[None]
+
+    out = np.asarray(
+        jax.jit(
+            jax.shard_map(
+                fn, mesh=ctx4.mesh,
+                in_specs=(P("tp"), P(), P("tp"), P("tp"), P("tp")),
+                out_specs=P("tp"), check_vma=False,
+            )
+        )(x, wr, wg, wu, wd)
+    )
+    for r in range(WORLD):
+        ref = moe_dense_ref(x[r], wr, wg, wu, wd, k)
+        # fp8 activations through two GEMMs: loose but meaningful bound.
+        np.testing.assert_allclose(out[r], ref, rtol=0.1, atol=0.02, err_msg=f"rank {r}")

@@ -27,7 +27,9 @@ from triton_dist_tpu.kernels.moe_utils import (
     combine,
     dispatch as local_dispatch,
     make_routing_plan,
+    regroup_by_expert,
     topk_routing,
+    ungroup_to_peers,
 )
 
 
@@ -61,19 +63,11 @@ def ep_moe_fused_fn(
     buf = local_dispatch(x, plan)  # (E, C, d) destination-major
     send = buf.reshape(world, e_local * cap, d)
     recv = all_to_all_single_fn(send, axis, mesh_axes, use_pallas_a2a)
-    xe = (
-        recv.reshape(world, e_local, cap, d)
-        .transpose(1, 0, 2, 3)
-        .reshape(e_local, world * cap, d)
-    )
+    xe = regroup_by_expert(recv, world, e_local, cap)
 
     h = group_gemm_swiglu_fn(xe, w_gate, w_up)
     y = group_gemm(h, w_down)  # (E_local, world*C, d)
 
-    send_back = (
-        y.reshape(e_local, world, cap, d)
-        .transpose(1, 0, 2, 3)
-        .reshape(world, e_local * cap, d)
-    )
+    send_back = ungroup_to_peers(y, world, e_local, cap)
     recv_back = all_to_all_single_fn(send_back, axis, mesh_axes, use_pallas_a2a)
     return combine(recv_back.reshape(world * e_local, cap, d), plan, w, t)

@@ -136,13 +136,9 @@ def ep_dispatch_shard(
     recv = all_to_all_single_shard(
         send, axis=axis, mesh_axes=mesh_axes, use_pallas=use_pallas
     )  # (world, e_local*C, d)
-    # Regroup: (world, E_local, C, d) → (E_local, world*C, d): each local
-    # expert sees the concatenation of every source rank's capacity block.
-    expert_inputs = (
-        recv.reshape(world, e_local, capacity, d)
-        .transpose(1, 0, 2, 3)
-        .reshape(e_local, world * capacity, d)
-    )
+    from triton_dist_tpu.kernels.moe_utils import regroup_by_expert
+
+    expert_inputs = regroup_by_expert(recv, world, e_local, capacity)
     return EPDispatchResult(expert_inputs=expert_inputs, plan=plan, num_tokens=t)
 
 
@@ -161,11 +157,9 @@ def ep_combine_shard(
     e_local, wc, d = y.shape
     capacity = wc // world
     # Back to source-major (world, E_local*C, d) and reverse the a2a.
-    send = (
-        y.reshape(e_local, world, capacity, d)
-        .transpose(1, 0, 2, 3)
-        .reshape(world, e_local * capacity, d)
-    )
+    from triton_dist_tpu.kernels.moe_utils import ungroup_to_peers
+
+    send = ungroup_to_peers(y, world, e_local, capacity)
     recv = all_to_all_single_shard(
         send, axis=axis, mesh_axes=mesh_axes, use_pallas=use_pallas
     )  # (world, E_local*C, d) = my tokens' slots grouped by expert-owner rank

@@ -1,0 +1,168 @@
+"""Low-latency EP AllToAll v2: fp8 wire, per-token scales, per-expert layout.
+
+Reference: ``python/triton_dist/kernels/nvidia/low_latency_all_to_all_v2.py``
+(696 LoC) — the inference-EP dispatch that beats DeepEP (137 µs vs 182 µs,
+``README.md:99``): tokens quantized to fp8 with per-token scales, laid out
+per expert on the receive side, one put per peer. TPU redesign:
+
+* **Wire compression**: payloads cross the ICI as ``float8_e4m3fn`` with a
+  per-token fp32 scale (absmax/448) — halving a2a bytes vs bf16 is exactly
+  the reference's fp8-wire win; scales ride a second (tiny) a2a.
+* **Per-expert layout**: the send buffer is already the (E, C, d) slot grid
+  (destination-major), so the receive side regroups to (E_local, world·C, d)
+  per-expert panels with zero extra copies — the v2 layout falls out of the
+  static-capacity design.
+* **Fused one-jit path** (``ep_moe_ll_shard``): dispatch → dequant → fused
+  gate/up+SwiGLU grouped GEMM → down grouped GEMM → combine under a single
+  jit scope, the ``ep_all2all_fused`` composition (reference
+  ``mega_kernel_dispatch_token_moe_grouped_gemm:839``) — XLA schedules the
+  dequant and the first expert GEMMs against the scale a2a.
+
+Combine returns in the model dtype (the reference's combine leg is bf16 too:
+gradient-of-quality choice, ``low_latency_all_to_all_v2.py`` combine path).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from triton_dist_tpu.kernels.ep_a2a import all_to_all_single_shard
+from triton_dist_tpu.kernels.group_gemm import group_gemm, group_gemm_swiglu
+from triton_dist_tpu.kernels.moe_utils import (
+    RoutingPlan,
+    capacity_for,
+    combine,
+    dispatch as local_dispatch,
+    make_routing_plan,
+    regroup_by_expert,
+    topk_routing,
+    ungroup_to_peers,
+)
+
+FP8_MAX = 448.0  # float8_e4m3fn finite max
+
+
+def quantize_fp8(x: jax.Array):
+    """Per-token (row) absmax quantization to e4m3: returns (q, scale) with
+    ``x ≈ q.astype(f32) * scale[:, None]``. Zero rows get scale 1."""
+    absmax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    scale = jnp.where(absmax > 0, absmax / FP8_MAX, 1.0)
+    q = (x.astype(jnp.float32) / scale).astype(jnp.float8_e4m3fn)
+    return q, scale.astype(jnp.float32)
+
+
+def dequantize_fp8(q: jax.Array, scale: jax.Array, dtype=jnp.bfloat16) -> jax.Array:
+    return (q.astype(jnp.float32) * scale).astype(dtype)
+
+
+@dataclasses.dataclass
+class LLDispatchResult:
+    """v2 dispatch output: per-expert panels + combine state."""
+
+    expert_inputs: jax.Array  # (E_local, world*C, d) dequantized model dtype
+    plan: RoutingPlan
+    num_tokens: int
+
+
+def ll_dispatch_shard(
+    x: jax.Array,  # (T, d) this rank's tokens
+    expert_idx: jax.Array,  # (T, K) global expert ids
+    *,
+    num_experts: int,
+    capacity: int,
+    axis: str = "ep",
+    mesh_axes=None,
+    use_pallas: bool = True,
+    wire_fp8: bool = True,
+) -> LLDispatchResult:
+    """fp8-wire dispatch (reference ``dispatch_kernel_v2``): quantize →
+    payload a2a (fp8) + scale a2a (fp32) → per-expert dequantized panels."""
+    world = jax.lax.axis_size(axis)
+    t, d = x.shape
+    e_local = num_experts // world
+
+    plan = make_routing_plan(expert_idx, num_experts, capacity)
+    buf = local_dispatch(x, plan)  # (E, C, d) destination-major
+    send = buf.reshape(world, e_local * capacity, d)
+
+    if wire_fp8:
+        q, scale = quantize_fp8(send.reshape(-1, d))
+        q = q.reshape(world, e_local * capacity, d)
+        # Scales as a (world, chunk, 1) payload — same a2a machinery; fp8
+        # bytes on the wire are an int8 view (DMA is dtype-agnostic).
+        qv = q.view(jnp.int8)
+        recv_q = all_to_all_single_shard(
+            qv, axis=axis, mesh_axes=mesh_axes, use_pallas=use_pallas
+        ).view(jnp.float8_e4m3fn)
+        recv_s = all_to_all_single_shard(
+            scale.reshape(world, e_local * capacity, 1),
+            axis=axis, mesh_axes=mesh_axes, use_pallas=use_pallas,
+        )
+        recv = dequantize_fp8(recv_q.reshape(-1, d), recv_s.reshape(-1, 1), x.dtype)
+        recv = recv.reshape(world, e_local * capacity, d)
+    else:
+        recv = all_to_all_single_shard(
+            send, axis=axis, mesh_axes=mesh_axes, use_pallas=use_pallas
+        )
+
+    expert_inputs = regroup_by_expert(recv, world, e_local, capacity)
+    return LLDispatchResult(expert_inputs=expert_inputs, plan=plan, num_tokens=t)
+
+
+def ll_combine_shard(
+    y: jax.Array,  # (E_local, world*C, d) expert outputs
+    disp: LLDispatchResult,
+    weights: jax.Array,  # (T, K)
+    *,
+    axis: str = "ep",
+    mesh_axes=None,
+    use_pallas: bool = True,
+) -> jax.Array:
+    """Return leg + weighted reduce (model dtype on the wire — combine
+    precision is a quality choice, matching the reference's v2 combine)."""
+    world = jax.lax.axis_size(axis)
+    e_local, wc, d = y.shape
+    capacity = wc // world
+    send = ungroup_to_peers(y, world, e_local, capacity)
+    recv = all_to_all_single_shard(
+        send, axis=axis, mesh_axes=mesh_axes, use_pallas=use_pallas
+    )
+    return combine(
+        recv.reshape(world * e_local, capacity, d), disp.plan, weights, disp.num_tokens
+    )
+
+
+def ep_moe_ll_shard(
+    x: jax.Array,  # (T, d)
+    w_router: jax.Array,  # (d, E)
+    w_gate: jax.Array,  # (E_local, d, ff)
+    w_up: jax.Array,  # (E_local, d, ff)
+    w_down: jax.Array,  # (E_local, ff, d)
+    *,
+    num_experts: int,
+    top_k: int,
+    capacity_factor: float = 2.0,
+    axis: str = "ep",
+    mesh_axes=None,
+    use_pallas: bool = True,
+    wire_fp8: bool = True,
+) -> jax.Array:
+    """Fused low-latency EP MoE under one jit: fp8 dispatch → fused
+    gate/up+SwiGLU grouped GEMM → down grouped GEMM → combine (the
+    ``ep_all2all_fused`` mega-EP composition)."""
+    t = x.shape[0]
+    logits = jnp.dot(x, w_router, preferred_element_type=jnp.float32)
+    idx, w = topk_routing(logits, top_k)
+    cap = capacity_for(t, top_k, num_experts, capacity_factor)
+    disp = ll_dispatch_shard(
+        x, idx, num_experts=num_experts, capacity=cap,
+        axis=axis, mesh_axes=mesh_axes, use_pallas=use_pallas, wire_fp8=wire_fp8,
+    )
+    h = group_gemm_swiglu(disp.expert_inputs, w_gate, w_up)
+    y = group_gemm(h, w_down)
+    return ll_combine_shard(
+        y, disp, w, axis=axis, mesh_axes=mesh_axes, use_pallas=use_pallas
+    )

@@ -33,10 +33,38 @@ class RoutingPlan:
     capacity: int
 
 
-def capacity_for(tokens: int, topk: int, num_experts: int, factor: float = 1.25, align: int = 8) -> int:
+# Per-expert capacity is padded up to this multiple (MXU tile friendliness);
+# chunked-MoE fallbacks key off it too (layers/tp.py small-chunk guard).
+CAPACITY_ALIGN = 8
+
+
+def capacity_for(tokens: int, topk: int, num_experts: int, factor: float = 1.25, align: int = CAPACITY_ALIGN) -> int:
     """Per-expert slot count: ceil(T*K/E * factor), aligned up (MXU tiles)."""
     c = int(tokens * topk / num_experts * factor) + 1
     return max(align, (c + align - 1) // align * align)
+
+
+def regroup_by_expert(recv: jax.Array, world: int, e_local: int, capacity: int) -> jax.Array:
+    """(world, e_local·C, d) source-major a2a output → (e_local, world·C, d)
+    per-expert panels (each local expert sees every source rank's capacity
+    block concatenated)."""
+    d = recv.shape[-1]
+    return (
+        recv.reshape(world, e_local, capacity, d)
+        .transpose(1, 0, 2, 3)
+        .reshape(e_local, world * capacity, d)
+    )
+
+
+def ungroup_to_peers(y: jax.Array, world: int, e_local: int, capacity: int) -> jax.Array:
+    """Inverse of :func:`regroup_by_expert`: (e_local, world·C, d) →
+    (world, e_local·C, d) peer-major send layout for the return a2a."""
+    d = y.shape[-1]
+    return (
+        y.reshape(e_local, world, capacity, d)
+        .transpose(1, 0, 2, 3)
+        .reshape(world, e_local * capacity, d)
+    )
 
 
 def make_routing_plan(

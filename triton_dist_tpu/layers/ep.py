@@ -36,9 +36,22 @@ class EP_MoE:
     axis: str = static_field(default="ep")
     mesh_axes: tuple | None = static_field(default=None)
     use_pallas_a2a: bool = static_field(default=False)
+    # Low-latency v2 path: fp8 wire + per-expert layout + fused one-jit
+    # dispatch→groupGEMM→combine (reference low_latency_all_to_all_v2.py).
+    low_latency: bool = static_field(default=False)
 
     def __call__(self, x: jax.Array) -> jax.Array:
         """x: (T, d) this rank's tokens → (T, d). Inside shard_map."""
+        if self.low_latency:
+            from triton_dist_tpu.kernels.low_latency_a2a import ep_moe_ll_shard
+
+            return ep_moe_ll_shard(
+                x, self.w_router, self.w_gate, self.w_up, self.w_down,
+                num_experts=self.num_experts, top_k=self.top_k,
+                capacity_factor=self.capacity_factor,
+                axis=self.axis, mesh_axes=self.mesh_axes,
+                use_pallas=self.use_pallas_a2a, wire_fp8=True,
+            )
         t, d = x.shape
         logits = jnp.dot(x, self.w_router, preferred_element_type=jnp.float32)
         idx, w = topk_routing(logits, self.top_k)

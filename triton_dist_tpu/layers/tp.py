@@ -242,11 +242,13 @@ class TP_MoE:
 
         world = jax.lax.axis_size(self.axis)
         t, d = x.shape
+        from triton_dist_tpu.kernels.moe_utils import CAPACITY_ALIGN
+
         if mode == "dist":
-            if t < 8:
-                # Tiny seq-shards: per-chunk align-8 capacity padding would
-                # multiply the grouped-GEMM work — gather once, run the
-                # (possibly unchunked) replicated path, take my chunk back.
+            if t < CAPACITY_ALIGN:
+                # Tiny seq-shards: per-chunk capacity padding (align-up to
+                # CAPACITY_ALIGN) would multiply the grouped-GEMM work —
+                # gather once, run the replicated path, take my chunk back.
                 x_full = jax.lax.all_gather(x, self.axis, tiled=True)
                 out_full = self(x_full, mode="dist_ar")
                 me = jax.lax.axis_index(self.axis)
@@ -257,9 +259,9 @@ class TP_MoE:
                 axis=self.axis,
             )
         # Chunked AR only when per-chunk tokens are large enough that the
-        # align-8 capacity padding doesn't multiply the grouped-GEMM work
+        # capacity padding doesn't multiply the grouped-GEMM work
         # (small-T decode stays on the unchunked grouped-GEMM + AR path).
-        if mode == "dist_ar" and t % world == 0 and t // world >= 8:
+        if mode == "dist_ar" and t % world == 0 and t // world >= CAPACITY_ALIGN:
             return tp_moe_ar_shard(
                 x, self.w_router, self.w_gate, self.w_up, self.w_down,
                 top_k=self.top_k, capacity_factor=self.capacity_factor,

@@ -1,15 +1,19 @@
-"""Inference engine: jit-compiled prefill + decode loop with backend switch.
+"""Inference engine: jit-compiled prefill + on-device decode loop.
 
 Reference: ``python/triton_dist/models/engine.py:37-189`` — ``serve()`` does
 HF prefill, switches the model to a triton_dist backend, captures the decode
 step in a CUDA graph, then replays it per token (:75,:113,:166). TPU: jit
-compilation *is* the graph capture — the decode step is traced once under
-``shard_map`` and replayed; caches are donated so XLA updates them in place.
+compilation *is* the graph capture, and the whole ``gen_len`` decode loop
+runs **on device** as one ``lax.fori_loop`` — zero host round-trips per
+token (one step further than the reference's per-token graph replay).
 
 Backends (reference ``engine.py:80`` backend switch):
   "xla"      — compiler collectives everywhere (the torch-eager analog)
   "dist"     — AG-GEMM/GEMM-RS prefill + GEMM-AR/one-shot-AR decode
   "dist_ar"  — GEMM-AR replicated path for both
+
+Sampling (reference ``sample_token``, ``engine.py:169``): greedy,
+temperature, and nucleus (top-p).
 """
 
 from __future__ import annotations
@@ -29,17 +33,46 @@ from triton_dist_tpu.models.kv_cache import KVCache
 _BACKENDS = ("xla", "dist", "dist_ar")
 
 
+def sample_token(
+    logits: jax.Array,  # (B, V) fp32
+    key: jax.Array | None,
+    method: str = "greedy",
+    temperature: float = 1.0,
+    top_p: float = 1.0,
+) -> jax.Array:
+    """Greedy / temperature / nucleus sampling (static method switch —
+    resolved at trace time, decode loop stays one compiled program)."""
+    if method == "greedy":
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    assert key is not None, "sampling needs a PRNG key"
+    logits = logits.astype(jnp.float32) / jnp.maximum(temperature, 1e-6)
+    if method == "top_p" and top_p < 1.0:
+        v = logits.shape[-1]
+        sorted_logits, sorted_idx = jax.lax.top_k(logits, v)  # descending
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        # Keep every token whose preceding cumulative mass is ≤ top_p (the
+        # first token always survives).
+        prev_mass = jnp.cumsum(probs, axis=-1) - probs
+        masked = jnp.where(prev_mass <= top_p, sorted_logits, -jnp.inf)
+        choice = jax.random.categorical(key, masked, axis=-1)
+        return jnp.take_along_axis(sorted_idx, choice[:, None], axis=1)[:, 0].astype(jnp.int32)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
 class Engine:
     """Reference ``Engine`` (``models/engine.py:37``)."""
 
-    def __init__(self, model: DenseLLM, backend: str = "dist", max_len: int = 512):
+    def __init__(self, model: DenseLLM, backend: str = "dist", max_len: int = 512,
+                 sample: str = "greedy", temperature: float = 1.0, top_p: float = 1.0):
         assert backend in _BACKENDS, backend
         self.model = model
         self.backend = backend
         self.max_len = max_len
+        self.sample_method = sample
+        self.temperature = temperature
+        self.top_p = top_p
         ctx = model.ctx
         mesh = ctx.mesh
-        c = model.config
         axis = model.axis
 
         prefill_mode = {"xla": "xla", "dist": "dist", "dist_ar": "dist_ar"}[backend]
@@ -55,6 +88,7 @@ class Engine:
         tok_spec = P(dp)
         len_spec = P(dp)
         kv_spec = P(None, dp, "tp")  # (L, B over dp, Hkv over tp, S, D)
+        self._kv_sharding = ctx.sharding(*kv_spec)
 
         def prefill_fn(params, tokens):
             logits, (ks, vs) = model.prefill_shard(params, tokens, prefill_mode)
@@ -73,62 +107,95 @@ class Engine:
             logits, ks, vs = model.decode_shard(params, token, ks, vs, lengths, decode_mode)
             return jax.lax.all_gather(logits, axis, axis=1, tiled=True), ks, vs
 
-        self._decode = jax.jit(
-            jax.shard_map(
-                decode_fn, mesh=mesh,
-                in_specs=(p_specs, tok_spec, kv_spec, kv_spec, len_spec),
-                out_specs=(tok_spec, kv_spec, kv_spec),
-                check_vma=False,
-            ),
-            donate_argnums=(2, 3),
+        self._decode_shard = jax.shard_map(
+            decode_fn, mesh=mesh,
+            in_specs=(p_specs, tok_spec, kv_spec, kv_spec, len_spec),
+            out_specs=(tok_spec, kv_spec, kv_spec),
+            check_vma=False,
         )
+        self._decode = jax.jit(self._decode_shard, donate_argnums=(2, 3))
 
-    # ----------------------------------------------------------------- serve
-    def serve(self, input_ids: jax.Array, gen_len: int, sample: str = "greedy"):
-        """Generate ``gen_len`` tokens (greedy). Returns (B, gen_len) int32.
-        Reference ``Engine.serve`` (``engine.py:113``)."""
-        model = self.model
-        c = model.config
-        bsz, seq = input_ids.shape
-        assert seq + gen_len <= self.max_len
+        # One compiled program per gen_len: the whole decode loop on device
+        # (the XLA analog of replaying a captured CUDA graph gen_len times,
+        # minus the per-token host dispatch).
+        @partial(jax.jit, static_argnums=(5,), donate_argnums=(2, 3))
+        def generate(params, token0, ks, vs, lengths, gen_len, key):
+            bsz = token0.shape[0]
+            out0 = jnp.zeros((bsz, gen_len), jnp.int32).at[:, 0].set(token0)
 
-        logits, ks, vs = self._prefill(model.params, input_ids)
-        # Pad caches to max_len (prefill produced length == seq).
+            def body(i, carry):
+                out, token, ks, vs, lengths, key = carry
+                logits, ks, vs = self._decode_shard(params, token, ks, vs, lengths)
+                key, sub = jax.random.split(key)
+                token = sample_token(
+                    logits, sub, self.sample_method, self.temperature, self.top_p
+                )
+                return (out.at[:, i].set(token), token, ks, vs, lengths + 1, key)
+
+            carry = (out0, token0, ks, vs, lengths, key)
+            out, _, ks, vs, _, _ = jax.lax.fori_loop(1, gen_len, body, carry)
+            return out, ks, vs
+
+        self._generate = generate
+
+    # ------------------------------------------------------------------ kv
+    def _pad_fn(self, pad: int):
+        """One compiled pad-concat per pad size (jit caches key off the
+        function object — a fresh lambda per call would recompile every
+        serve())."""
+        fns = self.__dict__.setdefault("_pad_fns", {})
+        if pad not in fns:
+            fns[pad] = jax.jit(
+                lambda k, v: (
+                    jnp.concatenate([k, jnp.zeros(k.shape[:3] + (pad, k.shape[4]), k.dtype)], axis=3),
+                    jnp.concatenate([v, jnp.zeros(v.shape[:3] + (pad, v.shape[4]), v.dtype)], axis=3),
+                ),
+                out_shardings=(self._kv_sharding, self._kv_sharding),
+            )
+        return fns[pad]
+
+    def _make_cache(self, ks: jax.Array, vs: jax.Array, seq: int) -> KVCache:
+        """Pad prefill caches to max_len into a KVCache handle."""
         pad = self.max_len - ks.shape[3]
         if pad > 0:
-            pad_block = jnp.zeros(
-                (ks.shape[0], ks.shape[1], ks.shape[2], pad, ks.shape[4]), ks.dtype
-            )
-            ks = jnp.concatenate([ks, pad_block], axis=3)
-            vs = jnp.concatenate([vs, pad_block], axis=3)
-        lengths = jnp.full((bsz,), seq, jnp.int32)
+            ks, vs = self._pad_fn(pad)(ks, vs)
+        lengths = jnp.full((ks.shape[1],), seq, jnp.int32)
+        return KVCache(k=ks, v=vs, lengths=lengths)
 
-        out = []
-        token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        out.append(token)
-        for _ in range(gen_len - 1):
-            logits, ks, vs = self._decode(model.params, token, ks, vs, lengths)
-            lengths = lengths + 1
-            token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            out.append(token)
-        return jnp.stack(out, axis=1)
+    # ----------------------------------------------------------------- serve
+    def serve(self, input_ids: jax.Array, gen_len: int, key: jax.Array | None = None):
+        """Generate ``gen_len`` tokens. Returns (B, gen_len) int32.
+        Reference ``Engine.serve`` (``engine.py:113``)."""
+        model = self.model
+        bsz, seq = input_ids.shape
+        assert seq + gen_len <= self.max_len
+        if key is None:
+            key = jax.random.PRNGKey(0)
+
+        logits, ks, vs = self._prefill(model.params, input_ids)
+        cache = self._make_cache(ks, vs, seq)
+
+        key, sub = jax.random.split(key)
+        token0 = sample_token(logits, sub, self.sample_method, self.temperature, self.top_p)
+        out, k2, v2 = self._generate(
+            model.params, token0, cache.k, cache.v, cache.lengths, gen_len, key
+        )
+        # gen_len-1 decode steps ran, each writing its input token's KV:
+        # slots [0, seq+gen_len-1) hold valid entries; the LAST generated
+        # token's KV is not yet written (a resumed decode feeds it next).
+        self.kv_cache = KVCache(k=k2, v=v2, lengths=cache.lengths + gen_len - 1)
+        return out
 
     # ------------------------------------------------------------- profiling
     def bench_decode(self, bsz: int = 1, prompt_len: int = 64, iters: int = 20):
-        """Steady-state decode latency (reference perf mode of
+        """Steady-state per-token decode latency (reference perf mode of
         ``test_e2e_inference.py``)."""
         ids = jnp.zeros((bsz, prompt_len), jnp.int32)
         logits, ks, vs = self._prefill(self.model.params, ids)
-        pad = self.max_len - ks.shape[3]
-        if pad > 0:
-            pad_block = jnp.zeros(
-                (ks.shape[0], ks.shape[1], ks.shape[2], pad, ks.shape[4]), ks.dtype
-            )
-            ks = jnp.concatenate([ks, pad_block], axis=3)
-            vs = jnp.concatenate([vs, pad_block], axis=3)
-        lengths = jnp.full((bsz,), prompt_len, jnp.int32)
+        cache = self._make_cache(ks, vs, prompt_len)
         token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        # warmup
+        ks, vs, lengths = cache.k, cache.v, cache.lengths
+        # warmup (compile)
         logits, ks, vs = self._decode(self.model.params, token, ks, vs, lengths)
         jax.block_until_ready(logits)
         t0 = time.perf_counter()
@@ -136,6 +203,18 @@ class Engine:
             logits, ks, vs = self._decode(self.model.params, token, ks, vs, lengths)
         jax.block_until_ready(logits)
         return (time.perf_counter() - t0) / iters
+
+
+def bench_decode_table(model: DenseLLM, backends=_BACKENDS, bsz: int = 1,
+                       prompt_len: int = 64, iters: int = 20, max_len: int = 512):
+    """Per-backend decode latency comparison (the reference's e2e table,
+    ``e2e_dense.md``): {backend: seconds/token}."""
+    return {
+        b: Engine(model, backend=b, max_len=max_len).bench_decode(
+            bsz=bsz, prompt_len=prompt_len, iters=iters
+        )
+        for b in backends
+    }
 
 
 def modelspecs(model: DenseLLM):

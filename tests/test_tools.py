@@ -1,0 +1,99 @@
+"""Tooling tests: perf models, profiler traces, tune cache, autotuner.
+
+Parity model: reference ``comm_perf_model``/``gemm_perf_model`` consistency
+checks and the profiler's trace-export contract.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from triton_dist_tpu.tools.perf_model import (
+    CHIPS,
+    allgather_time_s,
+    allreduce_time_s,
+    all_to_all_time_s,
+    attention_time_s,
+    chip_spec,
+    gemm_time_s,
+    overlap_efficiency,
+    overlap_fraction,
+    reduce_scatter_time_s,
+)
+from triton_dist_tpu.tools.profiler import ChromeTrace, profile_op
+
+
+V5E = CHIPS["tpu v5 lite"]
+
+
+def test_perf_model_rooflines():
+    # MXU-bound large GEMM: time ≈ flops/peak.
+    t = gemm_time_s(8192, 8192, 8192, jnp.bfloat16, V5E)
+    assert abs(t - 2 * 8192**3 / (V5E.bf16_tflops * 1e12)) / t < 1e-6
+    # HBM-bound skinny GEMM: bigger than pure-MXU time.
+    t_skinny = gemm_time_s(8, 8192, 8192, jnp.bfloat16, V5E)
+    assert t_skinny > 2 * 8 * 8192 * 8192 / (V5E.bf16_tflops * 1e12)
+    # Monotonic in shape.
+    assert gemm_time_s(4096, 4096, 4096, jnp.bfloat16, V5E) < t
+    # Causal attention is half the flops of full.
+    full = attention_time_s(4, 16, 4096, 128, jnp.bfloat16, V5E, causal=False)
+    half = attention_time_s(4, 16, 4096, 128, jnp.bfloat16, V5E, causal=True)
+    assert half < full
+
+
+def test_perf_model_collectives():
+    nbytes = 64 * 1024 * 1024
+    ag = allgather_time_s(nbytes, 8, V5E)
+    rs = reduce_scatter_time_s(nbytes, 8, V5E)
+    ar = allreduce_time_s(nbytes, 8, V5E)
+    assert ag == rs and abs(ar - 2 * ag) < 1e-12
+    assert allgather_time_s(nbytes, 1, V5E) == 0.0
+    # More ranks moves more total data over the ring.
+    assert allgather_time_s(nbytes, 16, V5E) > ag
+    assert all_to_all_time_s(nbytes, 8, V5E) > 0
+
+
+def test_overlap_accounting():
+    # Perfect overlap: measured == max leg.
+    assert overlap_fraction(1.0, 1.0, 0.5) == 1.0
+    # Fully serial.
+    assert overlap_fraction(1.5, 1.0, 0.5) == 0.0
+    # Halfway.
+    assert abs(overlap_fraction(1.25, 1.0, 0.5) - 0.5) < 1e-9
+    # Clipping.
+    assert overlap_fraction(2.0, 1.0, 0.5) == 0.0
+    assert overlap_fraction(0.9, 1.0, 0.5) == 1.0
+    # Efficiency: BASELINE's ≥0.9 bar shape.
+    assert abs(overlap_efficiency(1.1, 1.0, 0.8) - 1.0 / 1.1) < 1e-9
+
+
+def test_chip_spec_lookup():
+    assert chip_spec("TPU v5 lite").name == "tpu v5 lite"
+    assert chip_spec("TPU v5p chip").name == "tpu v5"
+    assert chip_spec("weird-device").name == "tpu v5 lite"  # fallback
+
+
+def test_chrome_trace(tmp_path):
+    tr = ChromeTrace()
+    x = jnp.ones((128, 128))
+    with tr.span("matmul", pid=0) as s:
+        s["block"] = jnp.dot(x, x)
+    with tr.span("add", pid=1):
+        pass
+    path = tr.save(os.fspath(tmp_path / "trace.json"))
+    data = json.load(open(path))
+    names = [e["name"] for e in data["traceEvents"]]
+    assert names == ["matmul", "add"]
+    assert all(e["dur"] >= 0 and e["ph"] == "X" for e in data["traceEvents"])
+
+
+def test_profile_op_xprof(tmp_path):
+    """XProf capture around a jitted op drops trace artifacts."""
+    d = os.fspath(tmp_path / "xprof")
+    profile_op(lambda a: jnp.dot(a, a), (jnp.ones((64, 64)),), d, iters=2)
+    found = [os.path.join(r, f) for r, _, fs in os.walk(d) for f in fs]
+    assert found, "profiler should write trace files"

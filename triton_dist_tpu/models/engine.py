@@ -163,9 +163,21 @@ class Engine:
         return KVCache(k=ks, v=vs, lengths=lengths)
 
     # ----------------------------------------------------------------- serve
-    def serve(self, input_ids: jax.Array, gen_len: int, key: jax.Array | None = None):
+    def serve(self, input_ids: jax.Array, gen_len: int, key: jax.Array | None = None,
+              profile_dir: str | None = None):
         """Generate ``gen_len`` tokens. Returns (B, gen_len) int32.
+        ``profile_dir`` wraps the run in an XProf capture (the reference's
+        ``trace_static.json`` export hook, ``engine.py:153-179``).
         Reference ``Engine.serve`` (``engine.py:113``)."""
+        if profile_dir is not None:
+            from triton_dist_tpu.tools.profiler import trace
+
+            with trace(profile_dir):
+                out = self.serve(input_ids, gen_len, key=key)
+                # Dispatch is async: realize inside the capture or the trace
+                # stops before the device work runs.
+                jax.block_until_ready(out)
+                return out
         model = self.model
         bsz, seq = input_ids.shape
         assert seq + gen_len <= self.max_len

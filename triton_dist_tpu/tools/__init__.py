@@ -19,6 +19,19 @@ GEMM tuner). TPU redesign notes:
 
 from triton_dist_tpu.tools.timing import bench_device_time
 from triton_dist_tpu.tools.tune import TuneCache, autotune, lookup, default_cache
+from triton_dist_tpu.tools.perf_model import (
+    ChipSpec,
+    chip_spec,
+    gemm_time_s,
+    attention_time_s,
+    allgather_time_s,
+    reduce_scatter_time_s,
+    allreduce_time_s,
+    all_to_all_time_s,
+    overlap_fraction,
+    overlap_efficiency,
+)
+from triton_dist_tpu.tools.profiler import ChromeTrace, annotate, profile_op, trace
 
 __all__ = [
     "bench_device_time",
@@ -26,4 +39,18 @@ __all__ = [
     "autotune",
     "lookup",
     "default_cache",
+    "ChipSpec",
+    "chip_spec",
+    "gemm_time_s",
+    "attention_time_s",
+    "allgather_time_s",
+    "reduce_scatter_time_s",
+    "allreduce_time_s",
+    "all_to_all_time_s",
+    "overlap_fraction",
+    "overlap_efficiency",
+    "ChromeTrace",
+    "annotate",
+    "profile_op",
+    "trace",
 ]

@@ -1,0 +1,96 @@
+"""Profiling: device op timelines (XProf/perfetto) + host span traces.
+
+Reference twofold:
+
+* Intra-kernel profiler (``tools/profiler/language.py:37-128``) — CUDA
+  kernels write (sm_id, task, globaltimer) records to a host buffer,
+  exported to perfetto. Mosaic exposes no cycle counter to Pallas kernels,
+  and it doesn't need to: **XLA's TPU profiler already records every op —
+  including each named Pallas kernel — on the device timeline** with
+  sub-kernel DMA/compute breakdowns. ``trace()`` wraps
+  ``jax.profiler.trace`` so a run drops a perfetto-compatible XProf capture;
+  ``annotate()`` scopes regions so fused steps are findable.
+* Host tracing (``profiler_utils.py:205-290`` ``group_profile``) — the
+  reference gathers per-rank torch traces to rank0 and merges them. JAX on
+  TPU is single-controller: one process drives every device, so one capture
+  *is* the merged trace. ``ChromeTrace`` additionally records host-measured
+  spans (block-until-ready walls) into a chrome://tracing JSON for
+  environments without XProf (e.g. the CPU sim).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+
+
+def trace(log_dir: str, **kw):
+    """Start an XProf capture (perfetto-compatible): context manager.
+    View with xprof/tensorboard or ui.perfetto.dev."""
+    import jax
+
+    return jax.profiler.trace(log_dir, **kw)
+
+
+def annotate(name: str):
+    """Named region on the profiler timeline (reference profiler spans)."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)
+
+
+class ChromeTrace:
+    """Host-measured span recorder → chrome://tracing JSON.
+
+    Spans are wall-clock with ``block_until_ready`` fencing — coarser than
+    XProf but dependency-free and sim-friendly. ``pid`` labels a logical
+    rank/stream so multi-op timelines read like the reference's merged
+    per-rank trace."""
+
+    def __init__(self):
+        self.events = []
+        self._t0 = time.perf_counter()
+
+    def _now_us(self) -> float:
+        return (time.perf_counter() - self._t0) * 1e6
+
+    @contextlib.contextmanager
+    def span(self, name: str, pid: int = 0, tid: int = 0, block=None):
+        """Record one span; ``block`` (a pytree) is block_until_ready'd
+        before closing so the span covers device completion."""
+        import jax
+
+        start = self._now_us()
+        out = {}
+        try:
+            yield out
+        finally:
+            if out.get("block") is not None:
+                jax.block_until_ready(out["block"])
+            elif block is not None:
+                jax.block_until_ready(block)
+            self.events.append({
+                "name": name, "ph": "X", "ts": start,
+                "dur": self._now_us() - start, "pid": pid, "tid": tid,
+            })
+
+    def save(self, path: str) -> str:
+        with open(path, "w") as f:
+            json.dump({"traceEvents": self.events, "displayTimeUnit": "ms"}, f)
+        return path
+
+
+def profile_op(fn, args, log_dir: str, iters: int = 3):
+    """Capture an XProf trace of ``iters`` runs of a jitted op; returns the
+    log dir (reference ``group_profile`` usage shape)."""
+    import jax
+
+    fn = jax.jit(fn) if not hasattr(fn, "lower") else fn
+    out = fn(*args)  # compile outside the capture
+    jax.block_until_ready(out)
+    with trace(log_dir):
+        for _ in range(iters):
+            out = fn(*args)
+        jax.block_until_ready(out)
+    return log_dir

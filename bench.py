@@ -198,6 +198,37 @@ def bench_overlap_model(on_tpu, flash_tflops):
     return out
 
 
+def bench_mega_decode(on_tpu):
+    """Megakernel decode step vs the XLA backend (reference megakernel.md's
+    headline table) — Qwen3-8B-width layers, single chip, bsz=1."""
+    from triton_dist_tpu.models import DenseLLM, ModelConfig
+    from triton_dist_tpu.models.engine import bench_decode_table
+    from triton_dist_tpu.runtime.mesh import initialize_distributed
+
+    if not on_tpu:
+        return {}
+    ctx = initialize_distributed(
+        axis_names=("tp",), devices=jax.devices()[:1], set_default=False
+    )
+    cfg = ModelConfig(
+        vocab_size=32768, hidden_size=4096, intermediate_size=12288,
+        num_layers=4, num_q_heads=32, num_kv_heads=8, head_dim=128,
+        dtype="bfloat16",
+    )
+    model = DenseLLM(cfg, ctx, key=jax.random.PRNGKey(0))
+    t = bench_decode_table(
+        model, backends=("xla", "mega"), bsz=1, prompt_len=64, iters=256, max_len=512
+    )
+    import math
+
+    out = {}
+    if math.isfinite(t["mega"]):
+        out["mega_decode_ms"] = round(t["mega"] * 1e3, 4)
+    if math.isfinite(t["xla"]) and math.isfinite(t["mega"]) and t["mega"] > 0:
+        out["mega_decode_vs_xla"] = round(t["xla"] / t["mega"], 3)
+    return out
+
+
 def main():
     on_tpu = jax.devices()[0].platform != "cpu"
     f = bench_flash(on_tpu)
@@ -215,6 +246,10 @@ def main():
         extra.update(bench_overlap_model(on_tpu, f["tflops"]))
     except Exception as e:  # noqa: BLE001
         extra["perf_model_error"] = f"{type(e).__name__}"
+    try:
+        extra.update(bench_mega_decode(on_tpu))
+    except Exception as e:  # noqa: BLE001
+        extra["mega_decode_error"] = f"{type(e).__name__}"
 
     print(
         json.dumps(

@@ -1,0 +1,55 @@
+"""Megakernel subsystem: model-as-task-graph → fused per-block kernels.
+
+Reference: ``python/triton_dist/mega_triton_kernel/`` (~8k LoC) — builds the
+model as a task graph (``core/graph.py:101``), schedules every op into ONE
+persistent CUDA kernel with inter-task scoreboard waits
+(``core/code_generator.py:101-180``), per-op TaskBuilders
+(``models/model_builder.py:216-336``); headline: Qwen3-32B decode 10.80 →
+7.41 ms (``docs/.../megakernel.md:31-35``).
+
+TPU redesign (SURVEY §7 hard-part (d)): TPU kernels can't persist across a
+model, and they don't need the reference's software scoreboard — XLA compiles
+the whole decode step into one executable whose op schedule *is* the
+dependency graph, and Mosaic double-buffers each kernel internally. What the
+reference's megakernel actually buys — no per-op launch gaps, no HBM
+round-trips for intermediates, weights read exactly once — maps to **fusing
+each decode block into a single Pallas kernel**:
+
+* ``fused_mlp_block`` — RMSNorm → gate/up matmuls → SwiGLU → down matmul in
+  ONE kernel: one sweep over the ff dimension, weight tiles streamed once,
+  zero intermediate HBM traffic (kernels.py).
+* ``fused_ln_qkv_rope`` — RMSNorm → fused QKV projection → per-head q/k
+  RMSNorm → RoPE in ONE kernel (kernels.py).
+
+``ModelBuilder`` (builder.py) assembles the per-layer task graph with the
+reference's ``make_*`` API, a greedy scheduler groups tasks into these fused
+kernels, and the generated step function runs under one jit — the XLA analog
+of the generated persistent kernel.
+
+Measured findings (v5e, 4×Qwen3-8B-width layers, bsz=1 decode, honest
+device-fenced timing):
+
+* Each fused kernel individually sits at the HBM roofline (fused MLP block
+  0.400 ms vs XLA MLP 0.393 ms vs roofline 0.369 ms) — decode is
+  weight-bandwidth-bound, and XLA's emitter is already optimal there, so
+  the megakernel's GPU-side win (launch-gap elimination) has no TPU analog
+  *within* one jit; the per-token win on TPU comes from the Engine's
+  on-device ``fori_loop`` decode (no host dispatch per token), which this
+  path shares.
+* Feeding Pallas kernels weight slices carved inside the step (lax.scan
+  over stacked layers, or sliced-in-loop) re-materializes every weight
+  every token — measured 2.7× slower. Hence ``split_layer_params``:
+  per-layer buffers are materialized once and passed whole.
+"""
+
+from triton_dist_tpu.megakernel.graph import Task, TaskGraph
+from triton_dist_tpu.megakernel.kernels import fused_ln_qkv_rope, fused_mlp_block
+from triton_dist_tpu.megakernel.builder import ModelBuilder
+
+__all__ = [
+    "Task",
+    "TaskGraph",
+    "fused_mlp_block",
+    "fused_ln_qkv_rope",
+    "ModelBuilder",
+]

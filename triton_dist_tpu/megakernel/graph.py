@@ -1,0 +1,102 @@
+"""Model-as-task-graph: tasks, dependencies, fusion-group scheduling.
+
+Reference: ``mega_triton_kernel/core/graph.py:101`` (task graph),
+``core/builder.py:34`` (per-op TaskBuilders), ``core/scheduler.py:103-157``
+(static round-robin / runtime work-queue scheduling). TPU: the graph's
+*execution* is compiled by XLA (data deps are the scoreboard — an op waits
+on its inputs, nothing else), so what remains load-bearing is (a) an
+auditable record of the model's op structure and (b) the **fusion grouping**
+deciding which task runs inside which generated Pallas kernel. The scheduler
+here greedily merges adjacent tasks into the known fusable group shapes
+(attn-front, mlp-block); everything else lowers to its standalone kernel.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass
+class Task:
+    """One op node (reference TaskBuilder output)."""
+
+    name: str
+    op: str  # "rmsnorm" | "linear" | "rope" | "cache_update" | ...
+    inputs: tuple[str, ...]
+    outputs: tuple[str, ...]
+    group: str | None = None  # fusion group id assigned by the scheduler
+
+
+# Chains the codegen knows how to fuse into one Pallas kernel, checked in
+# order (longest first). Reference analog: the generated kernel's
+# per-task-type dispatch (code_generator.py:158-166).
+FUSABLE_CHAINS = (
+    (("rmsnorm", "linear", "head_norm", "rope"), "attn_front"),
+    (("rmsnorm", "linear", "swiglu", "linear"), "mlp_block"),
+)
+
+
+class TaskGraph:
+    """Append-only task list + dependency validation + fusion scheduling."""
+
+    def __init__(self):
+        self.tasks: list[Task] = []
+        self._producers: dict[str, str] = {}
+
+    def add(self, task: Task) -> Task:
+        for out in task.outputs:
+            if out in self._producers:
+                raise ValueError(f"value {out!r} already produced by {self._producers[out]!r}")
+        for inp in task.inputs:
+            if inp not in self._producers and not inp.startswith(("param:", "input:")):
+                raise ValueError(f"task {task.name!r} consumes unproduced value {inp!r}")
+        for out in task.outputs:
+            self._producers[out] = task.name
+        self.tasks.append(task)
+        return task
+
+    def schedule(self) -> list[list[Task]]:
+        """Greedy fusion grouping: scan the (already topologically ordered —
+        builders append in dependency order) task list and merge maximal
+        chains matching FUSABLE_CHAINS; each group becomes one generated
+        kernel. Returns the grouped schedule and stamps task.group."""
+        groups: list[list[Task]] = []
+        i = 0
+        gid = 0
+        while i < len(self.tasks):
+            matched = False
+            for ops, gname in FUSABLE_CHAINS:
+                window = self.tasks[i : i + len(ops)]
+                if len(window) == len(ops) and all(
+                    t.op == o for t, o in zip(window, ops)
+                ):
+                    # The chain must be a straight line: each task feeds the
+                    # next (no external consumer would break fusion on TPU —
+                    # VMEM intermediates just aren't materialized).
+                    chained = all(
+                        set(window[j].outputs) & set(window[j + 1].inputs)
+                        for j in range(len(window) - 1)
+                    )
+                    if chained:
+                        g = f"{gname}:{gid}"
+                        for t in window:
+                            t.group = g
+                        groups.append(window)
+                        i += len(ops)
+                        gid += 1
+                        matched = True
+                        break
+            if not matched:
+                t = self.tasks[i]
+                t.group = f"{t.op}:{gid}"
+                groups.append([t])
+                i += 1
+                gid += 1
+        return groups
+
+    def summary(self) -> str:
+        lines = []
+        for g in self.schedule():
+            ops = "+".join(t.op for t in g)
+            lines.append(f"[{g[0].group}] {ops}")
+        return "\n".join(lines)

@@ -1,0 +1,206 @@
+"""Fused per-block decode kernels (the megakernel's generated groups).
+
+Reference: the megakernel's task types — rmsnorm/linear/activation fused into
+one persistent kernel per model (``mega_triton_kernel/tasks/*``,
+``core/code_generator.py:101-180``). TPU: one Pallas kernel per decode block;
+weights stream HBM→VMEM exactly once and no intermediate touches HBM.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from triton_dist_tpu.runtime.platform import interpret_mode_default
+
+
+def _rmsnorm_rows(x32: jax.Array, w32: jax.Array, eps: float, out_dtype):
+    """Qwen3 RMSNorm, matching layers.tp.RMSNorm bit-for-bit: normalize in
+    f32, cast to model dtype, THEN scale by the weight."""
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    normed = (x32 * jax.lax.rsqrt(var + eps)).astype(out_dtype)
+    return normed * w32.astype(out_dtype)
+
+
+def _mlp_block_kernel(x_ref, lnw_ref, wg_ref, wu_ref, wd_ref, o_ref, xn, acc,
+                      *, eps: float, n_f: int, residual: bool):
+    fi = pl.program_id(0)
+
+    @pl.when(fi == 0)
+    def _():
+        xn[...] = _rmsnorm_rows(
+            x_ref[...].astype(jnp.float32), lnw_ref[0], eps, xn.dtype
+        )
+        acc[...] = jnp.zeros_like(acc)
+
+    g = jnp.dot(xn[...], wg_ref[...], preferred_element_type=jnp.float32)
+    u = jnp.dot(xn[...], wu_ref[...], preferred_element_type=jnp.float32)
+    h = (jax.nn.silu(g) * u).astype(xn.dtype)
+    acc[...] += jnp.dot(h, wd_ref[...], preferred_element_type=jnp.float32)
+
+    @pl.when(fi == n_f - 1)
+    def _():
+        out = acc[...]
+        if residual:
+            out = out + x_ref[...].astype(jnp.float32)
+        o_ref[...] = out.astype(o_ref.dtype)
+
+
+def fused_mlp_block(
+    x: jax.Array,  # (B, d) block input (pre-norm residual stream)
+    ln_w: jax.Array,  # (d,)
+    w_gate: jax.Array,  # (d, ff)
+    w_up: jax.Array,  # (d, ff)
+    w_down: jax.Array,  # (ff, d)
+    *,
+    eps: float = 1e-6,
+    block_f: int = 512,
+    residual: bool = False,
+    vmem_limit_mb: int | None = 100,
+) -> jax.Array:
+    """RMSNorm → gate/up → SwiGLU → down in ONE kernel: a single sweep over
+    the ff dimension with the (B, d) f32 output accumulating in VMEM. Each
+    weight tile is read exactly once and no intermediate ever visits HBM —
+    the decode-MLP task group of the generated megakernel. Output is the
+    down-projection partial (caller all-reduces over tp); ``residual`` adds
+    x before the final cast (fusing the skip connection too)."""
+    from triton_dist_tpu.kernels.gemm import fit_block
+
+    b, d = x.shape
+    ff = w_gate.shape[1]
+    bf = fit_block(ff, block_f)
+    n_f = ff // bf
+
+    return pl.pallas_call(
+        functools.partial(_mlp_block_kernel, eps=eps, n_f=n_f, residual=residual),
+        grid=(n_f,),
+        in_specs=[
+            pl.BlockSpec((b, d), lambda fi: (0, 0)),
+            pl.BlockSpec((1, d), lambda fi: (0, 0)),
+            pl.BlockSpec((d, bf), lambda fi: (0, fi)),
+            pl.BlockSpec((d, bf), lambda fi: (0, fi)),
+            pl.BlockSpec((bf, d), lambda fi: (fi, 0)),
+        ],
+        out_specs=pl.BlockSpec((b, d), lambda fi: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, d), x.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((b, d), x.dtype),
+            pltpu.VMEM((b, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+            vmem_limit_bytes=vmem_limit_mb * 1024 * 1024 if vmem_limit_mb else None,
+        ),
+        interpret=interpret_mode_default(),
+        cost_estimate=pl.CostEstimate(
+            flops=6 * b * d * ff,
+            bytes_accessed=3 * d * ff * w_gate.dtype.itemsize + 2 * b * d * x.dtype.itemsize,
+            transcendentals=b * ff,
+        ),
+    )(x, ln_w.reshape(1, d), w_gate, w_up, w_down)
+
+
+def _ln_qkv_rope_kernel(x_ref, lnw_ref, w_ref, qn_ref, kn_ref, pos_ref,
+                        q_ref, k_ref, v_ref, *, eps, hq, hkv, hd, theta):
+    xn = _rmsnorm_rows(x_ref[...].astype(jnp.float32), lnw_ref[0], eps, x_ref.dtype)
+    # Round the projection to model dtype BEFORE the head norms — the layer
+    # path does (TP_Attn.decode: dot().astype(x.dtype) then _split_qkv), and
+    # bf16 parity with the other backends requires the same rounding point.
+    qkv = jnp.dot(xn, w_ref[...], preferred_element_type=jnp.float32).astype(
+        x_ref.dtype
+    ).astype(jnp.float32)  # (B, cols)
+
+    half = hd // 2
+    # Mosaic iota must be integer-typed; cast for the fp exponent.
+    iota = jax.lax.broadcasted_iota(jnp.int32, (1, half), 1).astype(jnp.float32)
+    freqs = theta ** (-iota / half)
+    angles = pos_ref[...].astype(jnp.float32) * freqs  # (B, half)
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+
+    def head_norm_rope(hh, nw, rope):
+        # hh (B, hd) f32; per-head RMSNorm then rotate-half RoPE, matching
+        # layers.tp._split_qkv + apply_rope exactly (norm before rope).
+        var = jnp.mean(hh * hh, axis=-1, keepdims=True)
+        # Product in model dtype (matches RMSNorm.__call__), then f32 rope.
+        hh = (
+            (hh * jax.lax.rsqrt(var + eps)).astype(x_ref.dtype)
+            * nw.astype(x_ref.dtype)
+        ).astype(jnp.float32)
+        if not rope:
+            return hh
+        x1, x2 = hh[:, :half], hh[:, half:]
+        return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=1)
+
+    # Static unroll over local heads (decode: a handful per rank).
+    for h in range(hq):
+        q_ref[:, h * hd:(h + 1) * hd] = head_norm_rope(
+            qkv[:, h * hd:(h + 1) * hd], qn_ref[0], True
+        ).astype(q_ref.dtype)
+    base = hq * hd
+    for h in range(hkv):
+        k_ref[:, h * hd:(h + 1) * hd] = head_norm_rope(
+            qkv[:, base + h * hd: base + (h + 1) * hd], kn_ref[0], True
+        ).astype(k_ref.dtype)
+    base = (hq + hkv) * hd
+    for h in range(hkv):
+        v_ref[:, h * hd:(h + 1) * hd] = qkv[:, base + h * hd: base + (h + 1) * hd].astype(v_ref.dtype)
+
+
+def fused_ln_qkv_rope(
+    x: jax.Array,  # (B, d)
+    ln_w: jax.Array,  # (d,)
+    wqkv: jax.Array,  # (d, (hq + 2*hkv) * hd)
+    q_norm: jax.Array,  # (hd,)
+    k_norm: jax.Array,  # (hd,)
+    pos: jax.Array,  # (B,) int32 absolute positions
+    *,
+    num_q_heads: int,
+    num_kv_heads: int,
+    head_dim: int,
+    rope_theta: float = 1e6,
+    eps: float = 1e-6,
+    vmem_limit_mb: int | None = 100,
+):
+    """RMSNorm → QKV projection → per-head q/k RMSNorm → RoPE in ONE kernel
+    (the attention-front task group). Returns q (B, hq·hd), k, v (B, hkv·hd)
+    flat — callers reshape to heads for the cache/attention (free in XLA)."""
+    b, d = x.shape
+    hq, hkv, hd = num_q_heads, num_kv_heads, head_dim
+    cols = (hq + 2 * hkv) * hd
+    assert wqkv.shape == (d, cols), (wqkv.shape, (d, cols))
+
+    q, k, v = pl.pallas_call(
+        functools.partial(
+            _ln_qkv_rope_kernel, eps=eps, hq=hq, hkv=hkv, hd=hd, theta=rope_theta
+        ),
+        grid=(1,),
+        in_specs=[
+            pl.BlockSpec((b, d), lambda i: (0, 0)),
+            pl.BlockSpec((1, d), lambda i: (0, 0)),
+            pl.BlockSpec((d, cols), lambda i: (0, 0)),
+            pl.BlockSpec((1, hd), lambda i: (0, 0)),
+            pl.BlockSpec((1, hd), lambda i: (0, 0)),
+            pl.BlockSpec((b, 1), lambda i: (0, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((b, hq * hd), lambda i: (0, 0)),
+            pl.BlockSpec((b, hkv * hd), lambda i: (0, 0)),
+            pl.BlockSpec((b, hkv * hd), lambda i: (0, 0)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((b, hq * hd), x.dtype),
+            jax.ShapeDtypeStruct((b, hkv * hd), x.dtype),
+            jax.ShapeDtypeStruct((b, hkv * hd), x.dtype),
+        ),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+            vmem_limit_bytes=vmem_limit_mb * 1024 * 1024 if vmem_limit_mb else None,
+        ),
+        interpret=interpret_mode_default(),
+    )(x, ln_w.reshape(1, d), wqkv, q_norm.reshape(1, hd), k_norm.reshape(1, hd),
+      pos.reshape(b, 1).astype(jnp.float32))
+    return q, k, v

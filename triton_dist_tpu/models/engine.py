@@ -30,7 +30,7 @@ from triton_dist_tpu.models.dense import DenseLLM
 from triton_dist_tpu.models.kv_cache import KVCache
 
 
-_BACKENDS = ("xla", "dist", "dist_ar")
+_BACKENDS = ("xla", "dist", "dist_ar", "mega")
 
 
 def sample_token(
@@ -75,8 +75,8 @@ class Engine:
         mesh = ctx.mesh
         axis = model.axis
 
-        prefill_mode = {"xla": "xla", "dist": "dist", "dist_ar": "dist_ar"}[backend]
-        decode_mode = {"xla": "xla", "dist": "dist_ar", "dist_ar": "dist_ar"}[backend]
+        prefill_mode = {"xla": "xla", "dist": "dist", "dist_ar": "dist_ar", "mega": "dist_ar"}[backend]
+        decode_mode = {"xla": "xla", "dist": "dist_ar", "dist_ar": "dist_ar", "mega": "mega"}[backend]
 
         p_specs = jax.tree.map(
             lambda s: s, modelspecs(model), is_leaf=lambda x: isinstance(x, P) or x is None
@@ -103,17 +103,51 @@ class Engine:
             )
         )
 
-        def decode_fn(params, token, ks, vs, lengths):
-            logits, ks, vs = model.decode_shard(params, token, ks, vs, lengths, decode_mode)
-            return jax.lax.all_gather(logits, axis, axis=1, tiled=True), ks, vs
+        if backend == "mega":
+            assert not model.config.is_moe, "mega backend supports dense MLP models"
+            # Pre-split per-layer params (see DenseLLM.split_layer_params:
+            # Pallas operands must be whole buffers, not loop-sliced views).
+            # NOTE: this keeps a second copy of the layer weights resident
+            # for the engine's lifetime (the stacked pytree still backs
+            # prefill) — the price of roofline decode.
+            self._mega_layers = model.split_layer_params()
+            # Per-layer specs = the stacked specs minus the leading L dim
+            # (derived, so DenseParams sharding changes can't drift).
+            from triton_dist_tpu.models.dense import _specs
 
-        self._decode_shard = jax.shard_map(
-            decode_fn, mesh=mesh,
-            in_specs=(p_specs, tok_spec, kv_spec, kv_spec, len_spec),
-            out_specs=(tok_spec, kv_spec, kv_spec),
-            check_vma=False,
-        )
-        self._decode = jax.jit(self._decode_shard, donate_argnums=(2, 3))
+            s = _specs(model.config)
+            stacked = {
+                "ln1": s.ln1, "wqkv": s.wqkv, "wo": s.wo, "q_norm": s.q_norm,
+                "k_norm": s.k_norm, "ln2": s.ln2, "mlp_gate": s.mlp_gate,
+                "mlp_up": s.mlp_up, "mlp_down": s.mlp_down,
+            }
+            lspec = {k: P(*v[1:]) if len(v) > 1 else P() for k, v in stacked.items()}
+            mega_specs = [dict(lspec) for _ in self._mega_layers]
+
+            def decode_fn(params, mega, token, ks, vs, lengths):
+                logits, ks, vs = model.decode_shard_mega(params, mega, token, ks, vs, lengths)
+                return jax.lax.all_gather(logits, axis, axis=1, tiled=True), ks, vs
+
+            sm = jax.shard_map(
+                decode_fn, mesh=mesh,
+                in_specs=(p_specs, mega_specs, tok_spec, kv_spec, kv_spec, len_spec),
+                out_specs=(tok_spec, kv_spec, kv_spec),
+                check_vma=False,
+            )
+            self._decode_shard = lambda p_, t_, k_, v_, l_: sm(
+                p_, self._mega_layers, t_, k_, v_, l_
+            )
+        else:
+            def decode_fn(params, token, ks, vs, lengths):
+                logits, ks, vs = model.decode_shard(params, token, ks, vs, lengths, decode_mode)
+                return jax.lax.all_gather(logits, axis, axis=1, tiled=True), ks, vs
+
+            self._decode_shard = jax.shard_map(
+                decode_fn, mesh=mesh,
+                in_specs=(p_specs, tok_spec, kv_spec, kv_spec, len_spec),
+                out_specs=(tok_spec, kv_spec, kv_spec),
+                check_vma=False,
+            )
 
         # One compiled program per gen_len: the whole decode loop on device
         # (the XLA analog of replaying a captured CUDA graph gen_len times,
@@ -199,22 +233,52 @@ class Engine:
         return out
 
     # ------------------------------------------------------------- profiling
-    def bench_decode(self, bsz: int = 1, prompt_len: int = 64, iters: int = 20):
+    def bench_decode(self, bsz: int = 1, prompt_len: int = 64, iters: int = 256,
+                     reps: int = 5):
         """Steady-state per-token decode latency (reference perf mode of
-        ``test_e2e_inference.py``)."""
+        ``test_e2e_inference.py``).
+
+        Times the on-device ``_generate`` loop — ``iters`` chained decode
+        steps in ONE dispatch, so per-call tunnel round-trips amortize to
+        ~zero — and subtracts the median 1-token wall (dispatch + cache-copy
+        overhead). Median-of-reps rejects shared-tenancy spikes. A naive
+        host loop of ``_decode`` calls would measure tunnel dispatch, not
+        the chip."""
         ids = jnp.zeros((bsz, prompt_len), jnp.int32)
         logits, ks, vs = self._prefill(self.model.params, ids)
         cache = self._make_cache(ks, vs, prompt_len)
         token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        ks, vs, lengths = cache.k, cache.v, cache.lengths
-        # warmup (compile)
-        logits, ks, vs = self._decode(self.model.params, token, ks, vs, lengths)
-        jax.block_until_ready(logits)
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            logits, ks, vs = self._decode(self.model.params, token, ks, vs, lengths)
-        jax.block_until_ready(logits)
-        return (time.perf_counter() - t0) / iters
+        key = jax.random.PRNGKey(0)
+
+        def run(n):
+            # _generate donates the caches: hand it fresh copies. The int()
+            # readback fences device execution — on a tunneled chip
+            # block_until_ready returns at dispatch completion (see
+            # tools.timing module doc), which would time nothing.
+            out, _, _ = self._generate(
+                self.model.params, token, jnp.copy(cache.k), jnp.copy(cache.v),
+                cache.lengths, n, key
+            )
+            return int(jnp.sum(out))
+
+        def median_wall(n):
+            walls = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                run(n)
+                walls.append(time.perf_counter() - t0)
+            walls.sort()
+            return walls[len(walls) // 2]
+
+        run(1)  # compile short
+        run(1 + iters)  # compile long
+        overhead = median_wall(1)
+        long_ = median_wall(1 + iters)
+        if long_ <= overhead:
+            # Shared-tenancy noise swamped the signal: unusable, never 0
+            # (callers would divide by it or report impossible 0 ms).
+            return float("inf")
+        return (long_ - overhead) / iters
 
 
 def bench_decode_table(model: DenseLLM, backends=_BACKENDS, bsz: int = 1,

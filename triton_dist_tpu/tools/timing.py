@@ -35,6 +35,50 @@ def _walltime(thunk) -> float:
 NOISE_FLOOR_S = 50e-3
 
 
+def bench_chain_diff(
+    run_of_n: Callable[[int], Callable[[], None]],
+    *,
+    iters: int = 256,
+    base: int = 64,
+    reps: int = 5,
+    max_iters: int = 16384,
+    noise_floor_s: float | None = None,
+) -> float:
+    """Generic escalating paired-difference timer: ``run_of_n(n)`` returns a
+    thunk executing n chained device iterations and fencing completion; the
+    per-iteration time is (long - short)/extra with PAIRED differences,
+    alternating measurement order, median-combined — the tunneled chip's
+    speed drifts on ~seconds timescales (shared tenancy), so a same-moment
+    pair cancels the drift and the median rejects outlier pairs. Below the
+    noise floor the chain length escalates ×4 (up to ``max_iters``); a
+    measurement that never clears the floor returns +inf so autotune sweeps
+    can never pick it. On a local (non-tunneled) CPU backend the floor is 0.
+    """
+    if noise_floor_s is None:
+        noise_floor_s = 0.0 if jax.devices()[0].platform == "cpu" else NOISE_FLOOR_S
+    short = run_of_n(base)
+    short()  # compile + warm once; base never changes
+    while True:
+        long_ = run_of_n(base + iters)
+        long_()
+        diffs = []
+        for r in range(reps):
+            if r % 2 == 0:
+                t_l = _walltime(long_)
+                t_s = _walltime(short)
+            else:
+                t_s = _walltime(short)
+                t_l = _walltime(long_)
+            diffs.append(t_l - t_s)
+        diffs.sort()
+        diff = diffs[len(diffs) // 2]
+        if diff > noise_floor_s:
+            return diff / iters
+        if iters >= max_iters:
+            return float("inf")
+        iters *= 4
+
+
 def bench_device_time(
     step: Callable,
     args: Sequence[jax.Array],
@@ -50,11 +94,8 @@ def bench_device_time(
     ``chain(out, args) -> args`` threads step N's output into step N+1's
     inputs (default: replace ``args[0]`` with ``clip(out, -1, 1)``, which fits
     self-shaped ops like square GEMMs and attention; the clip keeps chained
-    values finite). Pass a custom ``chain`` when shapes differ.
-
-    If the long-minus-short difference is below the noise floor the chain
-    length escalates (up to ``max_iters``); a measurement that never clears
-    the floor returns +inf so autotune sweeps can never pick it.
+    values finite). Pass a custom ``chain`` when shapes differ. See
+    :func:`bench_chain_diff` for the measurement discipline.
     """
     if chain is None:
         chain = lambda out, a: (jnp.clip(out, -1, 1).astype(a[0].dtype),) + tuple(a[1:])
@@ -71,31 +112,10 @@ def bench_device_time(
 
         return run
 
-    short = make(base)
-    float(short(*args))  # compile + warm once; base never changes
-    while True:
-        long_ = make(base + iters)
-        float(long_(*args))
-        # PAIRED differences, alternating measurement order, median-combined:
-        # the tunneled chip's speed drifts on ~seconds timescales (shared
-        # tenancy), so min-of-short vs min-of-long taken at different moments
-        # can produce faster-than-peak garbage. A same-moment pair cancels
-        # the drift; the median rejects outlier pairs.
-        diffs = []
-        for r in range(reps):
-            if r % 2 == 0:
-                t_l = _walltime(lambda: float(long_(*args)))
-                t_s = _walltime(lambda: float(short(*args)))
-            else:
-                t_s = _walltime(lambda: float(short(*args)))
-                t_l = _walltime(lambda: float(long_(*args)))
-            diffs.append(t_l - t_s)
-        diffs.sort()
-        diff = diffs[len(diffs) // 2]
-        if diff > NOISE_FLOOR_S:
-            return diff / iters
-        if iters >= max_iters:
-            # Even at the longest chain the diff never cleared the floor —
-            # jitter, not signal. +inf keeps autotune from ever picking it.
-            return float("inf")
-        iters *= 4
+    def run_of_n(n):
+        f = make(n)
+        return lambda: float(f(*args))
+
+    return bench_chain_diff(
+        run_of_n, iters=iters, base=base, reps=reps, max_iters=max_iters
+    )

@@ -83,7 +83,7 @@ def test_p2p_shift(ctx4, rng):
     x = jnp.asarray(rng.standard_normal((4 * 8, 128)), jnp.float32)
 
     def fn(xs):
-        return p2p_put_shard(xs, axis="tp", offset=1)
+        return p2p_put_shard(xs, "tp", 1)
 
     out = shard(ctx4, fn, (P("tp"),), P("tp"))(x)
     expect = np.roll(np.asarray(x).reshape(4, 8, 128), 1, axis=0).reshape(32, 128)

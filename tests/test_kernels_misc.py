@@ -1,0 +1,212 @@
+"""Inventory-closing kernels: varlen attention, fused Ulysses GEMM↔a2a, GDN,
+memory ops, 2D allgather.
+
+Parity model: reference ``test/nvidia`` per-kernel --check scripts.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+WORLD = 4
+
+
+def sm(ctx, fn, in_specs, out_specs):
+    return jax.jit(
+        jax.shard_map(fn, mesh=ctx.mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False)
+    )
+
+
+# ------------------------------------------------------------------- varlen
+
+
+def test_flash_attention_varlen(rng):
+    from triton_dist_tpu.kernels.flash_attn import flash_attention_varlen, attention_reference
+
+    hq, hkv, d = 4, 2, 32
+    lens = [48, 80, 33]
+    t = 256  # padded total (includes a padding tail)
+    cu = jnp.asarray(np.cumsum([0] + lens), jnp.int32)
+    q = jnp.asarray(rng.standard_normal((hq, t, d)), jnp.float32) * 0.3
+    k = jnp.asarray(rng.standard_normal((hkv, t, d)), jnp.float32) * 0.3
+    v = jnp.asarray(rng.standard_normal((hkv, t, d)), jnp.float32) * 0.3
+
+    out = np.asarray(
+        flash_attention_varlen(q, k, v, cu, block_q=64, block_k=64)
+    )
+
+    # Per-segment reference via the dense kernel reference.
+    start = 0
+    for L in lens:
+        seg = slice(start, start + L)
+        ref = attention_reference(
+            q[None, :, seg], k[None, :, seg], v[None, :, seg], causal=True
+        )[0]
+        np.testing.assert_allclose(
+            out[:, seg], np.asarray(ref), rtol=2e-4, atol=2e-4,
+            err_msg=f"segment at {start}+{L}",
+        )
+        start += L
+    # Padding tail rows produce zeros.
+    assert np.all(out[:, start:] == 0)
+
+
+# -------------------------------------------------------- fused ulysses a2a
+
+
+def test_gemm_a2a_and_a2a_gemm(ctx4, rng):
+    from triton_dist_tpu.kernels.sp import a2a_gemm_shard, gemm_a2a_shard
+
+    m, k, n = 8, 32, 64  # n splits into 4 peer chunks
+    x = jnp.asarray(rng.standard_normal((WORLD, m, k)), jnp.float32) * 0.3
+    w = jnp.asarray(rng.standard_normal((k, n)), jnp.float32) * 0.3
+
+    def fn(x_, w_):
+        return gemm_a2a_shard(x_[0], w_, axis="tp")[None]
+
+    out = np.asarray(sm(ctx4, fn, (P("tp"), P()), P("tp"))(x, w))
+    # out[r, j] = chunk rank j computed for rank r = x[j] @ w[:, r-block].
+    nc = n // WORLD
+    for r in range(WORLD):
+        for j in range(WORLD):
+            ref = np.asarray(x[j]) @ np.asarray(w[:, r * nc:(r + 1) * nc])
+            np.testing.assert_allclose(out[r, j], ref, rtol=1e-4, atol=1e-4)
+
+    # a2a_gemm: inverse composition — full matmul distributed over k chunks.
+    kc = k // WORLD
+    w2 = jnp.asarray(rng.standard_normal((k, n)), jnp.float32) * 0.3
+    xc = jnp.asarray(rng.standard_normal((WORLD, WORLD, m, kc)), jnp.float32) * 0.3
+
+    def fn2(xc_, w_):
+        return a2a_gemm_shard(xc_[0], w_, axis="tp")[None]
+
+    out2 = np.asarray(sm(ctx4, fn2, (P("tp"), P()), P("tp"))(xc, w2))
+    for r in range(WORLD):
+        # rank r receives chunk destined-to-r from each src s: xc[s, r]
+        gathered = np.concatenate([np.asarray(xc[s, r]) for s in range(WORLD)], axis=1)
+        np.testing.assert_allclose(gathered @ np.asarray(w2), out2[r], rtol=1e-4, atol=1e-4)
+
+
+def test_ulysses_fused_qkv_o_roundtrip(ctx4, rng):
+    """Fused QKV-gemm→a2a + attention + fused a2a→O-gemm == the unfused
+    Ulysses composition on gathered data."""
+    from triton_dist_tpu.kernels.sp import (
+        ulysses_o_a2a_gemm_shard, ulysses_qkv_gemm_a2a_shard,
+    )
+    from triton_dist_tpu.kernels.flash_attn import attention_reference
+
+    b, s_loc, d, hq, hkv, hd = 1, 16, 32, 4, 4, 32
+    s_full = WORLD * s_loc
+    x = jnp.asarray(rng.standard_normal((b, s_full, d)), jnp.float32) * 0.3
+    wqkv = jnp.asarray(rng.standard_normal((d, (hq + 2 * hkv) * hd)), jnp.float32) * 0.1
+    wo = jnp.asarray(rng.standard_normal((hq * hd, d)), jnp.float32) * 0.1
+
+    def fn(x_, wqkv_, wo_):
+        q, k, v = ulysses_qkv_gemm_a2a_shard(
+            x_, wqkv_, num_q_heads=hq, num_kv_heads=hkv, head_dim=hd, axis="tp"
+        )
+        # (B, S_full, H_local, D) → flash layout
+        from triton_dist_tpu.kernels.flash_attn import flash_attention
+
+        o = flash_attention(
+            q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3),
+            causal=True, block_q=64, block_k=64,
+        ).transpose(0, 2, 1, 3)
+        return ulysses_o_a2a_gemm_shard(o, wo_, axis="tp")
+
+    out = np.asarray(
+        sm(ctx4, fn, (P(None, "tp"), P(), P()), P(None, "tp"))(x, wqkv, wo)
+    )  # (B, S_full, d) gathered
+
+    # Reference: plain projections + attention, no sharding. The fused path's
+    # wqkv is head-GROUP-major: with hq=hkv=4 and world=4, group p = head p's
+    # [q|k|v] — build the reference by de-interleaving.
+    qkv = np.asarray(x) @ np.asarray(wqkv)  # (b, s, groups*(1+2)*hd)
+    qkv = qkv.reshape(b, s_full, WORLD, 3, hd)  # hq_l=hkv_l=1 per group
+    q = qkv[:, :, :, 0].transpose(0, 2, 1, 3)  # (b, H, S, D)
+    k = qkv[:, :, :, 1].transpose(0, 2, 1, 3)
+    v = qkv[:, :, :, 2].transpose(0, 2, 1, 3)
+    o = attention_reference(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal=True)
+    o = np.asarray(o).transpose(0, 2, 1, 3).reshape(b, s_full, hq * hd)
+    ref = o @ np.asarray(wo)
+    np.testing.assert_allclose(out, ref, rtol=2e-3, atol=2e-3)
+
+
+# ----------------------------------------------------------------------- gdn
+
+
+def test_gdn_fwd_matches_recurrence(rng):
+    from triton_dist_tpu.kernels.gdn import gdn_fwd, gdn_reference
+
+    h, t, dk, dv = 2, 128, 16, 32
+    q = jnp.asarray(rng.standard_normal((h, t, dk)), jnp.float32) * 0.3
+    k = jnp.asarray(rng.standard_normal((h, t, dk)), jnp.float32) * 0.3
+    v = jnp.asarray(rng.standard_normal((h, t, dv)), jnp.float32) * 0.3
+    alpha = jnp.asarray(0.8 + 0.2 * rng.random((h, t)), jnp.float32)
+    beta = jnp.asarray(rng.random((h, t)), jnp.float32) * 0.5
+
+    o, S = jax.jit(gdn_fwd)(q, k, v, alpha, beta)
+    ref = gdn_reference(q, k, v, alpha, beta)
+    np.testing.assert_allclose(np.asarray(o), ref, rtol=1e-4, atol=1e-4)
+    assert S.shape == (h, dk, dv)
+
+
+# ---------------------------------------------------------------- memory ops
+
+
+def test_memory_ops(rng):
+    from triton_dist_tpu.kernels.memory_ops import copy_tensor, fill
+
+    x = jnp.asarray(rng.standard_normal((64, 128)), jnp.float32)
+    np.testing.assert_array_equal(np.asarray(copy_tensor(x)), np.asarray(x))
+    x3 = jnp.asarray(rng.standard_normal((4, 32, 128)), jnp.float32)
+    np.testing.assert_array_equal(np.asarray(copy_tensor(x3)), np.asarray(x3))
+    f = fill((16, 128), 3.5, jnp.float32)
+    assert f.shape == (16, 128) and np.all(np.asarray(f) == 3.5)
+
+
+# ------------------------------------------------------------- 2D allgather
+
+
+def test_allgather_2d(rng):
+    """Hierarchical AG over a (2, 4) mesh: inner then outer."""
+    from triton_dist_tpu.runtime.platform import cpu_mesh
+    from triton_dist_tpu.runtime.mesh import initialize_distributed
+    from triton_dist_tpu.kernels.allgather import AllGatherMethod, all_gather_2d_shard
+
+    m = cpu_mesh((2, 4), ("dcn", "ici"))
+    ctx = initialize_distributed(
+        axis_names=("dcn", "ici"), axis_sizes=(2, 4),
+        devices=list(m.devices.flat), set_default=False,
+    )
+    x = jnp.asarray(rng.standard_normal((8, 16, 128)), jnp.float32)
+
+    def fn(x_):
+        # x_ is this rank's (1, 16, 128) row; gather → (wo=2, wi=4, 16, 128)
+        return all_gather_2d_shard(
+            x_[0], axes=("dcn", "ici"), mesh_axes=("dcn", "ici"),
+            method=AllGatherMethod.XLA,
+        )
+
+    out = np.asarray(
+        jax.jit(
+            jax.shard_map(
+                fn, mesh=ctx.mesh, in_specs=(P(("dcn", "ici")),),
+                out_specs=P(), check_vma=False,
+            )
+        )(x)
+    )
+    np.testing.assert_allclose(out, np.asarray(x).reshape(2, 4, 16, 128), rtol=1e-6, atol=1e-6)
+
+
+def test_memory_ops_unaligned(rng):
+    """Sizes not divisible by 128 take the padded lane view, not an (n,1)
+    per-element grid."""
+    from triton_dist_tpu.kernels.memory_ops import copy_tensor, fill
+
+    x = jnp.asarray(rng.standard_normal((7, 33)), jnp.float32)
+    np.testing.assert_array_equal(np.asarray(copy_tensor(x)), np.asarray(x))
+    f = fill((5, 13), -1.25, jnp.float32)
+    assert f.shape == (5, 13) and np.all(np.asarray(f) == -1.25)

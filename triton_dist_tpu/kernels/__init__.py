@@ -68,6 +68,26 @@ from triton_dist_tpu.kernels.gemm_allreduce import (
     gemm_ar_shard,
     gemm_ar,
 )
+from triton_dist_tpu.kernels.allgather import all_gather_2d_shard
+from triton_dist_tpu.kernels.flash_attn import flash_attention, flash_attention_varlen
+from triton_dist_tpu.kernels.flash_decode import flash_decode
+from triton_dist_tpu.kernels.gdn import gdn_fwd
+from triton_dist_tpu.kernels.memory_ops import copy_tensor, fill
+from triton_dist_tpu.kernels.low_latency_a2a import (
+    dequantize_fp8,
+    ep_moe_ll_shard,
+    ll_combine_shard,
+    ll_dispatch_shard,
+    quantize_fp8,
+)
+from triton_dist_tpu.kernels.sp import (
+    a2a_gemm_shard,
+    gemm_a2a_shard,
+    ring_attention_shard,
+    ulysses_attention_shard,
+    ulysses_o_a2a_gemm_shard,
+    ulysses_qkv_gemm_a2a_shard,
+)
 
 __all__ = [
     "barrier_all_on_device",
@@ -108,4 +128,22 @@ __all__ = [
     "create_gemm_ar_context",
     "gemm_ar_shard",
     "gemm_ar",
+    "all_gather_2d_shard",
+    "flash_attention",
+    "flash_attention_varlen",
+    "flash_decode",
+    "gdn_fwd",
+    "copy_tensor",
+    "fill",
+    "quantize_fp8",
+    "dequantize_fp8",
+    "ll_dispatch_shard",
+    "ll_combine_shard",
+    "ep_moe_ll_shard",
+    "a2a_gemm_shard",
+    "gemm_a2a_shard",
+    "ring_attention_shard",
+    "ulysses_attention_shard",
+    "ulysses_qkv_gemm_a2a_shard",
+    "ulysses_o_a2a_gemm_shard",
 ]

@@ -209,3 +209,22 @@ def all_gather(ag_ctx: AllGatherContext, x: jax.Array) -> jax.Array:
         fn, mesh=mesh, in_specs=P(axis), out_specs=P(), check_vma=False
     )
     return jax.jit(shard_f)(x)
+
+
+def all_gather_2d_shard(
+    x: jax.Array,
+    *,
+    axes: tuple[str, str],
+    mesh_axes=None,
+    method: AllGatherMethod = AllGatherMethod.AUTO,
+) -> jax.Array:
+    """Hierarchical 2D all-gather over two mesh axes: inner axis first (the
+    fast/ICI dimension), then outer (the slow/DCN dimension) — each outer
+    transfer carries the already-inner-gathered panel, so the slow axis moves
+    maximal-size messages exactly once (reference NUMA-aware 2D ring,
+    ``allgather.py:387-489``, and the push-2D low-latency variant,
+    ``low_latency_allgather.py``). Returns shards in (outer, inner) rank
+    order. Usable inside shard_map over both axes."""
+    outer, inner = axes
+    y = all_gather_shard(x, axis=inner, mesh_axes=mesh_axes, method=method)
+    return all_gather_shard(y, axis=outer, mesh_axes=mesh_axes, method=method)

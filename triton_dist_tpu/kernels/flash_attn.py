@@ -237,6 +237,121 @@ def flash_attention(
     return res.reshape(b, hq, sq, d)
 
 
+def _flash_varlen_kernel(
+    q_ref, k_ref, v_ref, qseg_ref, kseg_ref, o_ref,
+    acc_scr, m_scr, l_scr, *, scale, block_q, block_k, n_kv,
+):
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _():
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+
+    iq = pl.program_id(1)
+
+    # Packed-causal skip: same-segment keys are never ahead of the diagonal.
+    @pl.when(ik * block_k <= iq * block_q + block_q - 1)
+    def _():
+        q = q_ref[0]
+        k = k_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        q_ids = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        k_ids = ik * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        seg_q = qseg_ref[0].reshape(block_q, 1)  # (bq, 1)
+        seg_k = kseg_ref[0].reshape(1, block_k)  # (1, bk)
+        mask = jnp.logical_and(q_ids >= k_ids, seg_q == seg_k)
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_scr[...]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, jnp.broadcast_to(m_cur, m_prev.shape))
+        alpha = jnp.exp(m_prev - m_new)
+        # Mask again after the exp: on a fully-masked row m_new == NEG_INF
+        # and exp(s - m_new) would be exp(0) = 1, not 0.
+        p = jnp.where(mask, jnp.exp(s - m_new[:, :1]), 0.0)
+        l_scr[...] = l_scr[...] * alpha + jnp.broadcast_to(
+            jnp.sum(p, axis=1, keepdims=True), m_prev.shape
+        )
+        m_scr[...] = m_new
+        acc_scr[...] = acc_scr[...] * alpha[:, :1] + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(ik == n_kv - 1)
+    def _():
+        l = l_scr[:, :1]
+        l = jnp.where(l == 0.0, 1.0, l)  # padding rows → zero output
+        o_ref[0] = (acc_scr[...] / l).astype(o_ref.dtype)
+
+
+def flash_attention_varlen(
+    q: jax.Array,  # (Hq, T, D) — packed sequences, total length T
+    k: jax.Array,  # (Hkv, T, D)
+    v: jax.Array,  # (Hkv, T, D)
+    cu_seqlens: jax.Array,  # (N+1,) int32 monotonically increasing offsets
+    *,
+    scale: float | None = None,
+    block_q: int = 256,
+    block_k: int = 256,
+) -> jax.Array:
+    """Varlen (cu_seqlens) causal flash attention over packed sequences —
+    the reference's ``sp_ag_attention_intra_node.py`` varlen path. Tokens
+    attend causally within their own segment only; rows in padding segments
+    (beyond cu_seqlens[-1]) get zero output. Masking is data (segment-id
+    equality), so the program stays uniform across any SPMD callers."""
+    hq, t, d = q.shape
+    hkv = k.shape[0]
+    assert hq % hkv == 0
+    group = hq // hkv
+    scale = scale if scale is not None else d ** -0.5
+    block_q = fit_block(t, block_q)
+    block_k = fit_block(t, block_k)
+    n_kv = t // block_k
+
+    # Segment id per packed position; padding tail gets -1 (never matches
+    # a K segment because the Q row's own segment is also -1... it *does*
+    # match — so give Q padding -1 and K padding -2: no pair matches).
+    pos = jnp.arange(t, dtype=jnp.int32)
+    seg = jnp.searchsorted(cu_seqlens[1:], pos, side="right").astype(jnp.int32)
+    valid = pos < cu_seqlens[-1]
+    seg_q = jnp.where(valid, seg, -1).reshape(1, t)
+    seg_k = jnp.where(valid, seg, -2).reshape(1, t)
+
+    def kv_index(bh, iq_, ik_):
+        return bh // group, ik_, 0
+
+    return pl.pallas_call(
+        functools.partial(
+            _flash_varlen_kernel, scale=scale, block_q=block_q,
+            block_k=block_k, n_kv=n_kv,
+        ),
+        grid=(hq, t // block_q, n_kv),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0)),
+            pl.BlockSpec((1, block_k, d), kv_index),
+            pl.BlockSpec((1, block_k, d), kv_index),
+            pl.BlockSpec((1, block_q), lambda bh, iq, ik: (0, iq)),
+            pl.BlockSpec((1, block_k), lambda bh, iq, ik: (0, ik)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0)),
+        out_shape=jax.ShapeDtypeStruct((hq, t, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret_mode_default(),
+    )(q, k, v, seg_q, seg_k)
+
+
 def attention_reference(q, k, v, *, causal=True, scale=None):
     """Unfused reference (the torch-eager analog used by reference tests)."""
     b, hq, sq, d = q.shape

@@ -1,0 +1,91 @@
+"""Memory ops: tiled device copy / fill / strided-shard copy.
+
+Reference: ``python/triton_dist/kernels/nvidia/memory_ops.py`` (762 LoC) —
+vectorized/TMA copy & fill kernels + ``copy_tensor`` host API, used to stage
+tensors into symmetric buffers. TPU: Mosaic already emits optimal copies for
+``jnp`` assignments, so these exist for (a) explicit-buffer staging in
+kernels that want copies OUTSIDE the dependence graph (has_side_effects) and
+(b) measured-bandwidth probes (the copy kernel is the cleanest HBM-bandwidth
+yardstick a perf model can calibrate against).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from triton_dist_tpu.runtime.platform import interpret_mode_default
+
+
+def _copy_kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...]
+
+
+def _lane_view(flat: jax.Array):
+    """(n,) → lane-tiled (rows, 128) view, padding the tail if needed (an
+    (n, 1) fallback would degrade to per-element grid programs). Returns
+    (view, n) so callers can slice the pad back off."""
+    n = flat.shape[0]
+    pad = (-n) % 128
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    return flat.reshape((n + pad) // 128, 128), n
+
+
+def copy_tensor(x: jax.Array, *, block_rows: int = 1024) -> jax.Array:
+    """Tiled HBM→HBM copy through VMEM (reference ``copy_tensor``,
+    ``memory_ops.py:250-560``). 2D lane view; any array reshapes through it."""
+    from triton_dist_tpu.kernels.gemm import fit_block
+
+    shape = x.shape
+    flat, n = _lane_view(x.reshape(-1))
+    rows, cols = flat.shape
+    br = fit_block(rows, block_rows)
+
+    out = pl.pallas_call(
+        _copy_kernel,
+        grid=(rows // br,),
+        in_specs=[pl.BlockSpec((br, cols), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((br, cols), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((rows, cols), x.dtype),
+        interpret=interpret_mode_default(),
+    )(flat)
+    return out.reshape(-1)[:n].reshape(shape)
+
+
+def _fill_kernel(o_ref, *, value):
+    o_ref[...] = jnp.full_like(o_ref, value)
+
+
+def fill(shape, value, dtype=jnp.float32, *, block_rows: int = 1024) -> jax.Array:
+    """Tiled device fill (reference fill kernels)."""
+    from triton_dist_tpu.kernels.gemm import fit_block
+
+    import math
+
+    n = math.prod(shape)
+    rows = (n + 127) // 128  # lane-tiled with tail padding (see _lane_view)
+    br = fit_block(rows, block_rows)
+    out = pl.pallas_call(
+        functools.partial(_fill_kernel, value=value),
+        grid=(rows // br,),
+        out_specs=pl.BlockSpec((br, 128), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((rows, 128), dtype),
+        interpret=interpret_mode_default(),
+    )()
+    return out.reshape(-1)[:n].reshape(shape)
+
+
+def measured_copy_bandwidth_gbps(nbytes: int = 256 * 1024 * 1024) -> float:
+    """HBM bandwidth probe via the copy kernel (feeds perf-model
+    calibration). Returns GB/s moved (read + write)."""
+    from triton_dist_tpu.tools.timing import bench_device_time
+
+    n = nbytes // 4
+    x = jnp.arange(n, dtype=jnp.float32).reshape(n // 128, 128)
+    t = bench_device_time(copy_tensor, (x,), iters=16, base=4)
+    return 2 * nbytes / t / 1e9

@@ -32,11 +32,37 @@ def _p2p_kernel(x_ref, out_ref, send_sem, recv_sem, copy_sem, *, axis, mesh_axes
     tpl.barrier_all(axis, mesh_axes=mesh_axes)
 
 
+import functools as _functools
+
+
+@_functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
 def p2p_put_shard(
-    x: jax.Array, *, axis: str = "pp", offset: int = 1, mesh_axes=None, use_xla: bool = False
+    x: jax.Array, axis: str = "pp", offset: int = 1, mesh_axes=None, use_xla: bool = False
 ) -> jax.Array:
     """Shift shards by ``offset`` along the ring of ``axis``
-    (rank r's result = rank r-offset's input). Usable inside shard_map."""
+    (rank r's result = rank r-offset's input). Usable inside shard_map.
+
+    Differentiable: the transpose of shift-by-offset is shift-by-(-offset)
+    (grads ride the reverse ring — the backward pipeline's ``send_prev``),
+    defined here so every caller — PPCommLayer, gpipe — gets a VJP the
+    one-sided Pallas kernel can't derive itself."""
+    return _p2p_put_impl(x, axis=axis, offset=offset, mesh_axes=mesh_axes, use_xla=use_xla)
+
+
+def _p2p_fwd(x, axis, offset, mesh_axes, use_xla):
+    return p2p_put_shard(x, axis, offset, mesh_axes, use_xla), None
+
+
+def _p2p_bwd(axis, offset, mesh_axes, use_xla, _, g):
+    return (p2p_put_shard(g, axis, -offset, mesh_axes, use_xla),)
+
+
+p2p_put_shard.defvjp(_p2p_fwd, _p2p_bwd)
+
+
+def _p2p_put_impl(
+    x: jax.Array, *, axis: str = "pp", offset: int = 1, mesh_axes=None, use_xla: bool = False
+) -> jax.Array:
     world = jax.lax.axis_size(axis)
     if use_xla or world == 1:
         perm = [(i, (i + offset) % world) for i in range(world)]
@@ -60,7 +86,7 @@ def p2p_send_recv(ctx: DistContext, x: jax.Array, *, axis: str = "pp", offset: i
     mesh_axes = ctx.axis_names
 
     def fn(x_local):
-        return p2p_put_shard(x_local, axis=axis, offset=offset, mesh_axes=mesh_axes)
+        return p2p_put_shard(x_local, axis, offset, mesh_axes)
 
     shard_f = jax.shard_map(
         fn, mesh=ctx.mesh, in_specs=P(axis), out_specs=P(axis), check_vma=False

@@ -190,3 +190,107 @@ def ulysses_attention_shard(
         scale=scale,
     ).transpose(0, 2, 1, 3)
     return ulysses_a2a_out(o, axis=axis, mesh_axes=mesh_axes, use_pallas=use_pallas_a2a)
+
+
+# ------------------------------------------------- fused Ulysses GEMM ↔ a2a
+
+
+def gemm_a2a_shard(x: jax.Array, w: jax.Array, *, axis: str = "sp") -> jax.Array:
+    """Fused producer GEMM → a2a: ``w``'s columns are split into ``world``
+    peer chunks; chunk ``p`` of ``x @ w`` ships to peer ``p`` the moment its
+    GEMM finishes, hiding each hop behind the next chunk's MXU work
+    (reference ``sp_ulysess_qkv_gemm_all2all.py:545`` — the fused QKV-proj
+    producer). Returns (world, m, n/world): row ``j`` holds the chunk rank
+    ``j`` computed for this rank. Shard-local (inside shard_map)."""
+    world = jax.lax.axis_size(axis)
+    me = jax.lax.axis_index(axis)
+    m, k = x.shape
+    n = w.shape[1]
+    assert n % world == 0
+    nc = n // world
+
+    parts = []
+    for s in range(world):  # static unroll: GEMM s+1 hides the shift-s hop
+        dst = jnp.mod(me + s, world)
+        wc = jax.lax.dynamic_slice(w, (0, dst * nc), (k, nc))
+        g = jnp.dot(x, wc, preferred_element_type=jnp.float32).astype(x.dtype)
+        if s == 0:
+            parts.append(g)
+        else:
+            perm = [(i, (i + s) % world) for i in range(world)]
+            parts.append(jax.lax.ppermute(g, axis, perm))
+
+    # parts[s] was computed by rank (me - s) % world.
+    order = jnp.mod(me - jnp.arange(world), world)
+    return jnp.zeros((world, m, nc), x.dtype).at[order].set(jnp.stack(parts))
+
+
+def a2a_gemm_shard(x_chunks: jax.Array, w: jax.Array, *, axis: str = "sp") -> jax.Array:
+    """Fused a2a → consumer GEMM: ``x_chunks[p]`` (m, k/world) is this rank's
+    payload for peer ``p``; each arriving chunk immediately multiplies its
+    row-block of ``w`` and accumulates, so the reduction hides every hop
+    (reference ``sp_ulysess_o_all2all_gemm.py`` — the fused O-proj consumer).
+    Returns (m, n) = concat_k(a2a(x_chunks)) @ w. Shard-local."""
+    world = jax.lax.axis_size(axis)
+    me = jax.lax.axis_index(axis)
+    _, m, kc = x_chunks.shape
+    n = w.shape[1]
+
+    acc = jnp.zeros((m, n), jnp.float32)
+    for s in range(world):  # static unroll: hop s hides behind GEMM s-1
+        dst = jnp.mod(me + s, world)
+        sent = jax.lax.dynamic_index_in_dim(x_chunks, dst, axis=0, keepdims=False)
+        rec = sent if s == 0 else jax.lax.ppermute(
+            sent, axis, [(i, (i + s) % world) for i in range(world)]
+        )
+        src = jnp.mod(me - s, world)
+        wr = jax.lax.dynamic_slice(w, (src * kc, 0), (kc, n))
+        acc = acc + jnp.dot(rec, wr, preferred_element_type=jnp.float32)
+    return acc.astype(x_chunks.dtype)
+
+
+def ulysses_qkv_gemm_a2a_shard(
+    x: jax.Array,  # (B, S_local, d_model)
+    wqkv: jax.Array,  # (d_model, (hq+2·hkv)·hd), columns head-GROUP-major:
+    # group p holds its [q_p | k_p | v_p] columns contiguously
+    *,
+    num_q_heads: int,
+    num_kv_heads: int,
+    head_dim: int,
+    axis: str = "sp",
+):
+    """Fused QKV projection + seq→head a2a: returns head-sharded, full-seq
+    (q (B, S_full, hq_local, D), k, v (B, S_full, hkv_local, D))."""
+    world = jax.lax.axis_size(axis)
+    b, s_loc, d = x.shape
+    hq_l, hkv_l = num_q_heads // world, num_kv_heads // world
+    cols_l = (hq_l + 2 * hkv_l) * head_dim
+    recv = gemm_a2a_shard(x.reshape(b * s_loc, d), wqkv, axis=axis)
+    # (world, b·s_loc, cols_l) → (b, S_full, heads...) per-group split.
+    recv = recv.reshape(world, b, s_loc, cols_l).transpose(1, 0, 2, 3).reshape(
+        b, world * s_loc, hq_l + 2 * hkv_l, head_dim
+    )
+    return (
+        recv[:, :, :hq_l],
+        recv[:, :, hq_l:hq_l + hkv_l],
+        recv[:, :, hq_l + hkv_l:],
+    )
+
+
+def ulysses_o_a2a_gemm_shard(
+    o: jax.Array,  # (B, S_full, H_local, D) head-sharded attention output
+    wo: jax.Array,  # (H·D, d_model), rows head-GROUP-major
+    *,
+    axis: str = "sp",
+) -> jax.Array:
+    """Fused head→seq a2a + O projection: returns (B, S_local, d_model)."""
+    world = jax.lax.axis_size(axis)
+    b, s_full, h_loc, hd = o.shape
+    s_loc = s_full // world
+    chunks = (
+        o.reshape(b, world, s_loc, h_loc, hd)
+        .transpose(1, 0, 2, 3, 4)
+        .reshape(world, b * s_loc, h_loc * hd)
+    )
+    out = a2a_gemm_shard(chunks, wo, axis=axis)
+    return out.reshape(b, s_loc, -1)

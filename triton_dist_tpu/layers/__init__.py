@@ -10,6 +10,7 @@ torch-eager analog), ``"dist"`` (overlapped custom kernels), ``"dist_ar"``
 
 from triton_dist_tpu.layers.tp import TP_MLP, TP_Attn, TP_MoE, RMSNorm
 from triton_dist_tpu.layers.pp import PPCommLayer
+from triton_dist_tpu.layers.pp_schedule import gpipe_forward, gpipe_stage_params
 from triton_dist_tpu.layers.ep import EP_MoE
 from triton_dist_tpu.layers.sp import UlyssesSPAttn, RingSPAttn
 
@@ -19,6 +20,8 @@ __all__ = [
     "TP_MoE",
     "RMSNorm",
     "PPCommLayer",
+    "gpipe_forward",
+    "gpipe_stage_params",
     "EP_MoE",
     "UlyssesSPAttn",
     "RingSPAttn",

@@ -31,20 +31,8 @@ class PPCommLayer:
         """Push activations to stage+1; returns what stage-1 pushed to us
         (ring semantics — stage 0 receives stage N-1's output, which PP
         schedules ignore). Usable inside shard_map."""
-        return p2p_put_shard(
-            x,
-            axis=self.axis,
-            offset=1,
-            mesh_axes=self.mesh_axes,
-            use_xla=self.backend == "xla",
-        )
+        return p2p_put_shard(x, self.axis, 1, self.mesh_axes, self.backend == "xla")
 
     def send_prev(self, x: jax.Array) -> jax.Array:
         """Backward-pass direction (grads to stage-1)."""
-        return p2p_put_shard(
-            x,
-            axis=self.axis,
-            offset=-1,
-            mesh_axes=self.mesh_axes,
-            use_xla=self.backend == "xla",
-        )
+        return p2p_put_shard(x, self.axis, -1, self.mesh_axes, self.backend == "xla")

@@ -1,0 +1,86 @@
+"""Pipeline-parallel microbatch scheduling (GPipe) over the p2p transport.
+
+Reference: ``layers/nvidia/pp_block.py:36-245`` (``PyTorchP2P`` buffered
+send/recv + ``PPCommLayer``) and its tests' microbatched stage loops
+(``test/nvidia/test_pp.py``). TPU redesign: the schedule is ONE SPMD program
+unrolled over ``M + S - 1`` ticks — at tick ``t`` stage ``s`` works on
+microbatch ``m = t - s``; idle ticks run the same ops on masked data
+(uniform per-step program: divergent ``lax.cond`` branches starve collective
+rendezvous, the round-1 ring-attention lesson). Stage handoff is the
+``PPCommLayer`` ring shift (one-sided DMA or collective-permute), and the
+whole pipeline is differentiable — ``p2p_put_shard`` carries a custom VJP
+(transpose of shift-next is shift-prev), so ``jax.grad`` through the
+unrolled schedule yields the reversed-pipeline backward pass and GPipe
+training falls out of autodiff instead of a hand-scheduled 1F1B.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from triton_dist_tpu.layers.pp import PPCommLayer
+
+
+
+
+def gpipe_forward(
+    stage_fn: Callable,  # (x_mb (mb, d)) -> (mb, d); this rank's stage
+    x: jax.Array,  # (M, mb, d) microbatches — consumed by stage 0
+    *,
+    axis: str = "pp",
+    comm: PPCommLayer | None = None,
+) -> jax.Array:
+    """Run the GPipe forward schedule; returns the (M, mb, d) pipeline
+    output **on the last stage** (zeros elsewhere — callers broadcast or
+    keep outputs stage-local, matching the reference's last-rank gather).
+
+    Shard-local (inside shard_map over ``axis``). ``stage_fn`` must keep
+    the microbatch shape (transformer stages do); it runs on every tick —
+    masked ticks compute on zeros and their results are discarded.
+    """
+    comm = comm or PPCommLayer(axis=axis)
+    world = jax.lax.axis_size(axis)
+    me = jax.lax.axis_index(axis)
+    m_total, mb, d = x.shape
+    steps = m_total + world - 1
+
+    recv = jnp.zeros((mb, d), x.dtype)
+    out = jnp.zeros((m_total, mb, d), x.dtype)
+    for t in range(steps):  # static unroll: uniform program on every rank
+        m = t - me  # microbatch index this stage handles at tick t
+        active = jnp.logical_and(m >= 0, m < m_total)
+        m_idx = jnp.clip(m, 0, m_total - 1)
+        # Stage 0 injects fresh microbatches; later stages consume the wire.
+        inj = jax.lax.dynamic_index_in_dim(x, m_idx, axis=0, keepdims=False)
+        inp = jnp.where(me == 0, inj, recv)
+        y = stage_fn(inp)
+        y = jnp.where(active, y, jnp.zeros_like(y))
+        # Last stage records its finished microbatch.
+        take = jnp.logical_and(active, me == world - 1)
+        out = jax.lax.dynamic_update_index_in_dim(
+            out,
+            jnp.where(take, y, jax.lax.dynamic_index_in_dim(out, m_idx, 0, keepdims=False)),
+            m_idx,
+            axis=0,
+        )
+        if t + 1 < steps:
+            recv = comm.send_next(y)
+    return out
+
+
+def gpipe_stage_params(params: jax.Array, num_layers: int, axis: str = "pp"):
+    """Slice a stacked (L, ...) layer pytree to this stage's contiguous
+    layer block (L/S layers) — the standard PP layer partition."""
+    world = jax.lax.axis_size(axis)
+    me = jax.lax.axis_index(axis)
+    assert num_layers % world == 0, (
+        f"num_layers={num_layers} must divide over {world} pipeline stages "
+        "(trailing layers would silently be assigned to no stage)"
+    )
+    per = num_layers // world
+    return jax.tree.map(
+        lambda a: jax.lax.dynamic_slice_in_dim(a, me * per, per, axis=0), params
+    )

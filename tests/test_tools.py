@@ -97,3 +97,40 @@ def test_profile_op_xprof(tmp_path):
     profile_op(lambda a: jnp.dot(a, a), (jnp.ones((64, 64)),), d, iters=2)
     found = [os.path.join(r, f) for r, _, fs in os.walk(d) for f in fs]
     assert found, "profiler should write trace files"
+
+
+def test_topology_probe():
+    from triton_dist_tpu.runtime.topology import probe, ring_order, split_ici_dcn_axes
+    from triton_dist_tpu.runtime.platform import cpu_mesh
+
+    info = probe()
+    assert info.num_devices >= 1 and info.devices_per_process >= 1
+    order = ring_order()
+    assert sorted(order) == list(range(info.num_devices))
+    m = cpu_mesh((2, 4), ("a", "b"))
+    ici, dcn = split_ici_dcn_axes(m)
+    # Single-process CPU sim: every axis is intra-process (ICI).
+    assert set(ici) == {"a", "b"} and dcn == []
+
+
+def test_ring_order_one_hop_property():
+    """The snake walk yields single-hop neighbors on any torus shape."""
+    import itertools
+    from triton_dist_tpu.runtime.topology import TopologyInfo
+
+    import triton_dist_tpu.runtime.topology as topo
+
+    for shape in [(4, 4), (2, 2, 2), (2, 3, 4), (4, 4, 2), (2, 4, 2, 2)]:
+        coords = list(itertools.product(*[range(s) for s in shape]))
+
+        class FakeDev:
+            def __init__(self, c):
+                self.coords = c
+                self.device_kind = "fake"
+                self.process_index = 0
+
+        devs = [FakeDev(c) for c in coords]
+        order = topo.ring_order(devs)
+        for a, b in zip(order, order[1:]):
+            diff = sum(abs(x - y) for x, y in zip(coords[a], coords[b]))
+            assert diff == 1, (shape, coords[a], coords[b])

@@ -136,9 +136,9 @@ def ring_ag_concat(parts: list[jax.Array], axis: str) -> jax.Array:
     world = jax.lax.axis_size(axis)
     me = jax.lax.axis_index(axis)
     m, n = parts[0].shape
+    # (me - s) mod world is an involution: gather, not zeros+scatter.
     order = jnp.mod(me - jnp.arange(world), world)
-    out = jnp.zeros((world, m, n), parts[0].dtype).at[order].set(jnp.stack(parts))
-    return out.reshape(world * m, n)
+    return jnp.stack(parts)[order].reshape(world * m, n)
 
 
 def _ag_gemm_xla_ring(a, b, *, axis, accum_dtype=jnp.float32, return_gathered=False):

@@ -42,6 +42,8 @@ def copy_tensor(x: jax.Array, *, block_rows: int = 1024) -> jax.Array:
     from triton_dist_tpu.kernels.gemm import fit_block
 
     shape = x.shape
+    if x.size == 0:
+        return x
     flat, n = _lane_view(x.reshape(-1))
     rows, cols = flat.shape
     br = fit_block(rows, block_rows)
@@ -68,6 +70,8 @@ def fill(shape, value, dtype=jnp.float32, *, block_rows: int = 1024) -> jax.Arra
     import math
 
     n = math.prod(shape)
+    if n == 0:
+        return jnp.zeros(shape, dtype)
     rows = (n + 127) // 128  # lane-tiled with tail padding (see _lane_view)
     br = fit_block(rows, block_rows)
     out = pl.pallas_call(

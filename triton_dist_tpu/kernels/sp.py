@@ -220,9 +220,10 @@ def gemm_a2a_shard(x: jax.Array, w: jax.Array, *, axis: str = "sp") -> jax.Array
             perm = [(i, (i + s) % world) for i in range(world)]
             parts.append(jax.lax.ppermute(g, axis, perm))
 
-    # parts[s] was computed by rank (me - s) % world.
+    # parts[s] was computed by rank (me - s) % world; the permutation is
+    # an involution, so a gather places rows (cheaper than zeros+scatter).
     order = jnp.mod(me - jnp.arange(world), world)
-    return jnp.zeros((world, m, nc), x.dtype).at[order].set(jnp.stack(parts))
+    return jnp.stack(parts)[order]
 
 
 def a2a_gemm_shard(x_chunks: jax.Array, w: jax.Array, *, axis: str = "sp") -> jax.Array:
@@ -233,7 +234,9 @@ def a2a_gemm_shard(x_chunks: jax.Array, w: jax.Array, *, axis: str = "sp") -> ja
     Returns (m, n) = concat_k(a2a(x_chunks)) @ w. Shard-local."""
     world = jax.lax.axis_size(axis)
     me = jax.lax.axis_index(axis)
-    _, m, kc = x_chunks.shape
+    n_chunks, m, kc = x_chunks.shape
+    assert n_chunks == world, (n_chunks, world)  # clamped dynamic indexing
+    # would otherwise silently misroute chunks on a mismatched reshape
     n = w.shape[1]
 
     acc = jnp.zeros((m, n), jnp.float32)

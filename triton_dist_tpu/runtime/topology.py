@@ -1,0 +1,109 @@
+"""Topology probing: chip kind, mesh coordinates, ICI ring order.
+
+Reference: ``python/triton_dist/nv_utils.py:88-397`` — NVLink adjacency /
+full-mesh detection, link speeds, NUMA nodes via pynvml. TPU equivalent:
+the platform exposes topology through device attributes (``coords``,
+``device_kind``, process index) — no vendor library to bind; what matters
+downstream is (a) picking mesh axis *orders* whose neighbors are ICI
+neighbors (ring kernels assume ring_neighbor hops are single ICI hops) and
+(b) splitting ICI (intra-slice) from DCN (inter-process) axes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class TopologyInfo:
+    device_kind: str
+    num_devices: int
+    num_processes: int
+    devices_per_process: int
+    coords: tuple | None  # per-device torus coordinates, if exposed
+    ici_mesh_shape: tuple | None  # physical torus bounds, if derivable
+
+    @property
+    def has_torus_coords(self) -> bool:
+        return self.coords is not None
+
+
+def probe(devices=None) -> TopologyInfo:
+    """Probe the current platform (reference ``nv_topo`` probing)."""
+    import jax
+
+    devices = list(devices if devices is not None else jax.devices())
+    coords = None
+    mesh_shape = None
+    if all(hasattr(d, "coords") for d in devices):
+        try:
+            coords = tuple(tuple(d.coords) for d in devices)
+            dims = len(coords[0])
+            mesh_shape = tuple(
+                max(c[i] for c in coords) + 1 for i in range(dims)
+            )
+        except Exception:  # noqa: BLE001 — CPU/older backends lack coords
+            coords = None
+    n_proc = max((getattr(d, "process_index", 0) for d in devices), default=0) + 1
+    return TopologyInfo(
+        device_kind=devices[0].device_kind if devices else "none",
+        num_devices=len(devices),
+        num_processes=n_proc,
+        devices_per_process=len(devices) // max(n_proc, 1),
+        coords=coords,
+        ici_mesh_shape=mesh_shape,
+    )
+
+
+def ring_order(devices=None) -> list[int]:
+    """Device ordering whose consecutive entries are torus neighbors — the
+    order ring collectives should lay the mesh axis out in (reference
+    NUMA-aware ring ordering, ``nv_utils``/``utils.py:398-424``). Uses a
+    snake (boustrophedon) walk over the torus coords when available; falls
+    back to the default enumeration (already a ring on CPU sim)."""
+    import jax
+
+    devices = list(devices if devices is not None else jax.devices())
+    info = probe(devices)
+    if not info.has_torus_coords or len(devices) < 3:
+        return list(range(len(devices)))
+
+    # N-dimensional boustrophedon (reflected mixed-radix walk): dim d's
+    # direction reflects when the sum of the PHYSICAL outer coordinates is
+    # odd — consecutive entries then differ by exactly one along exactly
+    # one dim (one ICI hop) for any torus shape/rank, not just 2D
+    # (property-tested over 1D–4D shapes in test_tools).
+    dims = len(info.coords[0])
+    shape = info.ici_mesh_shape
+
+    def snake_key(i):
+        c = info.coords[i]
+        key = []
+        outer_sum = 0
+        for d in range(dims - 1, -1, -1):
+            v = c[d] if outer_sum % 2 == 0 else shape[d] - 1 - c[d]
+            key.append(v)
+            outer_sum += c[d]
+        return tuple(key)
+
+    return sorted(range(len(devices)), key=snake_key)
+
+
+def split_ici_dcn_axes(mesh) -> tuple[list[str], list[str]]:
+    """Which mesh axes stay inside one process (ICI) vs span processes
+    (DCN) — collectives should prefer ICI axes for bandwidth-bound legs
+    (SURVEY §7 hard-part (c): DCN legs go through XLA collectives)."""
+    import numpy as np
+
+    ici, dcn = [], []
+    dev_grid = mesh.devices
+    for ax, name in enumerate(mesh.axis_names):
+        moved = np.moveaxis(dev_grid, ax, 0)
+        first = moved[0].reshape(-1)
+        crosses = any(
+            moved[i].reshape(-1)[j].process_index != first[j].process_index
+            for i in range(moved.shape[0])
+            for j in range(first.size)
+        )
+        (dcn if crosses else ici).append(name)
+    return ici, dcn

@@ -77,7 +77,7 @@ def create_allgather_context(
 # --------------------------------------------------------------------- kernels
 
 
-def _ring_ag_kernel(x_ref, out_ref, send_sem, recv_sem, copy_sem, *, axis, mesh_axes):
+def _ring_ag_kernel(x_ref, out_ref, send_sem, recv_sem, copy_sem, *rest, axis, mesh_axes, straggler=None):
     """1D ring all-gather: out[(world, *shard)] filled in world-1 steps.
 
     Chunk flow: at step s, I send out[(me-s) % world] (received at step s-1,
@@ -87,6 +87,15 @@ def _ring_ag_kernel(x_ref, out_ref, send_sem, recv_sem, copy_sem, *, axis, mesh_
     me = tpl.rank(axis)
     world = tpl.num_ranks(axis)
     right = tpl.ring_neighbor(axis, +1, mesh_axes=mesh_axes)
+
+    if straggler is not None:
+        # Device-side straggler injection (reference straggler_option,
+        # allreduce.py:138): rank `straggler[0]` busy-waits before joining
+        # the protocol — the ring must tolerate the drift via its per-step
+        # semaphore slots, not lockstep.
+        @pl.when(jnp.equal(me, straggler[0]))
+        def _():
+            tpl.delay(rest[0], straggler[1])
 
     # Local shard into its slot (HBM→HBM local DMA).
     cp = pltpu.make_async_copy(x_ref, out_ref.at[me], copy_sem)
@@ -120,11 +129,16 @@ def _ring_ag_kernel(x_ref, out_ref, send_sem, recv_sem, copy_sem, *, axis, mesh_
     jax.lax.fori_loop(0, world - 1, step, 0)
 
 
-def _fullmesh_ag_kernel(x_ref, out_ref, send_sem, recv_sem, copy_sem, *, axis, mesh_axes):
+def _fullmesh_ag_kernel(x_ref, out_ref, send_sem, recv_sem, copy_sem, *rest, axis, mesh_axes, straggler=None):
     """Full-mesh push: put my shard to every peer's out[me] slot, then wait for
     world-1 arrivals (reference push producer ``allgather.py:82-148``)."""
     me = tpl.rank(axis)
     world = tpl.num_ranks(axis)
+
+    if straggler is not None:
+        @pl.when(jnp.equal(me, straggler[0]))
+        def _():
+            tpl.delay(rest[0], straggler[1])
 
     cp = pltpu.make_async_copy(x_ref, out_ref.at[me], copy_sem)
     cp.start()
@@ -152,21 +166,28 @@ def _fullmesh_ag_kernel(x_ref, out_ref, send_sem, recv_sem, copy_sem, *, axis, m
     jax.lax.fori_loop(1, world, wait_one, 0)
 
 
-def _ag_pallas(shard, *, axis, mesh_axes, method):
+def _ag_pallas(shard, *, axis, mesh_axes, method, straggler=None):
     world = jax.lax.axis_size(axis)
     kernel = _ring_ag_kernel if method is AllGatherMethod.RING_1D else _fullmesh_ag_kernel
-    out = dist_pallas_call(
-        functools.partial(kernel, axis=axis, mesh_axes=mesh_axes),
-        out_shape=jax.ShapeDtypeStruct((world, *shard.shape), shard.dtype),
-        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
-        out_specs=pl.BlockSpec(memory_space=pl.ANY),
-        scratch_shapes=[
+    sems = (
+        [
             pltpu.SemaphoreType.DMA((max(world - 1, 1),)),
             pltpu.SemaphoreType.DMA((max(world - 1, 1),)),
             pltpu.SemaphoreType.DMA,
         ]
         if kernel is _ring_ag_kernel
-        else [pltpu.SemaphoreType.DMA, pltpu.SemaphoreType.DMA, pltpu.SemaphoreType.DMA],
+        else [pltpu.SemaphoreType.DMA, pltpu.SemaphoreType.DMA, pltpu.SemaphoreType.DMA]
+    )
+    if straggler is not None:
+        # The delay scratch (and kernel arg) exists only under fault
+        # injection — production launches keep the pre-straggler signature.
+        sems = sems + [pltpu.VMEM((8, 128), jnp.float32)]
+    out = dist_pallas_call(
+        functools.partial(kernel, axis=axis, mesh_axes=mesh_axes, straggler=straggler),
+        out_shape=jax.ShapeDtypeStruct((world, *shard.shape), shard.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=sems,
     )(shard)
     return out
 
@@ -177,19 +198,25 @@ def all_gather_shard(
     axis: str = "tp",
     mesh_axes=None,
     method: AllGatherMethod = AllGatherMethod.AUTO,
+    straggler_option: tuple[int, int] | None = None,
 ) -> jax.Array:
     """All-gather the local ``shard`` over mesh ``axis`` → ``(world, *shard)``.
 
     Usable inside ``shard_map``. ``method=XLA`` lowers to
     ``jax.lax.all_gather`` (compiler-scheduled); other methods run the Pallas
-    one-sided-DMA kernels above.
+    one-sided-DMA kernels above. ``straggler_option=(rank, cycles)`` injects
+    a device-side busy-wait on one rank (reference ``straggler_option``,
+    ``allgather_gemm.py:539``) for protocol-robustness testing.
     """
     if method is AllGatherMethod.AUTO:
         nbytes = shard.size * shard.dtype.itemsize
         method = get_auto_all_gather_method(nbytes, jax.lax.axis_size(axis))
     if method is AllGatherMethod.XLA or jax.lax.axis_size(axis) == 1:
         return jax.lax.all_gather(shard, axis)
-    return _ag_pallas(shard, axis=axis, mesh_axes=mesh_axes, method=method)
+    return _ag_pallas(
+        shard, axis=axis, mesh_axes=mesh_axes, method=method,
+        straggler=straggler_option,
+    )
 
 
 def all_gather(ag_ctx: AllGatherContext, x: jax.Array) -> jax.Array:

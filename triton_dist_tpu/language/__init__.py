@@ -61,6 +61,7 @@ from triton_dist_tpu.language.core import (
     local_copy,
     barrier_all,
     quiet,
+    delay,
     semaphore_read,
 )
 
@@ -83,5 +84,6 @@ __all__ = [
     "local_copy",
     "barrier_all",
     "quiet",
+    "delay",
     "semaphore_read",
 ]

@@ -289,3 +289,27 @@ def quiet(dma_descriptors) -> None:
     (``libshmem_device.quiet``). On TPU: wait each descriptor's send leg."""
     for d in dma_descriptors:
         d.wait_send()
+
+
+# ------------------------------------------------- straggler / fault inject
+
+
+def delay(ref, cycles: int | jax.Array) -> None:
+    """Device-side busy-wait (straggler injection): ~``cycles`` dependent
+    VPU iterations, with the result folded back into ``ref`` as a float
+    no-op (x + (v - v)) so the loop can't be dead-code-eliminated.
+
+    The TPU analog of the reference's ``straggler_option`` device delay
+    (``kernels/nvidia/allreduce.py:138``, ``allgather_gemm.py:539``) —
+    skews one rank's progress inside the kernel so tests can verify the
+    semaphore protocol tolerates rank drift rather than depending on
+    lockstep."""
+    v0 = ref[...].astype(jnp.float32)
+
+    def body(_, a):
+        return a * 1.0000001 + 1e-7
+
+    out = jax.lax.fori_loop(0, cycles, body, v0)
+    # Float x + (out - out) is not foldable (NaN/inf semantics) but is a
+    # numerical no-op for finite values.
+    ref[...] = (v0 + (out - out)).astype(ref.dtype)

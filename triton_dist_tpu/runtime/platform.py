@@ -53,6 +53,38 @@ def is_cpu_platform() -> bool:
     return jax.devices()[0].platform == "cpu"
 
 
+_RACE_DETECTION = False
+
+
+def race_detection(enable: bool = True):
+    """Context manager turning on the interpret-mode race detector for every
+    ``pallas_call`` traced inside (the compute-sanitizer analog — reference
+    ``scripts/launch.sh:164-166``). CPU-sim only; a no-op on hardware.
+
+    The flag is read at TRACE time and does not participate in jit cache
+    keys, so entry/exit clears jax's compilation caches: functions re-trace
+    with the detector on inside the context, and re-trace without it after
+    — a cached pre-context executable would otherwise silently run
+    unchecked (and vice versa). Intended for tests, not hot loops."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def _ctx():
+        import jax
+
+        global _RACE_DETECTION
+        prev = _RACE_DETECTION
+        _RACE_DETECTION = enable
+        jax.clear_caches()
+        try:
+            yield
+        finally:
+            _RACE_DETECTION = prev
+            jax.clear_caches()
+
+    return _ctx()
+
+
 def interpret_mode_default(detect_races: bool = False):
     """Return the value for ``pallas_call(interpret=...)`` on this platform.
 
@@ -62,7 +94,7 @@ def interpret_mode_default(detect_races: bool = False):
     if is_cpu_platform():
         from jax.experimental.pallas import tpu as pltpu
 
-        return pltpu.InterpretParams(detect_races=detect_races)
+        return pltpu.InterpretParams(detect_races=detect_races or _RACE_DETECTION)
     return False
 
 

@@ -99,8 +99,8 @@ def straggler(rank: int, delay_ms: float):
     Delays process ``rank`` once, at context entry — offsetting the dispatch
     of whatever is issued inside the block to emulate a slow rank. For
     per-iteration straggling, re-enter per iteration; for *device-side*
-    straggling inside a kernel, see ``tpl`` delay support in kernels that
-    accept a ``straggler_option``.
+    straggling inside a kernel, pass ``straggler_option=(rank, cycles)`` to
+    ``all_gather_shard`` (``tpl.delay`` busy-waits on that rank in-kernel).
     """
     if jax.process_index() == rank:
         time.sleep(delay_ms / 1e3)

@@ -1,0 +1,26 @@
+"""Tutorials as tests (reference ``docs/testing.md:180-194`` — every tutorial
+is a runnable check). Each tutorial exposes ``main(ctx)``; running them
+in-process reuses the session's CPU-sim mesh instead of paying a fresh
+interpreter + backend boot per script."""
+
+import importlib.util
+import pathlib
+import sys
+
+import pytest
+
+TUTORIALS = sorted(
+    p for p in (pathlib.Path(__file__).parents[1] / "tutorials").glob("0*.py")
+)
+
+
+@pytest.mark.parametrize("path", TUTORIALS, ids=[p.stem for p in TUTORIALS])
+def test_tutorial(path, ctx8):
+    sys.path.insert(0, str(path.parent))  # main() imports tutorial_util lazily
+    try:
+        spec = importlib.util.spec_from_file_location(path.stem.replace("-", "_"), path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        mod.main(ctx8)
+    finally:
+        sys.path.pop(0)

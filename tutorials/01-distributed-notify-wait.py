@@ -1,0 +1,60 @@
+"""Tutorial 01 — one-sided put + signal + wait (the tpl device language).
+
+Reference: ``tutorials/01-distributed-notify-wait.py`` — NVSHMEM
+putmem_signal + ``dl.wait``/``consume_token``. TPU: a remote DMA carries its
+own completion semaphores; ``tpl.wait_recv`` is the ``dl.wait`` analog and
+the data dependence through the ref is ``consume_token`` (Mosaic orders it).
+
+Each rank pushes its buffer to its right neighbour, waits for the left
+neighbour's arrival, and adds 1 — result[r] = x[r-1] + 1.
+"""
+
+import functools
+
+
+def main(ctx):
+    import jax, jax.numpy as jnp, numpy as np  # noqa: E401
+    from jax.sharding import PartitionSpec as P
+    import triton_dist_tpu.language as tpl
+    from triton_dist_tpu.shmem.kernel import dist_pallas_call
+    from jax.experimental import pallas as pl
+
+    def kernel(x_ref, out_ref, send_sem, recv_sem, *, axis):
+        right = tpl.ring_neighbor(axis, +1)
+        # One-sided put of my whole buffer into my right neighbour's out.
+        dma = tpl.putmem_signal(x_ref, out_ref, send_sem, recv_sem, right, axis=axis)
+        dma.start()
+        # dl.wait analog: block until the LEFT neighbour's put landed here.
+        tpl.wait_recv(recv_sem, out_ref)
+        dma.wait_send()
+        tpl.barrier_all(axis)
+
+    world = ctx.num_ranks("tp")
+    x = jnp.arange(world * 8 * 128, dtype=jnp.float32).reshape(world, 8, 128)
+
+    def fn(xs):
+        from jax.experimental.pallas import tpu as pltpu
+
+        out = dist_pallas_call(
+            functools.partial(kernel, axis="tp"),
+            out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            scratch_shapes=[pltpu.SemaphoreType.DMA, pltpu.SemaphoreType.DMA],
+        )(xs[0])
+        return (out + 1.0)[None]
+
+    out = jax.jit(
+        jax.shard_map(fn, mesh=ctx.mesh, in_specs=(P("tp"),), out_specs=P("tp"),
+                      check_vma=False)
+    )(x)
+    expect = np.roll(np.asarray(x), 1, axis=0) + 1.0
+    np.testing.assert_allclose(np.asarray(out), expect)
+    print("tutorial 01 OK: ring put+signal+wait, result[r] = x[r-1] + 1")
+
+
+if __name__ == "__main__":
+    from tutorial_util import setup
+
+    ctx, *_ = setup()
+    main(ctx)

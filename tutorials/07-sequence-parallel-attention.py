@@ -1,0 +1,49 @@
+"""Tutorial 07 — long-context sequence parallelism: ring + Ulysses.
+
+Reference: the SP mechanisms of SURVEY §5 (``sp_ag_attention_*``,
+``ulysses_sp_dispatch``). TPU: the KV shard rotates the ICI ring with
+LSE-merged partials (uniform per-step masks — no divergent branches), or one
+a2a flips seq↔head sharding and attention runs unsharded per head group.
+"""
+
+
+def main(ctx):
+    import jax.numpy as jnp, numpy as np  # noqa: E401
+    from jax.sharding import PartitionSpec as P
+    from tutorial_util import shard_run
+    from triton_dist_tpu.kernels.flash_attn import attention_reference
+    from triton_dist_tpu.kernels.sp import ring_attention_shard, ulysses_attention_shard
+
+    world = ctx.num_ranks("tp")
+    b, s_loc, h, d = 1, 16, world, 32
+    s = world * s_loc
+    rng = np.random.default_rng(4)
+    q = jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.float32) * 0.3
+    k = jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.float32) * 0.3
+    v = jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.float32) * 0.3
+    ref = np.asarray(attention_reference(q, k, v, causal=True))
+
+    def ring_fn(q_, k_, v_):
+        return ring_attention_shard(q_, k_, v_, axis="tp", causal=True)
+
+    out = shard_run(ctx, ring_fn, (P(None, None, "tp"),) * 3, P(None, None, "tp"), q, k, v)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4, atol=2e-4)
+    print("tutorial 07 OK: ring attention == global causal softmax")
+
+    def uly_fn(q_, k_, v_):
+        o = ulysses_attention_shard(
+            q_.transpose(0, 2, 1, 3), k_.transpose(0, 2, 1, 3), v_.transpose(0, 2, 1, 3),
+            axis="tp", causal=True,
+        )
+        return o.transpose(0, 2, 1, 3)
+
+    out = shard_run(ctx, uly_fn, (P(None, None, "tp"),) * 3, P(None, None, "tp"), q, k, v)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4, atol=2e-4)
+    print("tutorial 07 OK: Ulysses a2a attention == global causal softmax")
+
+
+if __name__ == "__main__":
+    from tutorial_util import setup
+
+    ctx, *_ = setup()
+    main(ctx)

@@ -1,0 +1,30 @@
+"""Shared tutorial bootstrap: run on an 8-device CPU sim by default (the
+reference launches tutorials under torchrun; here one process simulates the
+mesh — README "Testing substrate")."""
+
+from __future__ import annotations
+
+
+def setup(n_devices: int = 8):
+    """Must run before any jax import work. Returns (ctx, jax, jnp, np, P)."""
+    from triton_dist_tpu.runtime.platform import use_cpu_devices
+
+    use_cpu_devices(n_devices)
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from triton_dist_tpu.runtime.mesh import initialize_distributed
+
+    ctx = initialize_distributed(axis_names=("tp",))
+    return ctx, jax, jnp, np, P
+
+
+def shard_run(ctx, fn, in_specs, out_specs, *args):
+    import jax
+
+    return jax.jit(
+        jax.shard_map(fn, mesh=ctx.mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_vma=False)
+    )(*args)

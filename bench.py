@@ -212,12 +212,12 @@ def bench_mega_decode(on_tpu):
     )
     cfg = ModelConfig(
         vocab_size=32768, hidden_size=4096, intermediate_size=12288,
-        num_layers=4, num_q_heads=32, num_kv_heads=8, head_dim=128,
+        num_layers=1, num_q_heads=32, num_kv_heads=8, head_dim=128,
         dtype="bfloat16",
     )
     model = DenseLLM(cfg, ctx, key=jax.random.PRNGKey(0))
     t = bench_decode_table(
-        model, backends=("xla", "mega"), bsz=1, prompt_len=64, iters=256, max_len=512
+        model, backends=("xla", "mega"), bsz=1, prompt_len=64, iters=64, max_len=192
     )
     import math
 
@@ -230,11 +230,54 @@ def bench_mega_decode(on_tpu):
 
 
 def main():
+    import os
+    import time
+
+    # Soft wall-clock budget: a degraded/shared-tenancy tunnel can stretch
+    # any section 10×; the primary metric must still print one JSON line
+    # inside the driver's window. Extras are ordered cheapest-first and
+    # skipped (flagged) once the budget is spent.
+    budget_s = float(os.environ.get("TDT_BENCH_BUDGET_S", "420"))
+    t_start = time.monotonic()
+
+    def remaining():
+        return budget_s - (time.monotonic() - t_start)
+
+    extra = {}
+    # Heaviest section FIRST, in a subprocess, BEFORE this process touches
+    # the device: on an exclusively-held chip a child client couldn't
+    # initialize once the parent owns it, and on a tunneled chip the child's
+    # remote-compile round-trips need a HARD timeout (the in-process budget
+    # can only check between sections). The child reports its own platform.
+    import subprocess
+    import sys
+
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import json, jax, bench; on_tpu = jax.devices()[0].platform != 'cpu';"
+             "out = bench.bench_mega_decode(on_tpu) if on_tpu else {'mega_decode_skipped': 'cpu'};"
+             "print(json.dumps(out))"],
+            capture_output=True, text=True, timeout=max(budget_s * 0.45, 60),
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        if r.returncode == 0 and r.stdout.strip():
+            extra.update(json.loads(r.stdout.strip().splitlines()[-1]))
+        else:
+            tail = (r.stderr or "").strip().splitlines()[-1:] or [""]
+            extra["mega_decode_error"] = f"rc={r.returncode}: {tail[0][:120]}"
+    except subprocess.TimeoutExpired:
+        extra["mega_decode_skipped"] = "timeout"
+    except Exception as e:  # noqa: BLE001
+        extra["mega_decode_error"] = f"{type(e).__name__}"
+
     on_tpu = jax.devices()[0].platform != "cpu"
     f = bench_flash(on_tpu)
-    extra = {}
     for name, fn in (("gemm", bench_gemm), ("gemm_swiglu", bench_swiglu),
                      ("ag_gemm_fused_w1", bench_ag_gemm_world1)):
+        if remaining() < 60:
+            extra[f"{name}_skipped"] = "budget"
+            continue
         try:
             r = fn(on_tpu)
             extra[f"{name}_tflops"] = round(r["tflops"], 2)
@@ -246,10 +289,6 @@ def main():
         extra.update(bench_overlap_model(on_tpu, f["tflops"]))
     except Exception as e:  # noqa: BLE001
         extra["perf_model_error"] = f"{type(e).__name__}"
-    try:
-        extra.update(bench_mega_decode(on_tpu))
-    except Exception as e:  # noqa: BLE001
-        extra["mega_decode_error"] = f"{type(e).__name__}"
 
     print(
         json.dumps(

@@ -1,0 +1,90 @@
+"""HF checkpoint loading (AutoLLM analog): synth checkpoint → sharded params.
+
+Parity model: the reference loads HF safetensors and extracts per-rank
+shards (``models/__init__.py:33-60``); the strongest correctness check is
+TP-invariance — the same checkpoint must generate identical tokens at
+world=1 and world=4 (any error in the fused-QKV column reorder or sharding
+breaks this).
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def hf_checkpoint(tmp_path_factory):
+    """A tiny Qwen3-style safetensors checkpoint on disk."""
+    from safetensors.numpy import save_file
+
+    path = tmp_path_factory.mktemp("hf_ckpt")
+    rng = np.random.default_rng(0)
+    V, d, ff, L, hq, hkv, hd = 128, 32, 64, 2, 4, 4, 8
+    cfg = {
+        "vocab_size": V, "hidden_size": d, "intermediate_size": ff,
+        "num_hidden_layers": L, "num_attention_heads": hq,
+        "num_key_value_heads": hkv, "head_dim": hd, "rope_theta": 1e4,
+        "rms_norm_eps": 1e-6, "tie_word_embeddings": False,
+    }
+    (path / "config.json").write_text(json.dumps(cfg))
+
+    def w(*shape, scale=0.1):
+        return (rng.standard_normal(shape) * scale).astype(np.float32)
+
+    sd = {
+        "model.embed_tokens.weight": w(V, d, scale=0.02),
+        "model.norm.weight": np.ones(d, np.float32),
+        "lm_head.weight": w(V, d),
+    }
+    for i in range(L):
+        pre = f"model.layers.{i}."
+        sd[pre + "self_attn.q_proj.weight"] = w(hq * hd, d)
+        sd[pre + "self_attn.k_proj.weight"] = w(hkv * hd, d)
+        sd[pre + "self_attn.v_proj.weight"] = w(hkv * hd, d)
+        sd[pre + "self_attn.o_proj.weight"] = w(d, hq * hd)
+        sd[pre + "self_attn.q_norm.weight"] = np.ones(hd, np.float32)
+        sd[pre + "self_attn.k_norm.weight"] = np.ones(hd, np.float32)
+        sd[pre + "input_layernorm.weight"] = np.ones(d, np.float32)
+        sd[pre + "post_attention_layernorm.weight"] = np.ones(d, np.float32)
+        sd[pre + "mlp.gate_proj.weight"] = w(ff, d)
+        sd[pre + "mlp.up_proj.weight"] = w(ff, d)
+        sd[pre + "mlp.down_proj.weight"] = w(d, ff)
+    save_file(sd, os.fspath(path / "model.safetensors"))
+    return os.fspath(path)
+
+
+def _engine_for(path, n_devices):
+    from triton_dist_tpu.models import Engine
+    from triton_dist_tpu.models.weights import AutoLLM
+    from triton_dist_tpu.runtime.mesh import initialize_distributed
+
+    ctx = initialize_distributed(
+        axis_names=("tp",), devices=jax.devices()[:n_devices], set_default=False
+    )
+    # The public entry point (class dispatch + dtype plumbing included).
+    model = AutoLLM.from_pretrained(path, ctx, dtype="float32")
+    return Engine(model, backend="xla", max_len=16), model.config, model.params
+
+
+def test_config_and_shapes(hf_checkpoint):
+    eng, cfg, params = _engine_for(hf_checkpoint, 1)
+    assert cfg.num_layers == 2 and cfg.head_dim == 8
+    assert params.wqkv.shape == (2, 32, (4 + 2 * 4) * 8)
+    assert params.embed.shape == (128, 32)
+    # lm_head is transposed to (d, V) matmul layout.
+    assert params.lm_head.shape == (32, 128)
+
+
+def test_tp_invariance(hf_checkpoint):
+    """world=1 and world=4 loads of the same checkpoint generate identical
+    tokens — validates the fused-QKV head reorder + all TP shardings."""
+    ids = jnp.asarray([[3, 17, 42, 7]], jnp.int32)
+    eng1, _, _ = _engine_for(hf_checkpoint, 1)
+    eng4, _, _ = _engine_for(hf_checkpoint, 4)
+    out1 = np.asarray(eng1.serve(ids, gen_len=5))
+    out4 = np.asarray(eng4.serve(ids, gen_len=5))
+    np.testing.assert_array_equal(out1, out4)

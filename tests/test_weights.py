@@ -15,6 +15,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytest.importorskip("safetensors")  # optional dep (ships with transformers)
+
 
 @pytest.fixture(scope="module")
 def hf_checkpoint(tmp_path_factory):
@@ -57,7 +59,11 @@ def hf_checkpoint(tmp_path_factory):
     return os.fspath(path)
 
 
-def _engine_for(path, n_devices):
+import functools
+
+
+@functools.lru_cache(maxsize=None)
+def _cached_engine(path, n_devices):
     from triton_dist_tpu.models import Engine
     from triton_dist_tpu.models.weights import AutoLLM
     from triton_dist_tpu.runtime.mesh import initialize_distributed
@@ -68,6 +74,12 @@ def _engine_for(path, n_devices):
     # The public entry point (class dispatch + dtype plumbing included).
     model = AutoLLM.from_pretrained(path, ctx, dtype="float32")
     return Engine(model, backend="xla", max_len=16), model.config, model.params
+
+
+def _engine_for(path, n_devices):
+    # Cached per world size: both tests reuse the world=1 build (the
+    # checkpoint load + serve() trace is the expensive part on the sim).
+    return _cached_engine(path, n_devices)
 
 
 def test_config_and_shapes(hf_checkpoint):

@@ -10,6 +10,7 @@ breaks this).
 import json
 import os
 
+import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -59,11 +60,10 @@ def hf_checkpoint(tmp_path_factory):
     return os.fspath(path)
 
 
-import functools
-
-
 @functools.lru_cache(maxsize=None)
-def _cached_engine(path, n_devices):
+def _engine_for(path, n_devices):
+    """Cached per world size: both tests reuse the world=1 build (the
+    checkpoint load + serve() trace is the expensive part on the sim)."""
     from triton_dist_tpu.models import Engine
     from triton_dist_tpu.models.weights import AutoLLM
     from triton_dist_tpu.runtime.mesh import initialize_distributed
@@ -74,12 +74,6 @@ def _cached_engine(path, n_devices):
     # The public entry point (class dispatch + dtype plumbing included).
     model = AutoLLM.from_pretrained(path, ctx, dtype="float32")
     return Engine(model, backend="xla", max_len=16), model.config, model.params
-
-
-def _engine_for(path, n_devices):
-    # Cached per world size: both tests reuse the world=1 build (the
-    # checkpoint load + serve() trace is the expensive part on the sim).
-    return _cached_engine(path, n_devices)
 
 
 def test_config_and_shapes(hf_checkpoint):

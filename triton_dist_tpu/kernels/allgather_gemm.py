@@ -76,12 +76,19 @@ def _fused_tiles(m: int, k: int, n: int, dtype, config=None):
     from triton_dist_tpu.kernels.gemm import fit_block
 
     itemsize = jnp.dtype(dtype).itemsize
+    # Default tiles measured on v5e (4096³ bf16, world=1): (512, 512, 1024)
+    # runs 160 TFLOP/s vs 126 for (256, 512, 512) — the wider K-tile halves
+    # accumulator flushes and the taller M-panel amortizes panel staging.
     want_m, want_n, want_k = (
-        (config.block_m, config.block_n, config.block_k) if config else (256, 512, 512)
+        (config.block_m, config.block_n, config.block_k) if config else (512, 512, 1024)
     )
     bn, bk = fit_block(n, want_n), fit_block(k, want_k)
     bm = fit_block(m, want_m)
-    budget = 12 * 1024 * 1024
+    # Mosaic's scoped-VMEM hard limit is 16 MiB and the estimate below
+    # undercounts (fp32 dot temporary, a_tile staging, compiler-internal
+    # buffers) — keep ~2.5 MiB headroom so near-limit shapes fall back to
+    # XLA_RING instead of failing compile with no recourse.
+    budget = 13 * 1024 * 1024 + 512 * 1024
     while True:
         need = (
             2 * bm * k * itemsize  # double-buffered A row panel

@@ -275,6 +275,11 @@ def _gemm_rs_fused_kernel(
 
 def _gemm_rs_fused(a, b, *, axis, mesh_axes, config=None):
     world = jax.lax.axis_size(axis)
+    # The ring's final drain waits on the step-(world-2) send and its
+    # credit; at world=1 neither is ever signaled — the kernel would
+    # deadlock (and crash the TPU watchdog). Callers go through
+    # gemm_rs_shard's world==1 shortcut.
+    assert world > 1, "fused GEMM-RS needs world > 1 (use gemm_rs_shard)"
     me = jax.lax.axis_index(axis)
     m, k = a.shape
     n = b.shape[1]
@@ -282,7 +287,9 @@ def _gemm_rs_fused(a, b, *, axis, mesh_axes, config=None):
     chunk = m // world
     from triton_dist_tpu.kernels.gemm import fit_block
 
-    cfg = config or GemmConfig(256, 512, 512)
+    # Same tile shape the fused AG-GEMM measured fastest on v5e (wider
+    # K-tile halves accumulator flushes); VMEM need ≈9 MiB at these tiles.
+    cfg = config or GemmConfig(512, 512, 1024)
     bm = fit_block(chunk, cfg.block_m)
     bn = fit_block(n, cfg.block_n)
     bk = fit_block(k, cfg.block_k)

@@ -180,3 +180,85 @@ def test_ep_moe_fused_grad(ctx8, rng, use_pallas):
         np.testing.assert_allclose(
             np.asarray(g_), np.asarray(r_), rtol=2e-4, atol=2e-4, err_msg=name
         )
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_attention_grad(rng, causal):
+    """flash_attention_fn's chunked-recompute backward matches autodiff of
+    the dense attention composition (GQA included)."""
+    from triton_dist_tpu.function import flash_attention_fn
+    from triton_dist_tpu.kernels.flash_attn import attention_reference
+
+    b, hq, hkv, s, d = 1, 4, 2, 64, 16
+    q = jnp.asarray(rng.standard_normal((b, hq, s, d)), jnp.float32) * 0.3
+    k = jnp.asarray(rng.standard_normal((b, hkv, s, d)), jnp.float32) * 0.3
+    v = jnp.asarray(rng.standard_normal((b, hkv, s, d)), jnp.float32) * 0.3
+    c = jnp.asarray(rng.standard_normal((b, hq, s, d)), jnp.float32)
+
+    def loss_flash(q_, k_, v_):
+        return jnp.sum(flash_attention_fn(q_, k_, v_, causal) * c)
+
+    def loss_dense(q_, k_, v_):
+        return jnp.sum(attention_reference(q_, k_, v_, causal=causal) * c)
+
+    got = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    ref = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for g_, r_, name in zip(got, ref, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(g_), np.asarray(r_), rtol=2e-4, atol=2e-4, err_msg=name
+        )
+
+
+def test_model_training_step(ctx4, rng):
+    """End-to-end: one SGD step through a tiny DenseLLM prefill (flash
+    attention VJP + collective matmul VJPs under shard_map) reduces the loss
+    — the framework is trainable, not inference-only."""
+    from triton_dist_tpu.models import DenseLLM, PRESETS
+    from triton_dist_tpu.function import flash_attention_fn
+    from triton_dist_tpu.layers.tp import RMSNorm, apply_rope
+
+    cfg = PRESETS["test-dense"]
+    model = DenseLLM(cfg, ctx4, key=jax.random.PRNGKey(0))
+    tokens = jnp.asarray([[3, 17, 42, 7, 9, 11, 2, 5]], jnp.int32)
+    p = model.params
+
+    def loss_fn(wqkv, wo):
+        # One attention block through the differentiable flash path.
+        import dataclasses
+
+        p2 = dataclasses.replace(p, wqkv=wqkv, wo=wo)
+
+        def shard_loss(p_, t_):
+            c = cfg
+            bsz, seq = t_.shape
+            x = p_.embed[t_].reshape(bsz * seq, c.hidden_size)
+            h = RMSNorm(weight=p_.ln1[0], eps=c.rms_eps)(x)
+            qkv = jnp.dot(h, p_.wqkv[0], preferred_element_type=jnp.float32).astype(x.dtype)
+            world = jax.lax.axis_size("tp")
+            hq, hkv, hd = c.num_q_heads // world, c.num_kv_heads // world, c.head_dim
+            qkv = qkv.reshape(bsz, seq, hq + 2 * hkv, hd)
+            pos = jnp.broadcast_to(jnp.arange(seq, dtype=jnp.int32)[None], (bsz, seq))
+            q = apply_rope(qkv[:, :, :hq].transpose(0, 2, 1, 3), pos, c.rope_theta)
+            k = apply_rope(qkv[:, :, hq:hq + hkv].transpose(0, 2, 1, 3), pos, c.rope_theta)
+            v = qkv[:, :, hq + hkv:].transpose(0, 2, 1, 3)
+            o = flash_attention_fn(q, k, v, True)
+            o = o.transpose(0, 2, 1, 3).reshape(bsz * seq, -1)
+            out = jax.lax.psum(
+                jnp.dot(o, p_.wo[0], preferred_element_type=jnp.float32), "tp"
+            )
+            return jnp.sum(out**2)[None] / out.size
+
+        per_rank = jax.shard_map(
+            shard_loss, mesh=ctx4.mesh,
+            in_specs=(model_specs_for(cfg), P()), out_specs=P("tp"),
+            check_vma=False,
+        )(p2, tokens)
+        return jnp.sum(per_rank) / 4  # mean over identical per-rank psums
+
+    from triton_dist_tpu.models.dense import _specs as model_specs_for
+
+    val, grads = jax.jit(jax.value_and_grad(loss_fn, argnums=(0, 1)))(p.wqkv, p.wo)
+    wqkv2 = p.wqkv - 0.05 * grads[0]
+    wo2 = p.wo - 0.05 * grads[1]
+    val2 = jax.jit(loss_fn)(wqkv2, wo2)
+    assert float(val2) < float(val), (float(val), float(val2))

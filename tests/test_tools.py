@@ -157,6 +157,17 @@ def test_multiprocess_launcher(tmp_path):
         "assert jax.process_count() == 2\n"
         "expected = 3.0 * jax.local_device_count()  # procs contribute 1 and 2\n"
         "assert float(out.addressable_shards[0].data[0, 0]) == expected\n"
+        "# A distributed kernel across PROCESS boundaries (the DCN analog):\n"
+        "# the XLA-ring collective matmul runs over the 2-process mesh.\n"
+        "from triton_dist_tpu.kernels.allgather_gemm import AGGemmMethod, ag_gemm_shard\n"
+        "import numpy as np\n"
+        "w = jax.device_count()\n"
+        "a = jnp.ones((w * 4, 8)); b = jnp.ones((8, w * 4))\n"
+        "out2 = jax.jit(jax.shard_map(lambda a_, b_: ag_gemm_shard(a_, b_, axis='dp', method=AGGemmMethod.XLA_RING),\n"
+        "    mesh=ctx.mesh, in_specs=(P('dp'), P(None, 'dp')), out_specs=P(None, 'dp'), check_vma=False))(a, b)\n"
+        "full = np.asarray(a) @ np.asarray(b)  # global value spans processes:\n"
+        "for sh in out2.addressable_shards:  # compare the local shards only\n"
+        "    np.testing.assert_allclose(np.asarray(sh.data), full[tuple(sh.index)])\n"
         "print('SMOKE OK')\n"
     )
     env = dict(os.environ)

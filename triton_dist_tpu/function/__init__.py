@@ -10,6 +10,7 @@ compiler-default collectives.
 
 from triton_dist_tpu.function.collectives import (
     ag_gemm_fn,
+    flash_attention_fn,
     gemm_rs_fn,
     gemm_ar_fn,
     all_to_all_single_fn,
@@ -19,6 +20,7 @@ from triton_dist_tpu.function.ep_moe import ep_moe_fused_fn
 
 __all__ = [
     "ag_gemm_fn",
+    "flash_attention_fn",
     "gemm_rs_fn",
     "gemm_ar_fn",
     "all_to_all_single_fn",

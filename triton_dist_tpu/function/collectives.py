@@ -212,7 +212,8 @@ def flash_attention_fn(q, k, v, causal: bool = True, scale: float | None = None)
     can't trace) + a chunked-recompute backward — O(S) memory, standard
     memory-efficient-attention math (dv = pᵀ·do, dp = do·vᵀ,
     ds = p∘(dp − δ) with δ_i = Σ_j do_ij·o_ij, dq = ds·k, dk = dsᵀ·q),
-    blocks swept with lax.map so no (S, S) tensor ever materializes."""
+    blocks swept with a lax.scan whose carry accumulates dq, so no (S, S)
+    tensor ever materializes."""
     from triton_dist_tpu.kernels.flash_attn import flash_attention
 
     return flash_attention(q, k, v, causal=causal, scale=scale)

@@ -134,32 +134,41 @@ class Engine:
                 out_specs=(tok_spec, kv_spec, kv_spec),
                 check_vma=False,
             )
-            self._decode_shard = lambda p_, t_, k_, v_, l_: sm(
-                p_, self._mega_layers, t_, k_, v_, l_
+            # The per-layer weights MUST flow through as a real argument —
+            # a closure capture would bake ~GBs of weights into the traced
+            # HLO as literal constants (unbounded compile payload; a
+            # tunneled remote compile rejects it outright with HTTP 413).
+            self._decode_extra = self._mega_layers
+            self._decode_shard = lambda p_, extra, t_, k_, v_, l_: sm(
+                p_, extra, t_, k_, v_, l_
             )
         else:
             def decode_fn(params, token, ks, vs, lengths):
                 logits, ks, vs = model.decode_shard(params, token, ks, vs, lengths, decode_mode)
                 return jax.lax.all_gather(logits, axis, axis=1, tiled=True), ks, vs
 
-            self._decode_shard = jax.shard_map(
+            sm = jax.shard_map(
                 decode_fn, mesh=mesh,
                 in_specs=(p_specs, tok_spec, kv_spec, kv_spec, len_spec),
                 out_specs=(tok_spec, kv_spec, kv_spec),
                 check_vma=False,
             )
+            self._decode_extra = ()
+            self._decode_shard = lambda p_, extra, t_, k_, v_, l_: sm(
+                p_, t_, k_, v_, l_
+            )
 
         # One compiled program per gen_len: the whole decode loop on device
         # (the XLA analog of replaying a captured CUDA graph gen_len times,
         # minus the per-token host dispatch).
-        @partial(jax.jit, static_argnums=(5,), donate_argnums=(2, 3))
-        def generate(params, token0, ks, vs, lengths, gen_len, key):
+        @partial(jax.jit, static_argnums=(6,), donate_argnums=(3, 4))
+        def generate(params, extra, token0, ks, vs, lengths, gen_len, key):
             bsz = token0.shape[0]
             out0 = jnp.zeros((bsz, gen_len), jnp.int32).at[:, 0].set(token0)
 
             def body(i, carry):
                 out, token, ks, vs, lengths, key = carry
-                logits, ks, vs = self._decode_shard(params, token, ks, vs, lengths)
+                logits, ks, vs = self._decode_shard(params, extra, token, ks, vs, lengths)
                 key, sub = jax.random.split(key)
                 token = sample_token(
                     logits, sub, self.sample_method, self.temperature, self.top_p
@@ -224,7 +233,8 @@ class Engine:
         key, sub = jax.random.split(key)
         token0 = sample_token(logits, sub, self.sample_method, self.temperature, self.top_p)
         out, k2, v2 = self._generate(
-            model.params, token0, cache.k, cache.v, cache.lengths, gen_len, key
+            model.params, self._decode_extra, token0, cache.k, cache.v,
+            cache.lengths, gen_len, key
         )
         # gen_len-1 decode steps ran, each writing its input token's KV:
         # slots [0, seq+gen_len-1) hold valid entries; the LAST generated
@@ -256,8 +266,8 @@ class Engine:
             # block_until_ready returns at dispatch completion (see
             # tools.timing module doc), which would time nothing.
             out, _, _ = self._generate(
-                self.model.params, token, jnp.copy(cache.k), jnp.copy(cache.v),
-                cache.lengths, n, key
+                self.model.params, self._decode_extra, token,
+                jnp.copy(cache.k), jnp.copy(cache.v), cache.lengths, n, key
             )
             return int(jnp.sum(out))
 

@@ -25,12 +25,16 @@ from triton_dist_tpu.runtime.platform import interpret_mode_default
 
 
 def fit_block(n: int, want: int) -> int:
-    """Largest power-of-two-shrunk block ≤ ``want`` dividing ``n`` (falls back
-    to n itself for awkward lengths) — callers never trip divisibility."""
+    """Largest divisor of ``n`` that is ≤ ``want``, preferring lane-aligned
+    (multiple-of-128) divisors. ALWAYS a divisor ≤ want (degenerate 1 for
+    prime lengths, like the old power-of-two shrink): callers never trip
+    divisibility, blocks never exceed the requested VMEM footprint, and
+    shrink loops (``fit_block(n, b // 2)``) strictly make progress."""
     b = min(want, n)
-    while b > 1 and n % b:
-        b //= 2
-    return b if n % b == 0 else n
+    for c in range(b, 0, -1):
+        if n % c == 0 and c % 128 == 0:
+            return c
+    return max(c for c in range(b, 0, -1) if n % c == 0)
 
 
 @dataclasses.dataclass(frozen=True)

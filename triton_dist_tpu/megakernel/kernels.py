@@ -9,6 +9,7 @@ weights stream HBM→VMEM exactly once and no intermediate touches HBM.
 from __future__ import annotations
 
 import functools
+import math
 
 import jax
 import jax.numpy as jnp
@@ -58,7 +59,7 @@ def fused_mlp_block(
     w_down: jax.Array,  # (ff, d)
     *,
     eps: float = 1e-6,
-    block_f: int = 512,
+    block_f: int = 384,
     residual: bool = False,
     vmem_limit_mb: int | None = 100,
 ) -> jax.Array:
@@ -105,49 +106,59 @@ def fused_mlp_block(
 
 
 def _ln_qkv_rope_kernel(x_ref, lnw_ref, w_ref, qn_ref, kn_ref, pos_ref,
-                        q_ref, k_ref, v_ref, *, eps, hq, hkv, hd, theta):
-    xn = _rmsnorm_rows(x_ref[...].astype(jnp.float32), lnw_ref[0], eps, x_ref.dtype)
+                        o_ref, xn_sc, cos_sc, sin_sc, *, eps, hq, hkv, hd,
+                        theta, n_heads_tile):
+    """One grid step = one (B, bc) column tile of the fused projection, so
+    the Mosaic pipeliner overlaps the next weight-tile DMA with this tile's
+    MXU work (a monolithic grid=(1,) load left ~20 % of HBM bandwidth idle
+    at decode shapes). Tile width divides every head-type segment, so each
+    step is uniformly q, k, or v typed (static thresholds, dynamic pid)."""
+    pid = pl.program_id(0)
+    nh = n_heads_tile
+    nq_t = hq // nh  # tiles spanning the q segment
+    nk_t = hkv // nh
+
+    @pl.when(pid == 0)
+    def _():
+        # Normed input and rope phases are tile-invariant: compute once.
+        xn_sc[...] = _rmsnorm_rows(
+            x_ref[...].astype(jnp.float32), lnw_ref[0], eps, x_ref.dtype
+        )
+        half_ = hd // 2
+        # Mosaic iota must be integer-typed; cast for the fp exponent.
+        iota = jax.lax.broadcasted_iota(jnp.int32, (1, half_), 1).astype(jnp.float32)
+        freqs = theta ** (-iota / half_)
+        angles = pos_ref[...].astype(jnp.float32) * freqs  # (B, half)
+        cos_sc[...] = jnp.cos(angles)
+        sin_sc[...] = jnp.sin(angles)
+
     # Round the projection to model dtype BEFORE the head norms — the layer
     # path does (TP_Attn.decode: dot().astype(x.dtype) then _split_qkv), and
     # bf16 parity with the other backends requires the same rounding point.
-    qkv = jnp.dot(xn, w_ref[...], preferred_element_type=jnp.float32).astype(
+    qkv = jnp.dot(xn_sc[...], w_ref[...], preferred_element_type=jnp.float32).astype(
         x_ref.dtype
-    ).astype(jnp.float32)  # (B, cols)
+    ).astype(jnp.float32)  # (B, nh*hd)
 
+    b = qkv.shape[0]
     half = hd // 2
-    # Mosaic iota must be integer-typed; cast for the fp exponent.
-    iota = jax.lax.broadcasted_iota(jnp.int32, (1, half), 1).astype(jnp.float32)
-    freqs = theta ** (-iota / half)
-    angles = pos_ref[...].astype(jnp.float32) * freqs  # (B, half)
-    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    cos = cos_sc[...][:, None, :]  # (B, 1, half)
+    sin = sin_sc[...][:, None, :]
 
-    def head_norm_rope(hh, nw, rope):
-        # hh (B, hd) f32; per-head RMSNorm then rotate-half RoPE, matching
-        # layers.tp._split_qkv + apply_rope exactly (norm before rope).
-        var = jnp.mean(hh * hh, axis=-1, keepdims=True)
-        # Product in model dtype (matches RMSNorm.__call__), then f32 rope.
-        hh = (
-            (hh * jax.lax.rsqrt(var + eps)).astype(x_ref.dtype)
-            * nw.astype(x_ref.dtype)
-        ).astype(jnp.float32)
-        if not rope:
-            return hh
-        x1, x2 = hh[:, :half], hh[:, half:]
-        return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=1)
-
-    # Static unroll over local heads (decode: a handful per rank).
-    for h in range(hq):
-        q_ref[:, h * hd:(h + 1) * hd] = head_norm_rope(
-            qkv[:, h * hd:(h + 1) * hd], qn_ref[0], True
-        ).astype(q_ref.dtype)
-    base = hq * hd
-    for h in range(hkv):
-        k_ref[:, h * hd:(h + 1) * hd] = head_norm_rope(
-            qkv[:, base + h * hd: base + (h + 1) * hd], kn_ref[0], True
-        ).astype(k_ref.dtype)
-    base = (hq + hkv) * hd
-    for h in range(hkv):
-        v_ref[:, h * hd:(h + 1) * hd] = qkv[:, base + h * hd: base + (h + 1) * hd].astype(v_ref.dtype)
+    hh = qkv.reshape(b, nh, hd)
+    is_q = pid < nq_t
+    is_v = pid >= nq_t + nk_t
+    # Per-head RMSNorm then rotate-half RoPE, matching layers.tp._split_qkv
+    # + apply_rope exactly (norm before rope; product in model dtype).
+    nw = jnp.where(is_q, qn_ref[...], kn_ref[...])  # (1, hd)
+    var = jnp.mean(hh * hh, axis=-1, keepdims=True)
+    normed = (
+        (hh * jax.lax.rsqrt(var + eps)).astype(x_ref.dtype)
+        * nw[None].astype(x_ref.dtype)
+    ).astype(jnp.float32)
+    x1, x2 = normed[..., :half], normed[..., half:]
+    roped = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    out = jnp.where(is_v, hh, roped)  # v tiles pass the raw projection through
+    o_ref[...] = out.reshape(b, nh * hd).astype(o_ref.dtype)
 
 
 def fused_ln_qkv_rope(
@@ -173,29 +184,36 @@ def fused_ln_qkv_rope(
     cols = (hq + 2 * hkv) * hd
     assert wqkv.shape == (d, cols), (wqkv.shape, (d, cols))
 
-    q, k, v = pl.pallas_call(
+    # Tile width must divide each head-type segment so every grid step is
+    # uniformly typed: nh | gcd(hq, hkv), capped so a (d, nh*hd) weight tile
+    # stays in the single-digit-MB DMA sweet spot.
+    g = math.gcd(hq, hkv)
+    nh = max((c for c in range(g, 0, -1) if g % c == 0 and c * hd <= 1024),
+             default=1)
+    bc = nh * hd
+    n_c = cols // bc
+
+    flat = pl.pallas_call(
         functools.partial(
-            _ln_qkv_rope_kernel, eps=eps, hq=hq, hkv=hkv, hd=hd, theta=rope_theta
+            _ln_qkv_rope_kernel, eps=eps, hq=hq, hkv=hkv, hd=hd,
+            theta=rope_theta, n_heads_tile=nh,
         ),
-        grid=(1,),
+        grid=(n_c,),
         in_specs=[
             pl.BlockSpec((b, d), lambda i: (0, 0)),
             pl.BlockSpec((1, d), lambda i: (0, 0)),
-            pl.BlockSpec((d, cols), lambda i: (0, 0)),
+            pl.BlockSpec((d, bc), lambda i: (0, i)),
             pl.BlockSpec((1, hd), lambda i: (0, 0)),
             pl.BlockSpec((1, hd), lambda i: (0, 0)),
             pl.BlockSpec((b, 1), lambda i: (0, 0)),
         ],
-        out_specs=(
-            pl.BlockSpec((b, hq * hd), lambda i: (0, 0)),
-            pl.BlockSpec((b, hkv * hd), lambda i: (0, 0)),
-            pl.BlockSpec((b, hkv * hd), lambda i: (0, 0)),
-        ),
-        out_shape=(
-            jax.ShapeDtypeStruct((b, hq * hd), x.dtype),
-            jax.ShapeDtypeStruct((b, hkv * hd), x.dtype),
-            jax.ShapeDtypeStruct((b, hkv * hd), x.dtype),
-        ),
+        out_specs=pl.BlockSpec((b, bc), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((b, cols), x.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((b, d), x.dtype),
+            pltpu.VMEM((b, hd // 2), jnp.float32),
+            pltpu.VMEM((b, hd // 2), jnp.float32),
+        ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",),
             vmem_limit_bytes=vmem_limit_mb * 1024 * 1024 if vmem_limit_mb else None,
@@ -203,4 +221,7 @@ def fused_ln_qkv_rope(
         interpret=interpret_mode_default(),
     )(x, ln_w.reshape(1, d), wqkv, q_norm.reshape(1, hd), k_norm.reshape(1, hd),
       pos.reshape(b, 1).astype(jnp.float32))
+    q = flat[:, : hq * hd]
+    k = flat[:, hq * hd : (hq + hkv) * hd]
+    v = flat[:, (hq + hkv) * hd :]
     return q, k, v

@@ -139,9 +139,7 @@ class Engine:
             # HLO as literal constants (unbounded compile payload; a
             # tunneled remote compile rejects it outright with HTTP 413).
             self._decode_extra = self._mega_layers
-            self._decode_shard = lambda p_, extra, t_, k_, v_, l_: sm(
-                p_, extra, t_, k_, v_, l_
-            )
+            self._decode_shard = sm
         else:
             def decode_fn(params, token, ks, vs, lengths):
                 logits, ks, vs = model.decode_shard(params, token, ks, vs, lengths, decode_mode)

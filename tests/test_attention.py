@@ -91,3 +91,25 @@ def test_dist_flash_decode(ctx8, rng):
     out = np.asarray(f(q, k, v, lengths))
     ref = np.asarray(flash_decode(q, k, v, lengths, block_k=64))
     np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_flash_attention_fully_masked_rows(rng):
+    """Rows placed entirely BEFORE the kv window via the public
+    q_offset/kv_offset args are fully masked and must produce o=0 and
+    lse≈-inf — not mean(v) (r2 review: an unguarded exp2(NEG_INF-NEG_INF)=1
+    row-fill; the varlen kernel always had the guard)."""
+    b, h, s, d = 1, 2, 128, 64
+    q = jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.float32)
+    # Queries start 64 rows before the keys: rows 0..63 see no valid key.
+    o, lse = flash_attention(
+        q, k, v, causal=True, block_q=64, block_k=64,
+        q_offset=jnp.int32(0), kv_offset=jnp.int32(64), return_lse=True,
+    )
+    np.testing.assert_array_equal(np.asarray(o[:, :, :64]), 0.0)
+    assert np.all(np.asarray(lse[:, :, :64]) < -1e25)
+    # Rows at/after the kv start behave exactly like an offset-free call on
+    # the visible prefix.
+    ref = attention_reference(q[:, :, 64:], k[:, :, : s - 64], v[:, :, : s - 64], causal=True)
+    np.testing.assert_allclose(np.asarray(o[:, :, 64:]), np.asarray(ref), rtol=2e-4, atol=2e-4)

@@ -210,3 +210,36 @@ def test_memory_ops_unaligned(rng):
     np.testing.assert_array_equal(np.asarray(copy_tensor(x)), np.asarray(x))
     f = fill((5, 13), -1.25, jnp.float32)
     assert f.shape == (5, 13) and np.all(np.asarray(f) == -1.25)
+
+
+def test_fit_block_contract():
+    """fit_block must ALWAYS return a divisor of n that is <= want (callers
+    size VMEM tiles and run shrink loops off it), prefer lane-aligned
+    divisors, and never collapse to 1 when a reasonable divisor exists
+    (r2 review: power-of-two shrinking returned 1 for ff=25600 @ want=384;
+    a later fix returned n > want, hanging the AG-GEMM VMEM-shrink loop)."""
+    from triton_dist_tpu.kernels.gemm import fit_block
+
+    for n in (128, 256, 384, 2048, 3200, 8209, 12288, 16418, 25600, 97):
+        for want in (64, 128, 384, 512, 1024):
+            b = fit_block(n, want)
+            assert n % b == 0, (n, want, b)
+            assert b <= max(want, 1), (n, want, b)
+    # Lane-aligned preference where possible.
+    assert fit_block(25600, 384) == 256
+    assert fit_block(2048, 384) == 256
+    assert fit_block(12288, 384) == 384
+    # Shrink loops make progress down to 1 (composite seeds: the loop body
+    # must actually run; primes start at 1 already).
+    for n in (25600, 16418, 12288):
+        b = fit_block(n, 1024)
+        seen = {b}
+        while b > 1:
+            nb = fit_block(n, max(1, b // 2))
+            assert nb < b, (n, b, nb)
+            b = nb
+            seen.add(b)
+        # Rich-divisor dims must actually step through intermediate sizes
+        # (16418 = 2·8209 only has {2, 1} below the cap).
+        assert len(seen) > 2 or n == 16418, (n, seen)
+    assert fit_block(8209, 512) == 1

@@ -10,10 +10,13 @@ distributed decode / ring-attention combines need (reference
 ``kernels/nvidia/flash_decode.py:308-566`` combine path).
 
 Block sizing (measured, v5e bf16 GQA causal): 1024×1024 tiles run
-3.5-4.3× faster than 256×256 (27 → 78 TFLOP/s at s=2048; 26 → 113 at
-s=8192, 57 % of MXU peak) — the online-softmax VPU work amortizes against
-much larger MXU matmuls per tile. ``fit_block`` shrinks tiles for short
-sequences, so the large defaults are safe everywhere.
+3.5-4.3× faster than 256×256 (27 → 81 TFLOP/s at s=2048; 26 → 121 at
+s=8192) — the online-softmax VPU work amortizes against much larger MXU
+matmuls per tile. The softmax runs in the exp2 domain (log2(e) folded into
+the score scale; both exponentials are native VPU exp2) and fully-below-
+diagonal causal blocks skip the mask select entirely — worth ~3 % together.
+``fit_block`` shrinks tiles for short sequences, so the large defaults are
+safe everywhere.
 """
 
 from __future__ import annotations
@@ -72,15 +75,21 @@ def _flash_kernel(
         m_scr[...] = jnp.full_like(m_scr, NEG_INF)
         l_scr[...] = jnp.zeros_like(l_scr)
 
-    def compute():
+    # Softmax runs in the exp2 domain: fold log2(e) into the score scale once
+    # per tile so both exponentials are native VPU exp2 ops with no extra
+    # (bq, bk)-sized multiply (m/l scratch then hold base-2 logs; only the
+    # final LSE converts back to nats).
+    LOG2E = 1.4426950408889634
+
+    def compute(masked):
         q = q_ref[0]  # (bq, d)
         k = k_ref[0]  # (bk, d)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # (bq, bk)
-        s *= scale
+        s *= scale * LOG2E
 
-        if causal:
+        if masked:
             # End-aligned (KV-cache) convention: query row i sits at absolute
             # position q_off + iq*bq + i (q_off = kv_len - sq statically, or
             # the caller-supplied ring offset), so a prefill continuation
@@ -94,8 +103,14 @@ def _flash_kernel(
         m_prev = m_scr[...]  # (bq, LANES)
         m_cur = jnp.max(s, axis=1, keepdims=True)  # (bq, 1)
         m_new = jnp.maximum(m_prev, jnp.broadcast_to(m_cur, m_prev.shape))
-        alpha = jnp.exp(m_prev - m_new)  # (bq, LANES)
-        p = jnp.exp(s - m_new[:, :1])  # (bq, bk)
+        alpha = jnp.exp2(m_prev - m_new)  # (bq, LANES)
+        p = jnp.exp2(s - m_new[:, :1])  # (bq, bk)
+        if masked:
+            # A row with NO valid key yet has m_new == NEG_INF and would get
+            # p = exp2(0) = 1 everywhere (→ mean(v) instead of 0). Re-mask
+            # such rows, same guard as the varlen kernel. Reachable through
+            # the public q_offset/kv_offset args (rows before the kv start).
+            p = jnp.where(m_new[:, :1] <= NEG_INF * 0.5, 0.0, p)
 
         l_scr[...] = l_scr[...] * alpha + jnp.broadcast_to(
             jnp.sum(p, axis=1, keepdims=True), m_prev.shape
@@ -107,14 +122,25 @@ def _flash_kernel(
         )
 
     if causal:
-        # Skip KV blocks entirely above the (end-aligned) diagonal. With
-        # dynamic offsets this is runtime predication inside a uniform grid —
-        # all devices still launch identical programs.
-        @pl.when(ik * block_k <= q_off + iq * block_q + block_q - 1)
+        # Skip KV blocks entirely above the (end-aligned) diagonal, and run
+        # blocks entirely below it without the mask select (the (bq, bk)
+        # iota/compare/select is pure VPU overhead there). With dynamic
+        # offsets this is runtime predication inside a uniform grid — all
+        # devices still launch identical programs.
+        first_q = q_off + iq * block_q
+        crosses_diag = ik * block_k + block_k - 1 > first_q
+
+        @pl.when(ik * block_k <= first_q + block_q - 1)
         def _():
-            compute()
+            @pl.when(crosses_diag)
+            def _():
+                compute(masked=True)
+
+            @pl.when(jnp.logical_not(crosses_diag))
+            def _():
+                compute(masked=False)
     else:
-        compute()
+        compute(masked=False)
 
     @pl.when(ik == n_kv - 1)
     def _():
@@ -122,7 +148,9 @@ def _flash_kernel(
         l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows → zero output
         o_ref[0] = (acc_scr[...] / l).astype(o_ref.dtype)
         if lse_ref is not None:
-            lse = m_scr[:, 0] + jnp.log(jnp.maximum(l_scr[:, 0], 1e-30))
+            # m/l are base-2; LSE is published in nats (what the distributed
+            # decode / ring combines expect).
+            lse = (m_scr[:, 0] + jnp.log2(jnp.maximum(l_scr[:, 0], 1e-30))) / LOG2E
             lse_ref[0, 0] = lse.astype(lse_ref.dtype)
 
 

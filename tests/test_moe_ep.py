@@ -241,3 +241,31 @@ def test_ep_moe_low_latency_vs_dense(ctx4, rng):
         ref = moe_dense_ref(x[r], wr, wg, wu, wd, k)
         # fp8 activations through two GEMMs: loose but meaningful bound.
         np.testing.assert_allclose(out[r], ref, rtol=0.1, atol=0.02, err_msg=f"rank {r}")
+
+
+def test_all_to_all_2d(mesh8):
+    """Hierarchical 2D a2a over (outer, inner) == global a2a over the
+    combined outer-major rank: out[s] on rank r == x[r] on rank s."""
+    import tests.conftest  # noqa: F401
+    from triton_dist_tpu.kernels.ep_a2a import all_to_all_2d_shard
+    from triton_dist_tpu.runtime.platform import cpu_mesh
+
+    wo, wi, c, d = 2, 4, 2, 8
+    mesh = cpu_mesh((wo, wi), ("dcn", "ici"))
+    rng = np.random.default_rng(0)
+    # Global input: axis0 = source global rank, then (dest_global, c, d).
+    full = jnp.asarray(rng.standard_normal((wo * wi, wo * wi, c, d)), jnp.float32)
+
+    def shard_fn(x):  # x: (1, wt, c, d) — this rank's send rows
+        return all_to_all_2d_shard(
+            x[0], axes=("dcn", "ici"), mesh_axes=("dcn", "ici"))[None]
+
+    out = jax.jit(
+        jax.shard_map(
+            shard_fn, mesh=mesh,
+            in_specs=(P(("dcn", "ici")),), out_specs=P(("dcn", "ici")),
+            check_vma=False,
+        )
+    )(full)
+    expected = np.transpose(np.asarray(full), (1, 0, 2, 3))  # out[r][s] = x[s][r]
+    np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-6, atol=1e-6)

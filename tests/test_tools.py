@@ -183,3 +183,14 @@ def test_multiprocess_launcher(tmp_path):
     )
     assert r.returncode == 0, (r.stdout[-500:], r.stderr[-500:])
     assert r.stdout.count("SMOKE OK") == 2
+
+
+def test_device_memory_stats():
+    """Allocator metrics surface (reference megakernel memory metrics):
+    dict of ints, or {} on backends without allocator stats (CPU sim)."""
+    from triton_dist_tpu.tools.profiler import device_memory_stats
+
+    stats = device_memory_stats()
+    assert isinstance(stats, dict)
+    for v in stats.values():
+        assert isinstance(v, int)

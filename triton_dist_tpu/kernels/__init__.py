@@ -69,6 +69,14 @@ from triton_dist_tpu.kernels.gemm_allreduce import (
     gemm_ar,
 )
 from triton_dist_tpu.kernels.allgather import all_gather_2d_shard
+from triton_dist_tpu.kernels.ep_a2a import (
+    all_to_all_single_shard,
+    all_to_all_2d_shard,
+    ep_dispatch_shard,
+    ep_combine_shard,
+    create_all_to_all_context,
+    fast_all_to_all,
+)
 from triton_dist_tpu.kernels.flash_attn import flash_attention, flash_attention_varlen
 from triton_dist_tpu.kernels.flash_decode import flash_decode
 from triton_dist_tpu.kernels.gdn import gdn_fwd
@@ -92,6 +100,12 @@ from triton_dist_tpu.kernels.sp import (
 __all__ = [
     "barrier_all_on_device",
     "copy_tensor_shard",
+    "all_to_all_single_shard",
+    "all_to_all_2d_shard",
+    "ep_dispatch_shard",
+    "ep_combine_shard",
+    "create_all_to_all_context",
+    "fast_all_to_all",
     "AllGatherMethod",
     "AllGatherContext",
     "create_allgather_context",

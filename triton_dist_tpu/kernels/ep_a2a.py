@@ -201,3 +201,37 @@ def fast_all_to_all(a2a_ctx: AllToAllContext, x, expert_idx):
         mesh_axes=a2a_ctx.ctx.axis_names,
         use_pallas=a2a_ctx.use_pallas,
     )
+
+
+def all_to_all_2d_shard(
+    x: jax.Array,  # (wo*wi, chunk, d) — row (po*wi + pi) destined for peer (po, pi)
+    *,
+    axes: tuple[str, str],
+    mesh_axes=None,
+    use_pallas: bool = True,
+) -> jax.Array:
+    """Hierarchical 2D all-to-all over two mesh axes (reference
+    ``all_to_all_single_2d.py`` — its intra/inter-node split): exchange over
+    the inner (fast/ICI) axis first, carrying each inner peer's whole
+    outer-bound panel, then over the outer (slow/DCN) axis — so the slow
+    axis moves wi-times-larger messages exactly once instead of wi small
+    ones. Row order in and out is outer-major global rank (po*wi + pi).
+    Usable inside shard_map over both axes."""
+    outer, inner = axes
+    wo = jax.lax.axis_size(outer)
+    wi = jax.lax.axis_size(inner)
+    wt, c, d = x.shape
+    assert wt == wo * wi, (wt, wo, wi)
+    # Phase 1 (inner): to inner peer j, send the rows destined (do, j) for
+    # every do — group rows by inner destination.
+    x1 = x.reshape(wo, wi, c, d).transpose(1, 0, 2, 3).reshape(wi, wo * c, d)
+    r1 = all_to_all_single_shard(
+        x1, axis=inner, mesh_axes=mesh_axes, use_pallas=use_pallas
+    )  # r1[j] = inner peer j's outer-bound panel for my inner index
+    # Phase 2 (outer): regroup by outer destination; each outer message
+    # carries the already-inner-exchanged (wi, c) panel.
+    x2 = r1.reshape(wi, wo, c, d).transpose(1, 0, 2, 3).reshape(wo, wi * c, d)
+    r2 = all_to_all_single_shard(
+        x2, axis=outer, mesh_axes=mesh_axes, use_pallas=use_pallas
+    )  # r2[so] = from outer peer so: rows of sources (so, si) for me
+    return r2.reshape(wo * wi, c, d)

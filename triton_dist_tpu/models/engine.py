@@ -246,12 +246,15 @@ class Engine:
         """Steady-state per-token decode latency (reference perf mode of
         ``test_e2e_inference.py``).
 
-        Times the on-device ``_generate`` loop — ``iters`` chained decode
-        steps in ONE dispatch, so per-call tunnel round-trips amortize to
-        ~zero — and subtracts the median 1-token wall (dispatch + cache-copy
-        overhead). Median-of-reps rejects shared-tenancy spikes. A naive
-        host loop of ``_decode`` calls would measure tunnel dispatch, not
-        the chip."""
+        Times the on-device ``_generate`` loop at TWO long lengths (iters
+        and iters//4 steps, one dispatch each) and divides the wall
+        difference by the step difference: dispatch/cache-copy overhead and
+        any per-dispatch tunnel stall cancel between two same-shaped long
+        runs (differencing a long run against a 1-step wall lets a single
+        contended overhead sample swallow the whole signal and once
+        produced a sub-HBM-floor \"measurement\"). Median-of-reps rejects
+        shared-tenancy spikes. A naive host loop of ``_decode`` calls would
+        measure tunnel dispatch, not the chip."""
         ids = jnp.zeros((bsz, prompt_len), jnp.int32)
         logits, ks, vs = self._prefill(self.model.params, ids)
         cache = self._make_cache(ks, vs, prompt_len)
@@ -278,15 +281,18 @@ class Engine:
             walls.sort()
             return walls[len(walls) // 2]
 
-        run(1)  # compile short
+        if iters < 2:
+            raise ValueError("bench_decode needs iters >= 2 (two-length differencing)")
+        short_iters = max(1, iters // 4)
+        run(1 + short_iters)  # compile short
         run(1 + iters)  # compile long
-        overhead = median_wall(1)
+        short_ = median_wall(1 + short_iters)
         long_ = median_wall(1 + iters)
-        if long_ <= overhead:
+        if long_ <= short_:
             # Shared-tenancy noise swamped the signal: unusable, never 0
             # (callers would divide by it or report impossible 0 ms).
             return float("inf")
-        return (long_ - overhead) / iters
+        return (long_ - short_) / (iters - short_iters)
 
 
 def bench_decode_table(model: DenseLLM, backends=_BACKENDS, bsz: int = 1,

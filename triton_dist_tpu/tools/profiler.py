@@ -94,3 +94,19 @@ def profile_op(fn, args, log_dir: str, iters: int = 3):
             out = fn(*args)
         jax.block_until_ready(out)
     return log_dir
+
+
+def device_memory_stats(device=None) -> dict:
+    """Live/peak HBM accounting for one device (reference megakernel memory
+    metrics, ``model_builder.py:135-164``). Returns {} on backends that don't
+    report allocator stats (e.g. the CPU sim)."""
+    import jax
+
+    d = device if device is not None else jax.devices()[0]
+    stats = getattr(d, "memory_stats", None)
+    stats = stats() if callable(stats) else None
+    if not stats:
+        return {}
+    keep = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+            "largest_alloc_size", "num_allocs")
+    return {k: stats[k] for k in keep if k in stats}

@@ -243,10 +243,9 @@ def test_ep_moe_low_latency_vs_dense(ctx4, rng):
         np.testing.assert_allclose(out[r], ref, rtol=0.1, atol=0.02, err_msg=f"rank {r}")
 
 
-def test_all_to_all_2d(mesh8):
+def test_all_to_all_2d():
     """Hierarchical 2D a2a over (outer, inner) == global a2a over the
     combined outer-major rank: out[s] on rank r == x[r] on rank s."""
-    import tests.conftest  # noqa: F401
     from triton_dist_tpu.kernels.ep_a2a import all_to_all_2d_shard
     from triton_dist_tpu.runtime.platform import cpu_mesh
 

@@ -10,7 +10,8 @@ import sys
 import pytest
 
 TUTORIALS = sorted(
-    p for p in (pathlib.Path(__file__).parents[1] / "tutorials").glob("0*.py")
+    p
+    for p in (pathlib.Path(__file__).parents[1] / "tutorials").glob("[0-9]*.py")
 )
 
 

@@ -154,6 +154,36 @@ def _flash_kernel(
             lse_ref[0, 0] = lse.astype(lse_ref.dtype)
 
 
+DEFAULT_BLOCK_Q = 1024
+DEFAULT_BLOCK_K = 1024
+
+
+def flash_op_name(causal: bool) -> str:
+    """Tune-cache op key — single source shared by the kernel lookup, the
+    offline tuner, and tests (a drifting literal would silently degrade
+    every lookup to the default blocks)."""
+    return "flash_attn_causal" if causal else "flash_attn"
+
+
+def flash_config_for(q_sds, k_sds, v_sds, causal: bool) -> tuple[int, int]:
+    """Trace-time tuned-block lookup (offline ``tools.tune_gemm --flash``
+    fills the cache, same discipline as ``gemm_config_for``; the cache key
+    is the (q, k, v) signature ``tune_flash`` times with). Falls back to
+    the measured 1024×1024 default.
+
+    Multi-host contract (same as the reference's JSON tune cache): every
+    process must see the SAME cache content — tuned blocks are baked into
+    the traced program, so per-host divergence means divergent HLO inside
+    one SPMD computation. Ship the cache file with the job (or point
+    ``TDT_TUNE_CACHE`` at a shared path); tune offline, not mid-job."""
+    from triton_dist_tpu.tools.tune import lookup
+
+    hit = lookup(flash_op_name(causal), [q_sds, k_sds, v_sds])
+    if hit:
+        return int(hit["block_q"]), int(hit["block_k"])
+    return DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K
+
+
 def flash_attention(
     q: jax.Array,  # (B, Hq, Sq, D)
     k: jax.Array,  # (B, Hkv, Sk, D)
@@ -161,8 +191,8 @@ def flash_attention(
     *,
     causal: bool = True,
     scale: float | None = None,
-    block_q: int = 1024,
-    block_k: int = 1024,
+    block_q: int | None = None,
+    block_k: int | None = None,
     return_lse: bool = False,
     q_offset: jax.Array | None = None,
     kv_offset: jax.Array | None = None,
@@ -182,6 +212,15 @@ def flash_attention(
     assert hq % hkv == 0, (hq, hkv)
     group = hq // hkv
     scale = scale if scale is not None else d ** -0.5
+    if block_q is None or block_k is None:
+        tuned_q, tuned_k = flash_config_for(
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+            causal,
+        )
+        block_q = tuned_q if block_q is None else block_q
+        block_k = tuned_k if block_k is None else block_k
     block_q = fit_block(sq, block_q)
     block_k = fit_block(sk, block_k)
     n_kv = sk // block_k

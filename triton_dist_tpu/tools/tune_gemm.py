@@ -36,15 +36,66 @@ def tune_square_gemm(size: int, dtype, *, verbose: bool = True):
     return best, t
 
 
+FLASH_BLOCK_SPACE = [
+    (256, 256), (512, 512), (512, 1024), (1024, 512),
+    (1024, 1024), (1024, 2048), (2048, 1024), (2048, 2048),
+]
+
+
+def tune_flash(b, hq, hkv, s, d, dtype, *, causal: bool = True, verbose: bool = True):
+    """Sweep flash-attention block shapes for one (B, H, S, D) shape and
+    persist the winner; ``flash_config_for`` reads it at trace time."""
+    from triton_dist_tpu.kernels.flash_attn import (
+        DEFAULT_BLOCK_K,
+        DEFAULT_BLOCK_Q,
+        flash_attention,
+        flash_op_name,
+    )
+
+    key = jax.random.PRNGKey(0)
+    q = jax.random.normal(key, (b, hq, s, d), jnp.float32).astype(dtype)
+    k = jax.random.normal(key, (b, hkv, s, d), jnp.float32).astype(dtype)
+    v = jax.random.normal(key, (b, hkv, s, d), jnp.float32).astype(dtype)
+    space = [
+        {"block_q": bq, "block_k": bk}
+        for bq, bk in FLASH_BLOCK_SPACE
+        if s % bq == 0 and s % bk == 0
+    ]
+    if not space:
+        # Awkward s: no candidate divides it. The kernel's fit_block handles
+        # such lengths; time the (shrunk) default rather than erroring out
+        # with "every candidate failed" over an empty sweep.
+        space = [{"block_q": DEFAULT_BLOCK_Q, "block_k": DEFAULT_BLOCK_K}]
+    best, t = autotune(
+        flash_op_name(causal),
+        space,
+        lambda cfg: (lambda q_, k_, v_: flash_attention(q_, k_, v_, causal=causal, **cfg)),
+        (q, k, v),
+        verbose=verbose,
+    )
+    flops = 2 * 2 * b * hq * s * s * d * (0.5 if causal else 1.0)
+    if verbose:
+        print(f"[tune_flash] b{b} h{hq}/{hkv} s{s} d{d}: best {best} "
+              f"{flops / t / 1e12:.1f} TFLOP/s")
+    return best, t
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--mkn", type=int, nargs="+", default=[2048, 4096, 8192])
     p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--flash", type=int, nargs=5, metavar=("B", "HQ", "HKV", "S", "D"),
+                   help="also tune flash attention at this shape")
+    p.add_argument("--non-causal", action="store_true",
+                   help="tune the non-causal flash cache key instead")
     p.add_argument("-q", "--quiet", action="store_true")
     args = p.parse_args()
     dtype = jnp.dtype(args.dtype)
     for s in args.mkn:
         tune_square_gemm(s, dtype, verbose=not args.quiet)
+    if args.flash:
+        tune_flash(*args.flash, dtype, causal=not args.non_causal,
+                   verbose=not args.quiet)
     print(f"cache: {default_cache().path}")
 
 

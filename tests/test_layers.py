@@ -288,3 +288,37 @@ def test_gpipe_training_grad(ctx4, rng):
 
     g_seq = jax.grad(loss_seq, argnums=1)(x, ws)
     np.testing.assert_allclose(np.asarray(g_pp), np.asarray(g_seq), rtol=1e-4, atol=1e-4)
+
+
+def test_ep_moe_fused_kernel_layer(ctx8, rng):
+    """EP_MoE(fused_kernel=True) — the one-kernel mega-EP path — agrees with
+    the default dispatch/combine composition."""
+    from triton_dist_tpu.layers import EP_MoE
+
+    world, d, ff, e, t, k = 8, 16, 32, 8, 8, 2
+    x = jnp.asarray(rng.standard_normal((world, t, d)), jnp.float32) * 0.3
+    wr = jnp.asarray(rng.standard_normal((d, e)), jnp.float32)
+    wg = jnp.asarray(rng.standard_normal((e, d, ff)), jnp.float32) * 0.1
+    wu = jnp.asarray(rng.standard_normal((e, d, ff)), jnp.float32) * 0.1
+    wd = jnp.asarray(rng.standard_normal((e, ff, d)), jnp.float32) * 0.1
+
+    outs = {}
+    for fused in (False, True):
+        def fn(x_, wr_, wg_, wu_, wd_):
+            moe = EP_MoE(
+                w_router=wr_, w_gate=wg_, w_up=wu_, w_down=wd_,
+                num_experts=e, top_k=k, capacity_factor=8.0, axis="tp",
+                mesh_axes=("tp",), fused_kernel=fused,
+            )
+            return moe(x_[0])[None]
+
+        outs[fused] = np.asarray(
+            jax.jit(
+                jax.shard_map(
+                    fn, mesh=ctx8.mesh,
+                    in_specs=(P("tp"), P(), P("tp"), P("tp"), P("tp")),
+                    out_specs=P("tp"), check_vma=False,
+                )
+            )(x, wr, wg, wu, wd)
+        )
+    np.testing.assert_allclose(outs[True], outs[False], rtol=2e-4, atol=2e-4)

@@ -77,6 +77,11 @@ from triton_dist_tpu.kernels.ep_a2a import (
     create_all_to_all_context,
     fast_all_to_all,
 )
+from triton_dist_tpu.kernels.ep_fused import (
+    ep_moe_fused_kernel_shard,
+    fused_dispatch_mlp_shard,
+    fused_moe_supported,
+)
 from triton_dist_tpu.kernels.flash_attn import flash_attention, flash_attention_varlen
 from triton_dist_tpu.kernels.flash_decode import flash_decode
 from triton_dist_tpu.kernels.gdn import gdn_fwd
@@ -106,6 +111,9 @@ __all__ = [
     "ep_combine_shard",
     "create_all_to_all_context",
     "fast_all_to_all",
+    "ep_moe_fused_kernel_shard",
+    "fused_dispatch_mlp_shard",
+    "fused_moe_supported",
     "AllGatherMethod",
     "AllGatherContext",
     "create_allgather_context",

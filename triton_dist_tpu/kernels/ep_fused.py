@@ -1,0 +1,263 @@
+"""Fused EP dispatch → grouped expert MLP in ONE Pallas kernel (mega-EP).
+
+Reference: ``python/triton_dist/kernels/nvidia/ep_all2all_fused.py`` (2071
+LoC) — ``mega_kernel_dispatch_token_moe_grouped_gemm:839`` runs the token
+a2a and the grouped expert GEMM inside one persistent kernel so compute hides
+communication. TPU redesign of the same idea:
+
+* One ``dist_pallas_call`` issues the one-sided token puts, then sweeps the
+  grid ``(E_local, ff_tiles)`` computing each local expert's
+  gate/up→SwiGLU→down on its arrived token panel. The Mosaic pipeline
+  prefetches the FIRST expert's weight tiles *while the a2a drains* — on a
+  TPU the a2a latency hides under weight streaming (the dual of the
+  reference's GPU framing, where grouped-GEMM tiles hide token sends; both
+  kernels overlap the same two legs, each hiding the one its hardware
+  stalls on).
+* Tokens land in the kernel's ``recv`` output buffer (interpret-mode rule:
+  communication buffers must be pallas inputs/outputs, not ANY scratch) and
+  are re-gathered per expert into VMEM once per expert — token panels are
+  tiny next to expert weights in the decode regime this serves.
+* The combine leg stays at jit level (``ll_combine_shard``) — its return
+  a2a is dominated by the down-GEMM it follows, which XLA already overlaps.
+
+Capacity/limits: the per-expert token panel ``(world·C, d)`` (×2: input +
+f32 accumulator) plus three ``(d, block_f)``-class weight tiles must fit
+VMEM; ``fused_moe_supported`` checks this and callers fall back to the
+jit-level composition (``ep_moe_ll_shard``) — same functional result,
+kernel-granular overlap only. fp8 wire is jit-level-only for now (the
+in-kernel a2a moves the model dtype).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+import triton_dist_tpu.language as tpl
+from triton_dist_tpu.kernels.gemm import fit_block
+from triton_dist_tpu.shmem.kernel import collective_id_for, dist_pallas_call
+
+
+def _fused_dispatch_mlp_kernel(
+    send_ref,  # ANY (world, E_local*C, d) — row p = my tokens for peer p
+    wg_ref,  # (1, d, bf) VMEM tile of w_gate[e]
+    wu_ref,  # (1, d, bf)
+    wd_ref,  # (1, bf, d)
+    y_ref,  # (1, world*C, d) expert output panel
+    recv_ref,  # ANY (world, E_local*C, d) — comm landing buffer
+    xs,  # VMEM (world*C, d) model dtype — expert e's token panel
+    acc,  # VMEM (world*C, d) f32
+    send_sem,
+    recv_sem,
+    copy_sem,
+    *,
+    axis,
+    mesh_axes,
+    cap: int,
+    n_f: int,
+):
+    e_i = pl.program_id(0)
+    f_i = pl.program_id(1)
+    me = tpl.rank(axis)
+    world = tpl.num_ranks(axis)
+
+    @pl.when(jnp.logical_and(e_i == 0, f_i == 0))
+    def _():
+        # Peers may still be reading recv from a previous step.
+        tpl.barrier_all(axis, mesh_axes=mesh_axes)
+        cp = pltpu.make_async_copy(send_ref.at[me], recv_ref.at[me], copy_sem)
+        cp.start()
+        cp.wait()
+
+        def send(i, _):
+            peer = jax.lax.rem(me + i, world)
+            tpl.putmem_signal(
+                send_ref.at[peer], recv_ref.at[me], send_sem, recv_sem, peer,
+                axis=axis, mesh_axes=mesh_axes,
+            ).start()
+            return 0
+
+        jax.lax.fori_loop(1, world, send, 0)
+
+        def drain(i, _):
+            # Each arrival delivers one (E_local*C, d) chunk; the weight
+            # pipeline for expert 0 is already streaming while we sit here.
+            tpl.wait_recv(recv_sem, recv_ref.at[me])
+            pltpu.make_async_copy(send_ref.at[me], send_ref.at[me], send_sem).wait()
+            return 0
+
+        jax.lax.fori_loop(1, world, drain, 0)
+
+    @pl.when(f_i == 0)
+    def _():
+        # Gather expert e_i's rows from every source chunk into one panel —
+        # start all world copies (disjoint xs slices), then drain the
+        # byte-counting semaphore, so the DMAs overlap instead of paying
+        # world sequential latencies.
+        def fetch(s, _):
+            pltpu.make_async_copy(
+                recv_ref.at[s, pl.ds(e_i * cap, cap)],
+                xs.at[pl.ds(s * cap, cap)],
+                copy_sem,
+            ).start()
+            return 0
+
+        jax.lax.fori_loop(0, world, fetch, 0)
+
+        def drain_fetch(s, _):
+            pltpu.make_async_copy(
+                xs.at[pl.ds(s * cap, cap)], xs.at[pl.ds(s * cap, cap)], copy_sem
+            ).wait()
+            return 0
+
+        jax.lax.fori_loop(0, world, drain_fetch, 0)
+        acc[...] = jnp.zeros_like(acc)
+
+    g = jnp.dot(xs[...], wg_ref[0], preferred_element_type=jnp.float32)
+    u = jnp.dot(xs[...], wu_ref[0], preferred_element_type=jnp.float32)
+    h = (jax.nn.silu(g) * u).astype(xs.dtype)
+    acc[...] += jnp.dot(h, wd_ref[0], preferred_element_type=jnp.float32)
+
+    @pl.when(f_i == n_f - 1)
+    def _():
+        y_ref[0] = acc[...].astype(y_ref.dtype)
+
+
+def fused_moe_supported(world: int, cap: int, d: int, ff: int,
+                        itemsize: int, block_f: int = 512,
+                        vmem_limit_mb: int = 100) -> bool:
+    """Static feasibility check for the fused kernel's VMEM plan: token
+    panel + f32 accumulator + double-buffered weight tiles + the
+    double-buffered (world·C, d) output block (its index map varies with
+    the expert grid dim, so the pipeline keeps two resident). The plan is
+    expert-count-independent — per-expert state lives in the same buffers."""
+    bf = fit_block(ff, block_f)
+    panel = world * cap * d * (itemsize + 4)
+    tiles = 2 * (2 * d * bf + bf * d) * itemsize  # double-buffered g/u/d tiles
+    out_blocks = 2 * world * cap * d * itemsize
+    return panel + tiles + out_blocks <= vmem_limit_mb * 1024 * 1024
+
+
+def fused_dispatch_mlp_shard(
+    send: jax.Array,  # (world, E_local*C, d) destination-major slot grid
+    w_gate: jax.Array,  # (E_local, d, ff)
+    w_up: jax.Array,  # (E_local, d, ff)
+    w_down: jax.Array,  # (E_local, ff, d)
+    *,
+    capacity: int,
+    axis: str = "ep",
+    mesh_axes=None,
+    block_f: int = 512,
+    vmem_limit_mb: int = 100,
+) -> jax.Array:
+    """a2a-dispatch + grouped gate/up/SwiGLU/down in one kernel. Returns the
+    per-expert output panels (E_local, world*C, d). Inside shard_map."""
+    world = jax.lax.axis_size(axis)
+    _, chunk, d = send.shape
+    e_local = chunk // capacity
+    ff = w_gate.shape[-1]
+    bf = fit_block(ff, block_f)
+    n_f = ff // bf
+
+    if world == 1:
+        from triton_dist_tpu.kernels.group_gemm import group_gemm, group_gemm_swiglu
+
+        xs = send.reshape(e_local, capacity, d)
+        return group_gemm(group_gemm_swiglu(xs, w_gate, w_up), w_down)
+
+    grid = (e_local, n_f)
+    y, _recv = dist_pallas_call(
+        functools.partial(
+            _fused_dispatch_mlp_kernel,
+            axis=axis, mesh_axes=mesh_axes, cap=capacity, n_f=n_f,
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec((1, d, bf), lambda e, f: (e, 0, f)),
+            pl.BlockSpec((1, d, bf), lambda e, f: (e, 0, f)),
+            pl.BlockSpec((1, bf, d), lambda e, f: (e, f, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, world * capacity, d), lambda e, f: (e, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((e_local, world * capacity, d), send.dtype),
+            jax.ShapeDtypeStruct(send.shape, send.dtype),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((world * capacity, d), send.dtype),
+            pltpu.VMEM((world * capacity, d), jnp.float32),
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary"),
+            vmem_limit_bytes=vmem_limit_mb * 1024 * 1024,
+            has_side_effects=True,
+            collective_id=collective_id_for("_fused_dispatch_mlp_kernel"),
+        ),
+    )(send, w_gate, w_up, w_down)
+    return y
+
+
+def ep_moe_fused_kernel_shard(
+    x: jax.Array,  # (T, d) this rank's tokens
+    w_router: jax.Array,  # (d, E)
+    w_gate: jax.Array,
+    w_up: jax.Array,
+    w_down: jax.Array,
+    *,
+    num_experts: int,
+    top_k: int,
+    capacity_factor: float = 2.0,
+    axis: str = "ep",
+    mesh_axes=None,
+    block_f: int = 512,
+    fallback_wire_fp8: bool = False,
+) -> jax.Array:
+    """Full fused-EP MoE: route → ONE-KERNEL dispatch+expert-MLP → combine
+    (reference ``ep_all2all_fused`` end-to-end composition). Falls back to
+    the jit-level ``ep_moe_ll_shard`` when the fused kernel's VMEM plan
+    doesn't fit — with ``fallback_wire_fp8`` deciding that path's wire
+    dtype (the fused kernel itself always moves the model dtype). Inside
+    shard_map."""
+    from triton_dist_tpu.kernels.low_latency_a2a import LLDispatchResult, ll_combine_shard
+    from triton_dist_tpu.kernels.moe_utils import (
+        capacity_for,
+        dispatch as local_dispatch,
+        make_routing_plan,
+        topk_routing,
+    )
+
+    world = jax.lax.axis_size(axis)
+    t, d = x.shape
+    e_local = num_experts // world
+    ff = w_gate.shape[-1]
+    cap = capacity_for(t, top_k, num_experts, capacity_factor)
+
+    if not fused_moe_supported(world, cap, d, ff, x.dtype.itemsize, block_f):
+        from triton_dist_tpu.kernels.low_latency_a2a import ep_moe_ll_shard
+
+        return ep_moe_ll_shard(
+            x, w_router, w_gate, w_up, w_down, num_experts=num_experts,
+            top_k=top_k, capacity_factor=capacity_factor, axis=axis,
+            mesh_axes=mesh_axes, use_pallas=True, wire_fp8=fallback_wire_fp8,
+        )
+
+    logits = jnp.dot(x, w_router, preferred_element_type=jnp.float32)
+    idx, w = topk_routing(logits, top_k)
+    plan = make_routing_plan(idx, num_experts, cap)
+    send = local_dispatch(x, plan).reshape(world, e_local * cap, d)
+    y = fused_dispatch_mlp_shard(
+        send, w_gate, w_up, w_down, capacity=cap, axis=axis,
+        mesh_axes=mesh_axes, block_f=block_f,
+    )
+    disp = LLDispatchResult(expert_inputs=y, plan=plan, num_tokens=t)
+    return ll_combine_shard(y, disp, w, axis=axis, mesh_axes=mesh_axes, use_pallas=True)

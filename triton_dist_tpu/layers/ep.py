@@ -39,9 +39,25 @@ class EP_MoE:
     # Low-latency v2 path: fp8 wire + per-expert layout + fused one-jit
     # dispatch→groupGEMM→combine (reference low_latency_all_to_all_v2.py).
     low_latency: bool = static_field(default=False)
+    # Mega-EP path: dispatch + grouped expert MLP in ONE Pallas kernel
+    # (kernels/ep_fused.py, reference ep_all2all_fused.py); falls back to
+    # the jit-level composition when its VMEM plan doesn't fit.
+    fused_kernel: bool = static_field(default=False)
 
     def __call__(self, x: jax.Array) -> jax.Array:
         """x: (T, d) this rank's tokens → (T, d). Inside shard_map."""
+        if self.fused_kernel:
+            from triton_dist_tpu.kernels.ep_fused import ep_moe_fused_kernel_shard
+
+            # If low_latency is ALSO set, honor its fp8 wire in the
+            # VMEM-fallback path (the fused kernel itself is model-dtype).
+            return ep_moe_fused_kernel_shard(
+                x, self.w_router, self.w_gate, self.w_up, self.w_down,
+                num_experts=self.num_experts, top_k=self.top_k,
+                capacity_factor=self.capacity_factor,
+                axis=self.axis, mesh_axes=self.mesh_axes,
+                fallback_wire_fp8=self.low_latency,
+            )
         if self.low_latency:
             from triton_dist_tpu.kernels.low_latency_a2a import ep_moe_ll_shard
 

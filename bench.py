@@ -170,6 +170,52 @@ def bench_swiglu(on_tpu):
     return {"tflops": 4.0 * m * n * k / t_pallas / 1e12, "vs_xla": t_xla / t_pallas}
 
 
+def bench_flash_bwd(on_tpu):
+    """Training path: Pallas flash backward (dq + dk/dv kernels) vs XLA
+    autodiff of the dense SDPA composition (r2: 4.1× on-chip)."""
+    from triton_dist_tpu.function import flash_attention_fn
+    from triton_dist_tpu.tools.timing import bench_device_time
+
+    if on_tpu:
+        b, hq, hkv, s, d = 4, 32, 8, 2048, 128
+        dtype = jnp.bfloat16
+    else:
+        b, hq, hkv, s, d = 1, 4, 2, 128, 32
+        dtype = jnp.float32
+    key = jax.random.PRNGKey(5)
+    q = jax.random.normal(key, (b, hq, s, d), jnp.float32).astype(dtype)
+    k = jax.random.normal(key, (b, hkv, s, d), jnp.float32).astype(dtype)
+    v = jax.random.normal(key, (b, hkv, s, d), jnp.float32).astype(dtype)
+
+    def loss_ours(q_, k_, v_):
+        return jnp.sum(flash_attention_fn(q_, k_, v_, True).astype(jnp.float32))
+
+    def sdpa_loss(q_, k_, v_):
+        g = hq // hkv
+        kf = jnp.repeat(k_, g, axis=1).astype(jnp.float32)
+        vf = jnp.repeat(v_, g, axis=1).astype(jnp.float32)
+        sc = jnp.einsum("bhqd,bhkd->bhqk", q_.astype(jnp.float32), kf) * (d ** -0.5)
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        sc = jnp.where(mask, sc, -jnp.inf)
+        p = jax.nn.softmax(sc, -1)
+        return jnp.sum(jnp.einsum("bhqk,bhkd->bhqd", p, vf))
+
+    # The clip keeps chained values finite (bench_device_time feeds outputs
+    # back as inputs; raw sum-loss grads grow without bound over the chain).
+    chain = lambda out, args: tuple(
+        jnp.clip(o, -1, 1).astype(a.dtype) for o, a in zip(out, args)
+    )
+    t_ours = bench_device_time(
+        jax.grad(loss_ours, argnums=(0, 1, 2)), (q, k, v), chain=chain
+    )
+    t_xla = bench_device_time(
+        jax.grad(sdpa_loss, argnums=(0, 1, 2)), (q, k, v), chain=chain
+    )
+    # fwd-recompute + bwd ≈ 2.5× the causal forward FLOPs.
+    flops = 2 * 2 * b * hq * s * s * d / 2 * 2.5
+    return {"tflops": flops / t_ours / 1e12, "vs_xla": t_xla / t_ours}
+
+
 def bench_overlap_model(on_tpu, flash_tflops):
     """Perf-model accounting (reference comm/gemm perf models): roofline
     fractions for the measured kernels and the analytic overlap budget the
@@ -291,7 +337,8 @@ def main():
     on_tpu = jax.devices()[0].platform != "cpu"
     f = bench_flash(on_tpu)
     for name, fn in (("gemm", bench_gemm), ("gemm_swiglu", bench_swiglu),
-                     ("ag_gemm_fused_w1", bench_ag_gemm_world1)):
+                     ("ag_gemm_fused_w1", bench_ag_gemm_world1),
+                     ("flash_bwd", bench_flash_bwd)):
         if remaining() < 60:
             extra[f"{name}_skipped"] = "budget"
             continue

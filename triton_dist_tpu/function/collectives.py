@@ -209,11 +209,11 @@ group_gemm_swiglu_fn.defvjp(_ggsw_fwd, _ggsw_bwd)
 @partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def flash_attention_fn(q, k, v, causal: bool = True, scale: float | None = None):
     """Differentiable flash attention: the Pallas forward (which autodiff
-    can't trace) + a chunked-recompute backward — O(S) memory, standard
-    memory-efficient-attention math (dv = pᵀ·do, dp = do·vᵀ,
-    ds = p∘(dp − δ) with δ_i = Σ_j do_ij·o_ij, dq = ds·k, dk = dsᵀ·q),
-    blocks swept with a lax.scan whose carry accumulates dq, so no (S, S)
-    tensor ever materializes."""
+    can't trace) + the Pallas backward (``flash_attention_bwd`` — dq and
+    dk/dv passes recomputing p exactly from the saved LSE) — O(S) memory,
+    standard memory-efficient-attention math (dv = pᵀ·do, dp = do·vᵀ,
+    ds = p∘(dp − δ) with δ_i = Σ_j do_ij·o_ij, dq = ds·k, dk = dsᵀ·q);
+    no (S, S) tensor ever materializes in HBM."""
     from triton_dist_tpu.kernels.flash_attn import flash_attention
 
     return flash_attention(q, k, v, causal=causal, scale=scale)
@@ -227,55 +227,10 @@ def _flash_fwd(q, k, v, causal, scale):
 
 
 def _flash_bwd(causal, scale, res, do):
-    from triton_dist_tpu.kernels.gemm import fit_block
+    from triton_dist_tpu.kernels.flash_attn import flash_attention_bwd
 
     q, k, v, o, lse = res
-    b, hq, sq, d = q.shape
-    hkv, sk = k.shape[1], k.shape[2]
-    group = hq // hkv
-    sc = scale if scale is not None else d ** -0.5
-    block = fit_block(sk, 1024)
-    n_blocks = sk // block
-
-    # Group-major views keep the GQA fold INSIDE the block contractions
-    # (no group-times repeated K/V, dk/dv emitted at hkv directly).
-    q32 = q.astype(jnp.float32).reshape(b, hkv, group, sq, d)
-    do32 = do.astype(jnp.float32).reshape(b, hkv, group, sq, d)
-    lse_g = lse.reshape(b, hkv, group, sq)
-    # δ_i = Σ_d do·o — the softmax-normalization correction term.
-    delta = jnp.sum(do32 * o.astype(jnp.float32).reshape(q32.shape), axis=-1)
-    k32 = k.astype(jnp.float32)
-    v32 = v.astype(jnp.float32)
-    q_ids = jnp.arange(sq) + (sk - sq)  # end-aligned causal convention
-
-    def step(dq_acc, i):
-        ks = jax.lax.dynamic_slice_in_dim(k32, i * block, block, axis=2)
-        vs = jax.lax.dynamic_slice_in_dim(v32, i * block, block, axis=2)
-        s = jnp.einsum("bhgqd,bhkd->bhgqk", q32, ks) * sc
-        # p from the saved LSE: exp(s - lse) is exact softmax (no re-max).
-        p = jnp.exp(s - lse_g[..., None])
-        if causal:
-            k_ids = i * block + jnp.arange(block)
-            p = jnp.where(q_ids[:, None] >= k_ids[None, :], p, 0.0)
-        dv_b = jnp.einsum("bhgqk,bhgqd->bhkd", p, do32)
-        dp = jnp.einsum("bhgqd,bhkd->bhgqk", do32, vs)
-        ds = p * (dp - delta[..., None]) * sc
-        dq_acc = dq_acc + jnp.einsum("bhgqk,bhkd->bhgqd", ds, ks)
-        dk_b = jnp.einsum("bhgqk,bhgqd->bhkd", ds, q32)
-        return dq_acc, (dk_b, dv_b)
-
-    # Scan carries the dq accumulator (O(S) memory — only per-block dk/dv
-    # slices stack, and those tile the sk axis exactly once).
-    dq, (dk_bs, dv_bs) = jax.lax.scan(
-        step, jnp.zeros_like(q32), jnp.arange(n_blocks)
-    )
-    dk = jnp.moveaxis(dk_bs, 0, 2).reshape(b, hkv, sk, d)
-    dv = jnp.moveaxis(dv_bs, 0, 2).reshape(b, hkv, sk, d)
-    return (
-        dq.reshape(b, hq, sq, d).astype(q.dtype),
-        dk.astype(k.dtype),
-        dv.astype(v.dtype),
-    )
+    return flash_attention_bwd(q, k, v, o, lse, do, causal=causal, scale=scale)
 
 
 flash_attention_fn.defvjp(_flash_fwd, _flash_bwd)

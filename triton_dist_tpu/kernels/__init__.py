@@ -90,6 +90,7 @@ from triton_dist_tpu.kernels.low_latency_a2a import (
     dequantize_fp8,
     ep_moe_ll_shard,
     ll_combine_shard,
+    combine_leg_shard,
     ll_dispatch_shard,
     quantize_fp8,
 )
@@ -161,6 +162,7 @@ __all__ = [
     "dequantize_fp8",
     "ll_dispatch_shard",
     "ll_combine_shard",
+    "combine_leg_shard",
     "ep_moe_ll_shard",
     "a2a_gemm_shard",
     "gemm_a2a_shard",

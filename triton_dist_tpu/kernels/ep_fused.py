@@ -17,7 +17,7 @@ communication. TPU redesign of the same idea:
   communication buffers must be pallas inputs/outputs, not ANY scratch) and
   are re-gathered per expert into VMEM once per expert — token panels are
   tiny next to expert weights in the decode regime this serves.
-* The combine leg stays at jit level (``ll_combine_shard``) — its return
+* The combine leg stays at jit level (``combine_leg_shard``) — its return
   a2a is dominated by the down-GEMM it follows, which XLA already overlaps.
 
 Capacity/limits: the per-expert token panel ``(world·C, d)`` (×2: input +
@@ -221,14 +221,17 @@ def ep_moe_fused_kernel_shard(
     mesh_axes=None,
     block_f: int = 512,
     fallback_wire_fp8: bool = False,
+    use_pallas_a2a: bool = True,
 ) -> jax.Array:
     """Full fused-EP MoE: route → ONE-KERNEL dispatch+expert-MLP → combine
     (reference ``ep_all2all_fused`` end-to-end composition). Falls back to
     the jit-level ``ep_moe_ll_shard`` when the fused kernel's VMEM plan
     doesn't fit — with ``fallback_wire_fp8`` deciding that path's wire
-    dtype (the fused kernel itself always moves the model dtype). Inside
+    dtype (the fused kernel itself always moves the model dtype) and
+    ``use_pallas_a2a`` selecting the fallback's and combine leg's transport
+    (the fused kernel's in-kernel a2a is inherently the pallas one). Inside
     shard_map."""
-    from triton_dist_tpu.kernels.low_latency_a2a import LLDispatchResult, ll_combine_shard
+    from triton_dist_tpu.kernels.low_latency_a2a import combine_leg_shard
     from triton_dist_tpu.kernels.moe_utils import (
         capacity_for,
         dispatch as local_dispatch,
@@ -248,7 +251,8 @@ def ep_moe_fused_kernel_shard(
         return ep_moe_ll_shard(
             x, w_router, w_gate, w_up, w_down, num_experts=num_experts,
             top_k=top_k, capacity_factor=capacity_factor, axis=axis,
-            mesh_axes=mesh_axes, use_pallas=True, wire_fp8=fallback_wire_fp8,
+            mesh_axes=mesh_axes, use_pallas=use_pallas_a2a,
+            wire_fp8=fallback_wire_fp8,
         )
 
     logits = jnp.dot(x, w_router, preferred_element_type=jnp.float32)
@@ -259,5 +263,6 @@ def ep_moe_fused_kernel_shard(
         send, w_gate, w_up, w_down, capacity=cap, axis=axis,
         mesh_axes=mesh_axes, block_f=block_f,
     )
-    disp = LLDispatchResult(expert_inputs=y, plan=plan, num_tokens=t)
-    return ll_combine_shard(y, disp, w, axis=axis, mesh_axes=mesh_axes, use_pallas=True)
+    return combine_leg_shard(
+        y, plan, t, w, axis=axis, mesh_axes=mesh_axes, use_pallas=use_pallas_a2a
+    )

@@ -112,6 +112,33 @@ def ll_dispatch_shard(
     return LLDispatchResult(expert_inputs=expert_inputs, plan=plan, num_tokens=t)
 
 
+def combine_leg_shard(
+    y: jax.Array,  # (E_local, world*C, d) expert outputs
+    plan: RoutingPlan,
+    num_tokens: int,
+    weights: jax.Array,  # (T, K)
+    *,
+    axis: str = "ep",
+    mesh_axes=None,
+    use_pallas: bool = True,
+) -> jax.Array:
+    """Return leg + weighted reduce from an explicit routing plan (model
+    dtype on the wire — combine precision is a quality choice, matching the
+    reference's v2 combine). The narrow entry point: callers that produced
+    ``y`` without an ``LLDispatchResult`` (e.g. the fused mega-EP kernel)
+    use this directly."""
+    world = jax.lax.axis_size(axis)
+    e_local, wc, d = y.shape
+    capacity = wc // world
+    send = ungroup_to_peers(y, world, e_local, capacity)
+    recv = all_to_all_single_shard(
+        send, axis=axis, mesh_axes=mesh_axes, use_pallas=use_pallas
+    )
+    return combine(
+        recv.reshape(world * e_local, capacity, d), plan, weights, num_tokens
+    )
+
+
 def ll_combine_shard(
     y: jax.Array,  # (E_local, world*C, d) expert outputs
     disp: LLDispatchResult,
@@ -121,17 +148,10 @@ def ll_combine_shard(
     mesh_axes=None,
     use_pallas: bool = True,
 ) -> jax.Array:
-    """Return leg + weighted reduce (model dtype on the wire — combine
-    precision is a quality choice, matching the reference's v2 combine)."""
-    world = jax.lax.axis_size(axis)
-    e_local, wc, d = y.shape
-    capacity = wc // world
-    send = ungroup_to_peers(y, world, e_local, capacity)
-    recv = all_to_all_single_shard(
-        send, axis=axis, mesh_axes=mesh_axes, use_pallas=use_pallas
-    )
-    return combine(
-        recv.reshape(world * e_local, capacity, d), disp.plan, weights, disp.num_tokens
+    """``combine_leg_shard`` bound to a dispatch result."""
+    return combine_leg_shard(
+        y, disp.plan, disp.num_tokens, weights,
+        axis=axis, mesh_axes=mesh_axes, use_pallas=use_pallas,
     )
 
 

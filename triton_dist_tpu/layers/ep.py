@@ -57,6 +57,7 @@ class EP_MoE:
                 capacity_factor=self.capacity_factor,
                 axis=self.axis, mesh_axes=self.mesh_axes,
                 fallback_wire_fp8=self.low_latency,
+                use_pallas_a2a=self.use_pallas_a2a,
             )
         if self.low_latency:
             from triton_dist_tpu.kernels.low_latency_a2a import ep_moe_ll_shard

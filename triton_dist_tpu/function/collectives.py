@@ -234,3 +234,76 @@ def _flash_bwd(causal, scale, res, do):
 
 
 flash_attention_fn.defvjp(_flash_fwd, _flash_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def flash_attention_lse_fn(q, k, v, q_offset, kv_offset,
+                           causal=True, scale=None, block_q=1024, block_k=1024):
+    """Differentiable flash attention returning (o, lse) — the ring-step
+    primitive. ``q_offset``/``kv_offset`` are traced int32 scalars placing
+    the shard in global coordinates (uniform per-rank programs; their
+    cotangents are float0). The LSE output is differentiable too: its
+    cotangent folds into the backward's δ correction, which is how ring
+    LSE-merge gradients reach each step's partial."""
+    from triton_dist_tpu.kernels.flash_attn import flash_attention
+
+    return flash_attention(
+        q, k, v, causal=causal, scale=scale, block_q=block_q, block_k=block_k,
+        return_lse=True, q_offset=q_offset, kv_offset=kv_offset,
+    )
+
+
+def _flash_lse_fwd(q, k, v, q_offset, kv_offset, causal, scale, block_q, block_k):
+    out = flash_attention_lse_fn(
+        q, k, v, q_offset, kv_offset, causal, scale, block_q, block_k
+    )
+    o, lse = out
+    return out, (q, k, v, o, lse, q_offset, kv_offset)
+
+
+def _flash_lse_bwd(causal, scale, block_q, block_k, res, cots):
+    import numpy as np
+
+    from triton_dist_tpu.kernels.flash_attn import flash_attention_bwd
+
+    q, k, v, o, lse, q_offset, kv_offset = res
+    do, dlse = cots
+    dq, dk, dv = flash_attention_bwd(
+        q, k, v, o, lse, do, causal=causal, scale=scale,
+        block_q=block_q, block_k=block_k,
+        q_offset=q_offset, kv_offset=kv_offset, dlse=dlse,
+    )
+    zero = lambda x: np.zeros(jnp.shape(x), jax.dtypes.float0)
+    return dq, dk, dv, zero(q_offset), zero(kv_offset)
+
+
+flash_attention_lse_fn.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
+def ring_attention_fn(
+    q, k, v, *, axis: str = "sp", causal: bool = True, scale=None,
+    block_q: int = 256, block_k: int = 256,
+):
+    """DIFFERENTIABLE ring attention (long-context training): the same
+    uniform blockwise-causal ring as ``kernels.sp.ring_attention_shard`` —
+    KV rotates over ``ppermute``, each step one offset-masked flash call,
+    partials LSE-merged — but built on ``flash_attention_lse_fn`` so
+    ``jax.grad`` flows through every step (the per-step backward is the
+    Pallas kernel pair; ppermute transposes to the reverse rotation).
+    Inside shard_map. Reference: training through the SP attention layers
+    (``sp_ag_attention_*`` under the L9 autograd functions)."""
+    from triton_dist_tpu.kernels.sp import ring_schedule
+
+    world = jax.lax.axis_size(axis)
+    if world == 1:
+        zero = jnp.int32(0)
+        return flash_attention_lse_fn(
+            q, k, v, zero, zero, causal, scale, block_q, block_k
+        )[0]
+
+    def attend(q_, k_, v_, q_off, kv_off, causal_step):
+        return flash_attention_lse_fn(
+            q_, k_, v_, q_off, kv_off, causal_step, scale, block_q, block_k
+        )
+
+    return ring_schedule(q, k, v, axis=axis, causal=causal, attend=attend)

@@ -221,7 +221,7 @@ def ep_moe_fused_kernel_shard(
     mesh_axes=None,
     block_f: int = 512,
     fallback_wire_fp8: bool = False,
-    use_pallas_a2a: bool = True,
+    use_pallas_a2a: bool = False,
 ) -> jax.Array:
     """Full fused-EP MoE: route → ONE-KERNEL dispatch+expert-MLP → combine
     (reference ``ep_all2all_fused`` end-to-end composition). Falls back to
@@ -229,8 +229,9 @@ def ep_moe_fused_kernel_shard(
     doesn't fit — with ``fallback_wire_fp8`` deciding that path's wire
     dtype (the fused kernel itself always moves the model dtype) and
     ``use_pallas_a2a`` selecting the fallback's and combine leg's transport
-    (the fused kernel's in-kernel a2a is inherently the pallas one). Inside
-    shard_map."""
+    (default False = XLA, matching ``EP_MoE.use_pallas_a2a``; the fused
+    kernel's own in-kernel a2a is inherently the pallas one either way).
+    Inside shard_map."""
     from triton_dist_tpu.kernels.low_latency_a2a import combine_leg_shard
     from triton_dist_tpu.kernels.moe_utils import (
         capacity_for,

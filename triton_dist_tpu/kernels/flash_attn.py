@@ -445,8 +445,9 @@ def attention_reference(q, k, v, *, causal=True, scale=None):
 
 
 def _flash_bwd_dq_kernel(
+    offs_ref,  # SMEM (2,) int32 [q_offset, kv_offset] or None (static)
     lse2_ref,  # (1, 1, bq) f32 — saved LSE × log2(e)
-    delta_ref,  # (1, 1, bq) f32 — Σ_d do·o
+    delta_ref,  # (1, 1, bq) f32 — Σ_d do·o − dlse
     q_ref,  # (1, bq, d)
     k_ref,  # (1, bk, d)
     v_ref,  # (1, bk, d)
@@ -463,10 +464,13 @@ def _flash_bwd_dq_kernel(
     sq: int,
 ):
     """dq pass: same sweep as the forward, p recomputed exactly from the
-    saved LSE (exp2 domain, no re-max), dq accumulated over kv blocks."""
+    saved LSE (exp2 domain, no re-max), dq accumulated over kv blocks.
+    Dynamic offsets keep every ring rank's program uniform, like the
+    forward; fully-masked rows (lse ≈ -inf from a skipped ring step) are
+    guarded to p = 0 so their zero cotangents never meet an inf."""
     iq = pl.program_id(1)
     ik = pl.program_id(2)
-    q_off = kv_len - sq
+    q_off = offs_ref[0] - offs_ref[1] if offs_ref is not None else kv_len - sq
     LOG2E = 1.4426950408889634
 
     @pl.when(ik == 0)
@@ -487,8 +491,12 @@ def _flash_bwd_dq_kernel(
                 jnp.int32, (block_q, block_k), 1
             )
             s2 = jnp.where(q_ids >= k_ids, s2, NEG_INF)
-        # Exact softmax from the saved LSE; masked positions give exp2(-inf)=0.
-        p = jnp.exp2(s2 - lse2_ref[0, 0][:, None])  # (bq, bk) f32
+        lse2 = lse2_ref[0, 0][:, None]
+        # Exact softmax from the saved LSE; masked positions give exp2(-inf)=0,
+        # and rows whose whole step was masked (lse2 ≈ -inf → exp2(+inf)) are
+        # forced to 0.
+        p = jnp.exp2(s2 - lse2)  # (bq, bk) f32
+        p = jnp.where(lse2 > NEG_INF * 0.5, p, 0.0)
         dp = jax.lax.dot_general(
             do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -532,12 +540,19 @@ def flash_attention_bwd(
     scale: float | None = None,
     block_q: int = 1024,
     block_k: int = 1024,
+    q_offset: jax.Array | None = None,
+    kv_offset: jax.Array | None = None,
+    dlse: jax.Array | None = None,  # (B, Hq, Sq) LSE cotangent (ring merges)
 ):
     """Pallas flash-attention backward: two kernels (dq; dk/dv), O(S) memory,
-    p recomputed exactly from the saved LSE in the exp2 domain. 1.6× the XLA
-    SDPA grad as a lax.scan composition; the kernels lift the block matmuls
-    onto the MXU with f32 (bq, bk) intermediates never touching HBM.
-    Returns (dq, dk, dv) in the input dtypes."""
+    p recomputed exactly from the saved LSE in the exp2 domain (4.1× the XLA
+    SDPA grad on-chip); the kernels lift the block matmuls onto the MXU with
+    f32 (bq, bk) intermediates never touching HBM.
+
+    ``q_offset``/``kv_offset`` mirror the forward's dynamic global positions
+    (uniform ring programs). ``dlse`` is the LSE output's cotangent: it folds
+    into the δ correction (ds = p∘(dp − δ + dlse)), which is how ring-merge
+    gradients flow back through each step's partial. Returns (dq, dk, dv)."""
     b, hq, sq, d = q.shape
     _, hkv, sk, _ = k.shape
     group = hq // hkv
@@ -547,56 +562,85 @@ def flash_attention_bwd(
     n_q = sq // block_q
     n_kv = sk // block_k
     LOG2E = 1.4426950408889634
+    dynamic = q_offset is not None or kv_offset is not None
 
     lse2 = (lse.astype(jnp.float32) * LOG2E).reshape(b * hq, 1, sq)
     delta = jnp.sum(
         do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
-    ).reshape(b * hq, 1, sq)
+    )
+    if dlse is not None:
+        delta = delta - dlse.astype(jnp.float32).reshape(delta.shape)
+    delta = delta.reshape(b * hq, 1, sq)
     qr = q.reshape(b * hq, sq, d)
     kr = k.reshape(b * hkv, sk, d)
     vr = v.reshape(b * hkv, sk, d)
     dor = do.reshape(b * hq, sq, d)
 
-    def kv_index(bh, iq_, ik_):
+    def kv_index(bh, iq_, ik_, *_):
         return (bh // hq) * hkv + (bh % hq) // group, ik_, 0
 
+    dq_kernel = functools.partial(
+        _flash_bwd_dq_kernel, scale=sc, causal=causal, block_q=block_q,
+        block_k=block_k, n_kv=n_kv, kv_len=sk, sq=sq,
+    )
+    if dynamic:
+        dq_kernel_fn = dq_kernel
+        offs = jnp.array(
+            [
+                0 if q_offset is None else q_offset,
+                0 if kv_offset is None else kv_offset,
+            ],
+            jnp.int32,
+        )
+        dq_operands = (offs, lse2, delta, qr, kr, vr, dor)
+    else:
+        dq_kernel_fn = lambda *refs: dq_kernel(None, *refs)
+        dq_operands = (lse2, delta, qr, kr, vr, dor)
+
     dq = pl.pallas_call(
-        functools.partial(
-            _flash_bwd_dq_kernel, scale=sc, causal=causal, block_q=block_q,
-            block_k=block_k, n_kv=n_kv, kv_len=sk, sq=sq,
+        dq_kernel_fn,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1 if dynamic else 0,
+            grid=(b * hq, n_q, n_kv),
+            in_specs=[
+                pl.BlockSpec((1, 1, block_q), lambda bh, iq, ik, *_: (bh, 0, iq)),
+                pl.BlockSpec((1, 1, block_q), lambda bh, iq, ik, *_: (bh, 0, iq)),
+                pl.BlockSpec((1, block_q, d), lambda bh, iq, ik, *_: (bh, iq, 0)),
+                pl.BlockSpec((1, block_k, d), kv_index),
+                pl.BlockSpec((1, block_k, d), kv_index),
+                pl.BlockSpec((1, block_q, d), lambda bh, iq, ik, *_: (bh, iq, 0)),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, block_q, d), lambda bh, iq, ik, *_: (bh, iq, 0)
+            ),
+            scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         ),
-        grid=(b * hq, n_q, n_kv),
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q), lambda bh, iq, ik: (bh, 0, iq)),
-            pl.BlockSpec((1, 1, block_q), lambda bh, iq, ik: (bh, 0, iq)),
-            pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0)),
-            pl.BlockSpec((1, block_k, d), kv_index),
-            pl.BlockSpec((1, block_k, d), kv_index),
-            pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0)),
         out_shape=jax.ShapeDtypeStruct((b * hq, sq, d), q.dtype),
-        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret_mode_default(),
-    )(lse2, delta, qr, kr, vr, dor)
+    )(*dq_operands)
 
     # dk/dv: innermost grid dim jj = gi * n_q + qi walks the GQA group and
     # the q blocks; all q-side operands index through jj.
-    def q_row(bh, ik_, jj):
+    def q_row(bh, ik_, jj, *_):
         return bh * group + jj // n_q, jj % n_q, 0
 
-    def q_scalar(bh, ik_, jj):
+    def q_scalar(bh, ik_, jj, *_):
         return bh * group + jj // n_q, 0, jj % n_q
 
-    def dkv_wrapped(lse2_ref, delta_ref, q_ref, k_ref, v_ref, do_ref,
-                    dk_ref, dv_ref, dk_scr, dv_scr):
+    def dkv_wrapped(*refs):
+        if dynamic:
+            offs_ref, *refs = refs
+        else:
+            offs_ref = None
+        (lse2_ref, delta_ref, q_ref, k_ref, v_ref, do_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = refs
         ik = pl.program_id(1)
         jj = pl.program_id(2)
         iq = jax.lax.rem(jj, n_q)
-        q_off = sk - sq
+        q_off = offs_ref[0] - offs_ref[1] if offs_ref is not None else sk - sq
         n_inner_total = group * n_q
 
         @pl.when(jj == 0)
@@ -619,7 +663,9 @@ def flash_attention_bwd(
                     jnp.int32, (block_q, block_k), 1
                 )
                 s2 = jnp.where(q_ids >= k_ids, s2, NEG_INF)
-            p = jnp.exp2(s2 - lse2_ref[0, 0][:, None])
+            lse2 = lse2_ref[0, 0][:, None]
+            p = jnp.exp2(s2 - lse2)
+            p = jnp.where(lse2 > NEG_INF * 0.5, p, 0.0)
             dv_scr[...] += jax.lax.dot_general(
                 p.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
@@ -657,34 +703,42 @@ def flash_attention_bwd(
             dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
             dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
+    dkv_operands = (
+        (offs, lse2, delta, qr, kr, vr, dor)
+        if dynamic
+        else (lse2, delta, qr, kr, vr, dor)
+    )
     dk, dv = pl.pallas_call(
         dkv_wrapped,
-        grid=(b * hkv, n_kv, group * n_q),
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q), q_scalar),
-            pl.BlockSpec((1, 1, block_q), q_scalar),
-            pl.BlockSpec((1, block_q, d), q_row),
-            pl.BlockSpec((1, block_k, d), lambda bh, ik_, jj: (bh, ik_, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, ik_, jj: (bh, ik_, 0)),
-            pl.BlockSpec((1, block_q, d), q_row),
-        ],
-        out_specs=(
-            pl.BlockSpec((1, block_k, d), lambda bh, ik_, jj: (bh, ik_, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, ik_, jj: (bh, ik_, 0)),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1 if dynamic else 0,
+            grid=(b * hkv, n_kv, group * n_q),
+            in_specs=[
+                pl.BlockSpec((1, 1, block_q), q_scalar),
+                pl.BlockSpec((1, 1, block_q), q_scalar),
+                pl.BlockSpec((1, block_q, d), q_row),
+                pl.BlockSpec((1, block_k, d), lambda bh, ik_, jj, *_: (bh, ik_, 0)),
+                pl.BlockSpec((1, block_k, d), lambda bh, ik_, jj, *_: (bh, ik_, 0)),
+                pl.BlockSpec((1, block_q, d), q_row),
+            ],
+            out_specs=(
+                pl.BlockSpec((1, block_k, d), lambda bh, ik_, jj, *_: (bh, ik_, 0)),
+                pl.BlockSpec((1, block_k, d), lambda bh, ik_, jj, *_: (bh, ik_, 0)),
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((block_k, d), jnp.float32),
+                pltpu.VMEM((block_k, d), jnp.float32),
+            ],
         ),
         out_shape=(
             jax.ShapeDtypeStruct((b * hkv, sk, d), k.dtype),
             jax.ShapeDtypeStruct((b * hkv, sk, d), v.dtype),
         ),
-        scratch_shapes=[
-            pltpu.VMEM((block_k, d), jnp.float32),
-            pltpu.VMEM((block_k, d), jnp.float32),
-        ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret_mode_default(),
-    )(lse2, delta, qr, kr, vr, dor)
+    )(*dkv_operands)
 
     return (
         dq.reshape(b, hq, sq, d),

@@ -42,6 +42,53 @@ def _merge_partials(o1, lse1, o2, lse2):
     return o.astype(o1.dtype), m + jnp.log(denom)
 
 
+def ring_schedule(q, k, v, *, axis: str, causal: bool, attend) -> jax.Array:
+    """THE blockwise-causal ring driver, shared by the inference ring and the
+    differentiable ``function.ring_attention_fn`` (one copy of the schedule
+    whose uniform-program discipline fixed the r1 deadlock).
+
+    KV shard j (global position block j) vs my Q shard ``me``: j < me →
+    unmasked, j == me → causal, j > me → skipped (weight exp(-inf) via the
+    LSE merge). ``attend(q, k_cur, v_cur, q_off, kv_off, causal_step)``
+    returns this step's (o, lse) partial.
+
+    UNIFORM program per step on every rank: one flash call with a
+    step-dependent global-position mask (q rows start at me·S_loc, visiting
+    KV columns at j·S_loc). No per-rank lax.cond — a divergent branch around
+    the ppermute rendezvous deadlocks the XLA CPU collective (and wastes a
+    pipeline slot on real ICI)."""
+    world = jax.lax.axis_size(axis)
+    me = jax.lax.axis_index(axis)
+    perm = [(i, (i + 1) % world) for i in range(world)]
+    s_loc = q.shape[2]
+    zero = jnp.int32(0)
+
+    o = None
+    lse = None
+    k_cur, v_cur = k, v
+    for step in range(world):  # static unroll; ppermute overlaps flash compute
+        j = jnp.mod(me - step, world)  # owner of the visiting KV shard
+        if causal:
+            o_step, lse_step = attend(
+                q, k_cur, v_cur,
+                (me * s_loc).astype(jnp.int32), (j * s_loc).astype(jnp.int32),
+                True,
+            )
+        else:
+            o_step, lse_step = attend(q, k_cur, v_cur, zero, zero, False)
+
+        if o is None:
+            o, lse = o_step, lse_step
+        else:
+            o, lse = _merge_partials(o, lse, o_step, lse_step)
+
+        if step + 1 < world:
+            k_cur = jax.lax.ppermute(k_cur, axis, perm)
+            v_cur = jax.lax.ppermute(v_cur, axis, perm)
+
+    return o
+
+
 def ring_attention_shard(
     q: jax.Array,  # (B, Hq, S_local, D) — this rank's query shard
     k: jax.Array,  # (B, Hkv, S_local, D) — this rank's KV shard
@@ -54,55 +101,22 @@ def ring_attention_shard(
     block_k: int = 256,
 ) -> jax.Array:
     """Exact attention over the full (world·S_local) sequence with Q/K/V
-    sequence-sharded. Usable inside shard_map.
-
-    Blockwise-causal schedule: KV shard j (global position block j) vs my Q
-    shard ``me``: j < me → unmasked, j == me → causal, j > me → skipped
-    (weight exp(-inf) via the LSE merge). Equivalent to the reference's
-    AG-SP attention where flash consumes shards as they arrive.
-    """
+    sequence-sharded (``ring_schedule`` over the Pallas flash kernel).
+    Usable inside shard_map. Equivalent to the reference's AG-SP attention
+    where flash consumes shards as they arrive."""
     world = jax.lax.axis_size(axis)
-    me = jax.lax.axis_index(axis)
     if world == 1:
         return flash_attention(q, k, v, causal=causal, scale=scale, block_q=block_q, block_k=block_k)
 
-    perm = [(i, (i + 1) % world) for i in range(world)]
-    s_loc = q.shape[2]
+    def attend(q_, k_, v_, q_off, kv_off, causal_step):
+        return flash_attention(
+            q_, k_, v_, causal=causal_step, scale=scale,
+            block_q=block_q, block_k=block_k, return_lse=True,
+            q_offset=q_off if causal_step else None,
+            kv_offset=kv_off if causal_step else None,
+        )
 
-    o = None
-    lse = None
-    k_cur, v_cur = k, v
-    for step in range(world):  # static unroll; ppermute overlaps flash compute
-        j = jnp.mod(me - step, world)  # owner of the visiting KV shard
-        if causal:
-            # UNIFORM program per step on every rank: one flash call with a
-            # step-dependent global-position mask (q rows start at me·S_loc,
-            # visiting KV columns at j·S_loc). j < me → fully unmasked,
-            # j == me → diagonal causal, j > me → fully masked (o=0,
-            # lse≈-inf, killed by the LSE merge). No per-rank lax.cond — a
-            # divergent branch around the ppermute rendezvous deadlocks the
-            # XLA CPU collective (and wastes a pipeline slot on real ICI).
-            o_step, lse_step = flash_attention(
-                q, k_cur, v_cur, causal=True, scale=scale,
-                block_q=block_q, block_k=block_k, return_lse=True,
-                q_offset=me * s_loc, kv_offset=j * s_loc,
-            )
-        else:
-            o_step, lse_step = flash_attention(
-                q, k_cur, v_cur, causal=False, scale=scale,
-                block_q=block_q, block_k=block_k, return_lse=True,
-            )
-
-        if o is None:
-            o, lse = o_step, lse_step
-        else:
-            o, lse = _merge_partials(o, lse, o_step, lse_step)
-
-        if step + 1 < world:
-            k_cur = jax.lax.ppermute(k_cur, axis, perm)
-            v_cur = jax.lax.ppermute(v_cur, axis, perm)
-
-    return o
+    return ring_schedule(q, k, v, axis=axis, causal=causal, attend=attend)
 
 
 def ulysses_a2a_qkv(

@@ -182,10 +182,10 @@ def bench_flash_bwd(on_tpu):
     else:
         b, hq, hkv, s, d = 1, 4, 2, 128, 32
         dtype = jnp.float32
-    key = jax.random.PRNGKey(5)
-    q = jax.random.normal(key, (b, hq, s, d), jnp.float32).astype(dtype)
-    k = jax.random.normal(key, (b, hkv, s, d), jnp.float32).astype(dtype)
-    v = jax.random.normal(key, (b, hkv, s, d), jnp.float32).astype(dtype)
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(5), 3)
+    q = jax.random.normal(kq, (b, hq, s, d), jnp.float32).astype(dtype)
+    k = jax.random.normal(kk, (b, hkv, s, d), jnp.float32).astype(dtype)
+    v = jax.random.normal(kv, (b, hkv, s, d), jnp.float32).astype(dtype)
 
     def loss_ours(q_, k_, v_):
         return jnp.sum(flash_attention_fn(q_, k_, v_, True).astype(jnp.float32))
@@ -211,8 +211,10 @@ def bench_flash_bwd(on_tpu):
     t_xla = bench_device_time(
         jax.grad(sdpa_loss, argnums=(0, 1, 2)), (q, k, v), chain=chain
     )
-    # fwd-recompute + bwd ≈ 2.5× the causal forward FLOPs.
-    flops = 2 * 2 * b * hq * s * s * d / 2 * 2.5
+    # FLOP convention: jax.grad executes 1× forward plus a backward whose
+    # matmuls (dv, dp, dq, dk + the s/p recompute in both kernels) come to
+    # ~3.5× the causal forward — 4.5× total is what the timed region does.
+    flops = 2 * 2 * b * hq * s * s * d / 2 * 4.5
     return {"tflops": flops / t_ours / 1e12, "vs_xla": t_xla / t_ours}
 
 
@@ -244,6 +246,47 @@ def bench_overlap_model(on_tpu, flash_tflops):
         # ring time and overlap_efficiency(measured) = t_comm/measured.
         out["ag_gemm_model_comm_over_compute"] = round(t_ag / t_gemm, 3)
     return out
+
+
+def bench_gdn(on_tpu):
+    """Chunked GDN (WY/UT-transform) vs the per-token scan recurrence
+    (reference gdn.py's chunked-vs-recurrent gap). The chain perturbs q and k
+    as well as v: the UT-transform precompute depends only on q/k/α/β, and a
+    v-only chain lets XLA hoist it out of the timing loop entirely."""
+    from triton_dist_tpu.kernels.gdn import gdn_fwd_chunked, gdn_fwd_scan
+    from triton_dist_tpu.tools.timing import bench_device_time
+
+    if on_tpu:
+        h, t, dk, dv = 8, 4096, 128, 128
+        dtype = jnp.bfloat16
+    else:
+        h, t, dk, dv = 2, 256, 32, 32
+        dtype = jnp.float32
+    kq, kk, kv, ka, kb = jax.random.split(jax.random.PRNGKey(7), 5)
+    q = jax.random.normal(kq, (h, t, dk), jnp.float32).astype(dtype)
+    k = jax.random.normal(kk, (h, t, dk), jnp.float32)
+    k = (k / jnp.linalg.norm(k, axis=-1, keepdims=True)).astype(dtype)
+    v = jax.random.normal(kv, (h, t, dv), jnp.float32).astype(dtype)
+    a = 0.9 + 0.1 * jax.random.uniform(ka, (h, t), jnp.float32)
+    b = 0.9 * jax.random.uniform(kb, (h, t), jnp.float32)
+
+    def chain(out, args):
+        q_, k_, v_, a_, b_ = args
+        # (h, t, 1) delta broadcasts against dk regardless of dv == dk.
+        d = jnp.clip(out.astype(jnp.float32), -1e-3, 1e-3).mean(-1, keepdims=True)
+        return ((q_.astype(jnp.float32) + d).astype(q_.dtype),
+                (k_.astype(jnp.float32) + d).astype(k_.dtype),
+                jnp.clip(out.astype(jnp.float32), -1, 1).astype(v_.dtype),
+                a_, b_)
+
+    t_chunk = bench_device_time(
+        lambda *xs: gdn_fwd_chunked(*xs)[0], (q, k, v, a, b), chain=chain,
+        iters=256, base=8)
+    t_scan = bench_device_time(
+        lambda *xs: gdn_fwd_scan(*xs)[0], (q, k, v, a, b), chain=chain,
+        iters=16, base=8)
+    return {"gdn_chunked_ms": round(t_chunk * 1e3, 4),
+            "gdn_speedup_vs_scan": round(t_scan / t_chunk, 2)}
 
 
 def bench_mega_decode(on_tpu):
@@ -349,6 +392,13 @@ def main():
                 extra[f"{name}_vs_xla"] = round(r["vs_xla"], 3)
         except Exception as e:  # noqa: BLE001 — extras must not kill the primary metric
             extra[f"{name}_error"] = f"{type(e).__name__}"
+    if remaining() > 90:
+        try:
+            extra.update(bench_gdn(on_tpu))
+        except Exception as e:  # noqa: BLE001
+            extra["gdn_error"] = f"{type(e).__name__}"
+    else:
+        extra["gdn_skipped"] = "budget"
     try:
         extra.update(bench_overlap_model(on_tpu, f["tflops"]))
     except Exception as e:  # noqa: BLE001

@@ -4,6 +4,8 @@ memory ops, 2D allgather.
 Parity model: reference ``test/nvidia`` per-kernel --check scripts.
 """
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -137,20 +139,94 @@ def test_ulysses_fused_qkv_o_roundtrip(ctx4, rng):
 # ----------------------------------------------------------------------- gdn
 
 
-def test_gdn_fwd_matches_recurrence(rng):
-    from triton_dist_tpu.kernels.gdn import gdn_fwd, gdn_reference
-
-    h, t, dk, dv = 2, 128, 16, 32
+def _gdn_inputs(rng, h, t, dk, dv):
     q = jnp.asarray(rng.standard_normal((h, t, dk)), jnp.float32) * 0.3
     k = jnp.asarray(rng.standard_normal((h, t, dk)), jnp.float32) * 0.3
     v = jnp.asarray(rng.standard_normal((h, t, dv)), jnp.float32) * 0.3
     alpha = jnp.asarray(0.8 + 0.2 * rng.random((h, t)), jnp.float32)
     beta = jnp.asarray(rng.random((h, t)), jnp.float32) * 0.5
+    return q, k, v, alpha, beta
 
-    o, S = jax.jit(gdn_fwd)(q, k, v, alpha, beta)
-    ref = gdn_reference(q, k, v, alpha, beta)
-    np.testing.assert_allclose(np.asarray(o), ref, rtol=1e-4, atol=1e-4)
-    assert S.shape == (h, dk, dv)
+
+def test_gdn_fwd_matches_recurrence(rng):
+    """Fused chunked Pallas kernel vs the per-token oracle (incl. T not a
+    multiple of the chunk, which exercises the no-op padding)."""
+    from triton_dist_tpu.kernels.gdn import gdn_fwd, gdn_reference
+
+    for t, impl in ((128, "pallas"), (100, "pallas"), (128, "auto")):
+        h, dk, dv = 2, 16, 32
+        q, k, v, alpha, beta = _gdn_inputs(rng, h, t, dk, dv)
+        o, S = jax.jit(functools.partial(gdn_fwd, chunk_size=32, impl=impl))(
+            q, k, v, alpha, beta)
+        ref_o, ref_S = gdn_reference(q, k, v, alpha, beta)
+        np.testing.assert_allclose(np.asarray(o), ref_o, rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(S), ref_S, rtol=1e-4, atol=1e-4)
+
+
+def test_gdn_chunked_jnp_and_warm_state(rng):
+    """Pure-jnp chunked path == oracle; warm-state resume: running the back
+    half from the front half's final state matches one full run."""
+    from triton_dist_tpu.kernels.gdn import gdn_fwd, gdn_fwd_chunked, gdn_reference
+
+    h, t, dk, dv = 2, 96, 16, 32
+    q, k, v, alpha, beta = _gdn_inputs(rng, h, t, dk, dv)
+    o, S = jax.jit(functools.partial(gdn_fwd_chunked, chunk_size=32))(
+        q, k, v, alpha, beta)
+    ref_o, ref_S = gdn_reference(q, k, v, alpha, beta)
+    np.testing.assert_allclose(np.asarray(o), ref_o, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(S), ref_S, rtol=1e-4, atol=1e-4)
+
+    half = t // 2
+    sl = lambda x, a, b: x[:, a:b]
+    o1, s1 = gdn_fwd(sl(q, 0, half), sl(k, 0, half), sl(v, 0, half),
+                     sl(alpha, 0, half), sl(beta, 0, half), chunk_size=32)
+    o2, s2 = gdn_fwd(sl(q, half, t), sl(k, half, t), sl(v, half, t),
+                     sl(alpha, half, t), sl(beta, half, t), state=s1,
+                     chunk_size=32)
+    np.testing.assert_allclose(np.asarray(o2), ref_o[:, half:], rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(s2), ref_S, rtol=1e-4, atol=1e-4)
+
+
+def test_gdn_backward_matches_scan_grads(rng):
+    """custom_vjp backward (chunked recompute) vs autodiff of the per-token
+    scan recurrence."""
+    from triton_dist_tpu.kernels.gdn import gdn_fwd, gdn_fwd_scan
+
+    h, t, dk, dv = 1, 64, 8, 16
+    q, k, v, alpha, beta = _gdn_inputs(rng, h, t, dk, dv)
+
+    def loss(fn):
+        def f(q_, k_, v_, a_, b_):
+            o, S = fn(q_, k_, v_, a_, b_)
+            return jnp.sum(o * o) + jnp.sum(S * S)
+        return f
+
+    g_chunk = jax.grad(loss(functools.partial(gdn_fwd, chunk_size=16)),
+                       argnums=(0, 1, 2, 3, 4))(q, k, v, alpha, beta)
+    g_scan = jax.grad(loss(gdn_fwd_scan), argnums=(0, 1, 2, 3, 4))(
+        q, k, v, alpha, beta)
+    for gc, gs in zip(g_chunk, g_scan):
+        np.testing.assert_allclose(np.asarray(gc), np.asarray(gs),
+                                   rtol=5e-3, atol=5e-3)
+
+
+def test_gdn_bf16_dtype_and_grads(rng):
+    """Output dtype follows v's dtype on every impl, and the pallas
+    custom_vjp backward accepts bf16 cotangents (regression: the chunked
+    path's f32 cast used to leak into the output dtype)."""
+    from triton_dist_tpu.kernels.gdn import gdn_fwd
+
+    h, t, dk, dv = 1, 32, 8, 16
+    q, k, v, alpha, beta = (x.astype(jnp.bfloat16) if x.ndim == 3 else x
+                            for x in _gdn_inputs(rng, h, t, dk, dv))
+    for impl in ("chunked", "pallas", "scan"):
+        o, S = gdn_fwd(q, k, v, alpha, beta, chunk_size=16, impl=impl)
+        assert o.dtype == jnp.bfloat16, impl
+        assert S.dtype == jnp.float32, impl
+        g = jax.grad(lambda q_: jnp.sum(
+            gdn_fwd(q_, k, v, alpha, beta, chunk_size=16, impl=impl)[0]
+            .astype(jnp.float32)))(q)
+        assert np.isfinite(np.asarray(g, np.float32)).all(), impl
 
 
 # ---------------------------------------------------------------- memory ops

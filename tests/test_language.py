@@ -156,3 +156,22 @@ def test_notify_remote_accumulate(ctx4):
 
     out = np.asarray(shard(ctx4, body, (), P("tp"))())
     np.testing.assert_array_equal(out[:, 0], [1, 0, 0, 0])
+
+
+def test_collective_id_registry_refuses_aliasing():
+    """The 33rd distinct collective kernel must error loudly, not silently
+    alias kernel #1's barrier semaphore (id pool wraps at 32)."""
+    from triton_dist_tpu.shmem import kernel as K
+
+    saved = dict(K._collective_id_registry)
+    try:
+        K._collective_id_registry.clear()
+        ids = [K.collective_id_for(f"k{i}") for i in range(K.MAX_COLLECTIVE_IDS)]
+        assert ids == list(range(K.MAX_COLLECTIVE_IDS))
+        # re-registration of an existing name is free
+        assert K.collective_id_for("k0") == 0
+        with pytest.raises(RuntimeError, match="alias"):
+            K.collective_id_for("one_too_many")
+    finally:
+        K._collective_id_registry.clear()
+        K._collective_id_registry.update(saved)

@@ -1,39 +1,348 @@
-"""Gated DeltaNet (GDN) forward — linear attention with the gated delta rule.
+"""Gated DeltaNet (GDN) — chunked linear attention with the gated delta rule.
 
-Reference: ``python/triton_dist/kernels/nvidia/gdn.py`` (1075 LoC) — gated
-delta-rule forward for Qwen3-Next-style hybrid layers. Recurrence per head
-(state S ∈ R^{dk×dv}):
+Reference: ``python/triton_dist/kernels/nvidia/gdn.py`` (1075 LoC) — the
+chunked tensor-core forward for Qwen3-Next-style hybrid layers, structured as
+three Triton kernels: ``chunk_kkt_inv_ut_fused_kernel`` (:123 — per-chunk
+UT-transform / WY representation), ``chunk_gated_delta_rule_fwd_kernel_h``
+(:482 — inter-chunk state carry), and ``chunk_fwd_o`` (:724 — outputs).
+
+Recurrence per head (state S ∈ R^{dk×dv}, row vectors q/k/v):
 
     S_t = α_t · S_{t-1} + β_t · k_tᵀ (v_t − k_t S_{t-1})
     o_t = q_t S_t
 
-TPU implementation: a per-token ``lax.scan`` carrying S, vmapped over heads
-— exact by construction, fp32 state math (the recurrence is
-precision-sensitive), and XLA pipelines the outer-product updates across
-heads. The reference's chunked tensor-core form (WY-representation /
-UT-transform batching of the intra-chunk triangular dependence) is a known
-further optimization for long sequences and is NOT implemented here; this
-is the correctness-first kernel the rest of the stack builds on.
+Chunked derivation (the TPU-first redesign — one fused kernel instead of the
+reference's three, with the carried state living in VMEM scratch):
+
+With Γ_t = ∏_{j≤t} α_t = e^{G_t} (G = in-chunk cumsum of log α) and the
+substitution S_t = e^{G_t} S_0 + Σ_{j≤t} e^{G_t−G_j} k_jᵀ ũ_j, the auxiliary
+rows ũ solve the *unit lower triangular* system
+
+    (I + A) Ũ = diag(β) V − diag(β_t e^{G_{t−1}}) K S_0,
+    A_{tj} = β_t e^{G_{t−1}−G_j} (k_t·k_j)   for j < t (else 0).
+
+Every exponent is a *relative* in-chunk decay (≤ 0), so nothing overflows.
+(I + A)⁻¹ is computed by Newton doubling — X ← X(2I − MX), exact in ⌈log₂C⌉
+steps for unit-triangular M — i.e. the triangular dependence is batched onto
+the MXU, never solved row-by-row. Then per chunk:
+
+    Ũ  = X·diag(β)V − (X·diag(β e^{G_{t−1}})K) S_0   (= U_v − W S_0)
+    O  = diag(e^{G_t}) Q S_0 + (QKᵀ ⊙ D≤) Ũ,   D≤_{tj} = e^{G_t−G_j}, j ≤ t
+    S' = e^{G_C} S_0 + (diag(e^{G_C−G_j}) K)ᵀ Ũ
+
+Two implementations, equivalence-tested against ``gdn_reference``:
+
+* ``gdn_fwd_chunked`` — the chunk math as batched jnp: phase 1 (everything
+  S0-independent) is vmapped over ALL H·NT chunks at once — huge batched
+  MXU einsums — and phase 2 carries S through an NT-step ``lax.scan``.
+  Differentiable by construction. This is the default (see ``gdn_fwd``).
+* ``_gdn_fwd_pallas`` (``impl="pallas"``) — ONE Pallas kernel, grid
+  (heads, chunks): each step does the whole pipeline in VMEM (~14 MXU
+  matmuls at C=64), carrying S in fp32 scratch across the sequential chunk
+  axis; no HBM round-trip for any intermediate. Measured slower than the
+  hybrid on TPU (the grid serializes chunk-parallel work — see ``gdn_fwd``
+  docstring), kept as the fused-kernel form and exercised by tests.
+  Differentiable via ``jax.custom_vjp`` (backward recomputes through the
+  chunked jnp path).
+
+Warm-state resume (``state=``) is supported by both (the reference threads
+``initial_state`` through ``chunk_gated_delta_rule_fwd_h``, gdn.py:644).
 """
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from triton_dist_tpu.runtime.platform import interpret_mode_default
+
+DEFAULT_CHUNK = 64
+
+
+# --------------------------------------------------------------------------
+# shared chunk math (jnp, used by both the scan path and as the vjp substrate)
+# --------------------------------------------------------------------------
+
+
+def _tri_inverse_unit_lower(m: jax.Array) -> jax.Array:
+    """Inverse of a unit lower-triangular (..., C, C) matrix by Newton
+    doubling: X ← X(2I − MX) squares the error nilpotent each step.
+
+    Matmul precision follows the ambient ``jax.default_matmul_precision``
+    (see ``gdn_fwd``'s ``precision`` kwarg): measured on-chip, forcing only
+    this inversion to HIGHEST doubles chunk cost without moving end-to-end
+    error (the ~4e-3 default-precision error is spread evenly across all the
+    bf16-pass f32 matmuls, not amplified here).
+    """
+    c = m.shape[-1]
+    eye = jnp.eye(c, dtype=m.dtype)
+    x = eye
+    steps = max(1, (c - 1).bit_length())
+    for _ in range(steps):
+        x = x @ (2.0 * eye - m @ x)
+    return x
+
+
+def _chunk_precompute(qc, kc, vc, ac, bc):
+    """Per-chunk S0-independent tensors. Shapes: qc/kc (C, dk), vc (C, dv),
+    ac/bc (C,) or (C, 1). Returns (w, u_v, p, q_gamma, k_out, gamma_c):
+      w (C, dk): Ũ = u_v − w @ S0 ;  p (C, C): O = q_gamma@S0 + p@Ũ ;
+      k_out (C, dk): S' = gamma_c·S0 + k_outᵀ @ Ũ.
+
+    Everything is kept in (C, 1)-column / (C, C) form — in-kernel the cumsum
+    is a tril-ones matmul and no op is rank-1, so Mosaic lowers it all to
+    MXU/VPU work.
+    """
+    c = qc.shape[0]
+    a_col = ac.reshape(c, 1).astype(jnp.float32)
+    b_col = bc.reshape(c, 1).astype(jnp.float32)
+
+    idx = jax.lax.broadcasted_iota(jnp.int32, (c, c), 0)
+    jdx = jax.lax.broadcasted_iota(jnp.int32, (c, c), 1)
+    strict = idx > jdx
+    incl = idx >= jdx
+
+    log_a = jnp.log(a_col)  # (C, 1), ≤ 0
+    g = jnp.where(incl, 1.0, 0.0) @ log_a  # (C, 1) cumsum via tril-ones matmul
+    g_prev = g - log_a  # G_{t-1}
+    kk = kc @ kc.T  # (C, C)
+    qk = qc @ kc.T
+
+    d_prev = jnp.where(strict, jnp.exp(g_prev - g.T), 0.0)
+    a = (b_col * d_prev) * kk  # strictly lower
+    x = _tri_inverse_unit_lower(jnp.eye(c, dtype=a.dtype) + a)
+
+    u_v = x @ (b_col * vc)  # (C, dv)
+    w = x @ ((b_col * jnp.exp(g_prev)) * kc)  # (C, dk)
+    p = qk * jnp.where(incl, jnp.exp(g - g.T), 0.0)  # (C, C)
+    q_gamma = jnp.exp(g) * qc  # (C, dk)
+    gamma_c = jnp.exp(g[c - 1, 0])
+    k_out = jnp.exp(g[c - 1, 0] - g) * kc  # (C, dk)
+    return w, u_v, p, q_gamma, k_out, gamma_c
+
+
+def _chunk_apply(s, w, u_v, p, q_gamma, k_out, gamma_c):
+    """Sequential leg: fold one chunk into state s (dk, dv). Returns (s', o)."""
+    u = u_v - w @ s  # (C, dv)
+    o = q_gamma @ s + p @ u  # (C, dv)
+    s_next = gamma_c * s + k_out.T @ u
+    return s_next, o
+
+
+def _pad_chunks(q, k, v, alpha, beta, c):
+    """Pad T to a multiple of c with no-op tokens (α=1, β=0 leaves S fixed)."""
+    t = q.shape[1]
+    pad = (-t) % c
+    if pad == 0:
+        return q, k, v, alpha, beta, t
+    padt = lambda x, val: jnp.pad(x, [(0, 0), (0, pad)] + [(0, 0)] * (x.ndim - 2),
+                                  constant_values=val)
+    return (padt(q, 0), padt(k, 0), padt(v, 0), padt(alpha, 1.0),
+            padt(beta, 0.0), t)
+
+
+# --------------------------------------------------------------------------
+# pure-jnp chunked path (differentiable substrate + warm state)
+# --------------------------------------------------------------------------
+
+
+def gdn_fwd_chunked(
+    q: jax.Array,  # (H, T, dk)
+    k: jax.Array,
+    v: jax.Array,  # (H, T, dv)
+    alpha: jax.Array,  # (H, T) in (0, 1]
+    beta: jax.Array,  # (H, T)
+    *,
+    state: jax.Array | None = None,  # (H, dk, dv) warm state
+    chunk_size: int = DEFAULT_CHUNK,
+):
+    """Chunked (WY/UT-transform) forward in pure jnp. Returns (o, S_final)."""
+    h, _, dk = q.shape
+    dv = v.shape[-1]
+    out_dtype = v.dtype
+    c = chunk_size
+    q, k, v, alpha, beta, t = _pad_chunks(
+        q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32),
+        alpha.astype(jnp.float32), beta.astype(jnp.float32), c)
+    nt = q.shape[1] // c
+
+    def per_head(qh, kh, vh, ah, bh, s0):
+        ch = lambda x: x.reshape(nt, c, *x.shape[1:])
+        pre = jax.vmap(_chunk_precompute)(ch(qh), ch(kh), ch(vh), ch(ah), ch(bh))
+
+        def step(s, chunk):
+            s_next, o = _chunk_apply(s, *chunk)
+            return s_next, o
+
+        s_fin, o = jax.lax.scan(step, s0, pre)
+        return o.reshape(nt * c, dv), s_fin
+
+    s0 = (jnp.zeros((h, dk, dv), jnp.float32) if state is None
+          else state.astype(jnp.float32))
+    o, s_fin = jax.vmap(per_head)(q, k, v, alpha, beta, s0)
+    return o[:, :t].astype(out_dtype), s_fin
+
+
+# --------------------------------------------------------------------------
+# fused Pallas kernel
+# --------------------------------------------------------------------------
+
+
+def _gdn_kernel(q_ref, k_ref, v_ref, a_ref, b_ref, s0_ref, o_ref, s_ref,
+                s_scr, *, nt: int):
+    ni = pl.program_id(1)
+
+    @pl.when(ni == 0)
+    def _():
+        s_scr[...] = s0_ref[0]
+
+    qc = q_ref[0].astype(jnp.float32)
+    kc = k_ref[0].astype(jnp.float32)
+    vc = v_ref[0].astype(jnp.float32)
+    ac = a_ref[0].astype(jnp.float32)
+    bc = b_ref[0].astype(jnp.float32)
+
+    w, u_v, p, q_gamma, k_out, gamma_c = _chunk_precompute(qc, kc, vc, ac, bc)
+    s_next, o = _chunk_apply(s_scr[...], w, u_v, p, q_gamma, k_out, gamma_c)
+    o_ref[0] = o.astype(o_ref.dtype)
+    s_scr[...] = s_next
+
+    @pl.when(ni == nt - 1)
+    def _():
+        s_ref[0] = s_next
+
+
+def _gdn_fwd_pallas(q, k, v, alpha, beta, state, chunk_size):
+    h, _, dk = q.shape
+    dv = v.shape[-1]
+    c = chunk_size
+    q, k, v, alpha, beta, t = _pad_chunks(q, k, v, alpha, beta, c)
+    nt = q.shape[1] // c
+    s0 = (jnp.zeros((h, dk, dv), jnp.float32) if state is None
+          else state.astype(jnp.float32))
+
+    o, s_fin = pl.pallas_call(
+        functools.partial(_gdn_kernel, nt=nt),
+        grid=(h, nt),
+        in_specs=[
+            pl.BlockSpec((1, c, dk), lambda hi, ni: (hi, ni, 0)),
+            pl.BlockSpec((1, c, dk), lambda hi, ni: (hi, ni, 0)),
+            pl.BlockSpec((1, c, dv), lambda hi, ni: (hi, ni, 0)),
+            # Gates travel as (H, T, 1) columns: a (1, c, 1) block is
+            # Mosaic-legal for any c (last dim spans the array), where a
+            # (1, c) block from (H, T) is rejected unless c % 128 == 0.
+            pl.BlockSpec((1, c, 1), lambda hi, ni: (hi, ni, 0)),
+            pl.BlockSpec((1, c, 1), lambda hi, ni: (hi, ni, 0)),
+            pl.BlockSpec((1, dk, dv), lambda hi, ni: (hi, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, c, dv), lambda hi, ni: (hi, ni, 0)),
+            pl.BlockSpec((1, dk, dv), lambda hi, ni: (hi, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((h, nt * c, dv), v.dtype),
+            jax.ShapeDtypeStruct((h, dk, dv), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((dk, dv), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret_mode_default(),
+    )(q, k, v, alpha[..., None], beta[..., None], s0)
+    return o[:, :t], s_fin
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7))
+def _gdn_core(q, k, v, alpha, beta, state, chunk_size, precision):
+    return _gdn_fwd_pallas(q, k, v, alpha, beta, state, chunk_size)
+
+
+def _gdn_core_fwd(q, k, v, alpha, beta, state, chunk_size, precision):
+    out = _gdn_fwd_pallas(q, k, v, alpha, beta, state, chunk_size)
+    return out, (q, k, v, alpha, beta, state)
+
+
+def _gdn_core_bwd(chunk_size, precision, res, cts):
+    # The bwd is traced outside gdn_fwd's precision context, so re-enter it
+    # here — otherwise precision="highest" would apply to the forward only.
+    import contextlib
+
+    q, k, v, alpha, beta, state = res
+    ctx = (jax.default_matmul_precision(precision) if precision
+           else contextlib.nullcontext())
+    with ctx:
+        def fwd_fn(q_, k_, v_, a_, b_, s_):
+            return gdn_fwd_chunked(q_, k_, v_, a_, b_, state=s_,
+                                   chunk_size=chunk_size)
+
+        s_arg = (state if state is not None
+                 else jnp.zeros((q.shape[0], q.shape[2], v.shape[2]),
+                                jnp.float32))
+        _, vjp = jax.vjp(fwd_fn, q, k, v, alpha, beta, s_arg)
+        dq, dk_, dv_, da, db, ds = vjp(cts)
+    return dq, dk_, dv_, da, db, (None if state is None else ds)
+
+
+_gdn_core.defvjp(_gdn_core_fwd, _gdn_core_bwd)
 
 
 def gdn_fwd(
     q: jax.Array,  # (H, T, dk)
-    k: jax.Array,  # (H, T, dk)
+    k: jax.Array,
     v: jax.Array,  # (H, T, dv)
     alpha: jax.Array,  # (H, T) in (0, 1] — gate (decay)
     beta: jax.Array,  # (H, T) — write strength
     *,
-    state: jax.Array | None = None,  # (H, dk, dv) initial state
+    state: jax.Array | None = None,  # (H, dk, dv) warm state (resume)
+    chunk_size: int = DEFAULT_CHUNK,
+    impl: str = "auto",  # auto | chunked | pallas | scan
+    precision: str | None = None,  # None (ambient) | "highest" (exact f32)
 ):
-    """Returns (o (H, T, dv), final_state (H, dk, dv))."""
-    if state is not None:
-        raise NotImplementedError("warm-state resume not supported yet")
+    """Chunked GDN forward (differentiable, warm-state).
+
+    Returns (o (H, T, dv), final_state (H, dk, dv) fp32). Pass ``state`` to
+    resume from a previous call's final state (decode/streaming).
+
+    ``precision``: with TPU's default f32 matmul mode the end-to-end error vs
+    an exact-f32 oracle is ~4e-3 (same class as the bf16 inputs themselves
+    and as the reference's bf16 tensor-core kernel); ``"highest"`` drops it
+    to ~4e-5 at 3.3× chunk cost (0.99 ms vs 0.30 ms at the doc shape).
+
+    ``impl`` (measured on TPU v5e, H=8 T=4096 dk=dv=128 bf16, chained device
+    timing with all of q/k/v varying per iteration so nothing hoists):
+    per-token scan 5.18 ms; fused Pallas kernel 1.19 ms (4.3×); the hybrid
+    ``chunked`` path 0.297 ms (17.4×) — phase 1 (UT transform) runs as
+    XLA-batched einsums over all H·NT chunks at once, saturating the MXU,
+    while phase 2 is an NT-step scan; the single-kernel Pallas form must
+    serialize its (H, NT) grid on the one tensor core, so chunk parallelism
+    is worth more than fusion here. ``auto`` therefore picks ``chunked`` —
+    the same measured-delegation policy as ``kernels/gemm.py``.
+    """
+    import contextlib
+
+    ctx = (jax.default_matmul_precision(precision) if precision
+           else contextlib.nullcontext())
+    with ctx:
+        if impl == "auto":
+            impl = "chunked"
+        if impl == "chunked":
+            return gdn_fwd_chunked(q, k, v, alpha, beta, state=state,
+                                   chunk_size=chunk_size)
+        if impl == "pallas":
+            return _gdn_core(q, k, v, alpha, beta, state, chunk_size,
+                             precision)
+        if impl == "scan":
+            return gdn_fwd_scan(q, k, v, alpha, beta, state=state)
+        raise ValueError(f"unknown impl {impl!r}")
+
+
+def gdn_fwd_scan(q, k, v, alpha, beta, *, state=None):
+    """Per-token ``lax.scan`` recurrence — exact, sequential-in-T; kept as the
+    slow-path oracle for tests and tiny T."""
     h, t, dk = q.shape
     dv = v.shape[-1]
 
@@ -43,21 +352,22 @@ def gdn_fwd(
     a32 = alpha.astype(jnp.float32)
     b32 = beta.astype(jnp.float32)
 
-    def per_head(qh, kh, vh, ah, bh):
+    def per_head(qh, kh, vh, ah, bh, s0):
         def token_step(S, tok):
             qt, kt, vt, at, bt = tok
             pred = kt @ S  # (dv,) = k_t S_{t-1}
             S = at * S + bt * jnp.outer(kt, vt - pred)
             return S, qt @ S
 
-        S0 = jnp.zeros((dk, dv), jnp.float32)
-        return jax.lax.scan(token_step, S0, (qh, kh, vh, ah, bh))
+        return jax.lax.scan(token_step, s0, (qh, kh, vh, ah, bh))
 
-    S, o = jax.vmap(per_head)(q32, k32, v32, a32, b32)
+    s0 = (jnp.zeros((h, dk, dv), jnp.float32) if state is None
+          else state.astype(jnp.float32))
+    S, o = jax.vmap(per_head)(q32, k32, v32, a32, b32, s0)
     return o.astype(v.dtype), S
 
 
-def gdn_reference(q, k, v, alpha, beta):
+def gdn_reference(q, k, v, alpha, beta, state=None):
     """Naive per-token recurrence (the correctness oracle)."""
     import numpy as np
 
@@ -66,10 +376,12 @@ def gdn_reference(q, k, v, alpha, beta):
     h, t, dk = q.shape
     dv = v.shape[-1]
     o = np.zeros((h, t, dv), np.float32)
+    S_all = np.zeros((h, dk, dv), np.float32) if state is None else np.array(state, np.float32)
     for hi in range(h):
-        S = np.zeros((dk, dv), np.float32)
+        S = S_all[hi]
         for ti in range(t):
             pred = k[hi, ti] @ S
-            S = alpha[hi, ti] * S + beta[hi, ti] * np.outer(k[hi, ti], v[hi, ti] - pred)
+            S = alpha[hi, ti] * S + np.outer(beta[hi, ti] * k[hi, ti], v[hi, ti] - pred)
             o[hi, ti] = q[hi, ti] @ S
-    return o
+        S_all[hi] = S
+    return o, S_all

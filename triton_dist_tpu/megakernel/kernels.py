@@ -188,8 +188,12 @@ def fused_ln_qkv_rope(
     # uniformly typed: nh | gcd(hq, hkv), capped so a (d, nh*hd) weight tile
     # stays in the single-digit-MB DMA sweet spot.
     g = math.gcd(hq, hkv)
-    nh = max((c for c in range(g, 0, -1) if g % c == 0 and c * hd <= 1024),
-             default=1)
+    fits = [c for c in range(g, 0, -1) if g % c == 0 and c * hd <= 1024]
+    # Prefer a lane-aligned column tile (nh*hd % 128 == 0) — an unaligned
+    # BlockSpec width pads badly (or is rejected) under Mosaic even when
+    # interpret mode accepts it; fall back to the widest fit otherwise.
+    aligned = [c for c in fits if (c * hd) % 128 == 0]
+    nh = (aligned or fits or [1])[0]
     bc = nh * hd
     n_c = cols // bc
 

@@ -29,8 +29,18 @@ _collective_id_registry: dict[str, int] = {}
 
 
 def next_collective_id() -> int:
-    """Process-unique collective id for barrier-semaphore-using kernels."""
-    return next(_collective_ids)
+    """Process-unique collective id for barrier-semaphore-using kernels.
+
+    Allocates from the same checked registry as :func:`collective_id_for`
+    (under a synthetic unique name), so anonymous and named allocations share
+    one id space and the 32-id aliasing guard applies to both.
+    """
+    return collective_id_for(f"__anon_{next(_collective_ids)}")
+
+
+#: Mosaic's barrier-semaphore pool size — ids past this would alias another
+#: kernel's barrier semaphore, a silent cross-talk correctness hazard.
+MAX_COLLECTIVE_IDS = 32
 
 
 def collective_id_for(name: str) -> int:
@@ -40,9 +50,21 @@ def collective_id_for(name: str) -> int:
     burned per trace; distinct kernel names get distinct ids while fewer than
     32 collective kernels exist in the program (Mosaic's barrier-semaphore
     pool). Registration order is trace order, identical across SPMD processes.
+
+    Raises ``RuntimeError`` on the 33rd distinct kernel instead of wrapping:
+    an aliased barrier semaphore deadlocks or corrupts silently, which is far
+    worse than a loud registration failure.
     """
     if name not in _collective_id_registry:
-        _collective_id_registry[name] = len(_collective_id_registry) % 32
+        if len(_collective_id_registry) >= MAX_COLLECTIVE_IDS:
+            raise RuntimeError(
+                f"collective_id_for({name!r}): {MAX_COLLECTIVE_IDS} distinct "
+                "collective kernels already registered; a new id would alias "
+                "an existing kernel's barrier semaphore. Pass an explicit "
+                "collective_id to dist_pallas_call to reuse one safely, or "
+                "reset the registry in a fresh process."
+            )
+        _collective_id_registry[name] = len(_collective_id_registry)
     return _collective_id_registry[name]
 
 

@@ -178,13 +178,22 @@ def test_gdn_chunked_jnp_and_warm_state(rng):
 
     half = t // 2
     sl = lambda x, a, b: x[:, a:b]
-    o1, s1 = gdn_fwd(sl(q, 0, half), sl(k, 0, half), sl(v, 0, half),
-                     sl(alpha, 0, half), sl(beta, 0, half), chunk_size=32)
-    o2, s2 = gdn_fwd(sl(q, half, t), sl(k, half, t), sl(v, half, t),
-                     sl(alpha, half, t), sl(beta, half, t), state=s1,
-                     chunk_size=32)
-    np.testing.assert_allclose(np.asarray(o2), ref_o[:, half:], rtol=1e-4, atol=1e-4)
-    np.testing.assert_allclose(np.asarray(s2), ref_S, rtol=1e-4, atol=1e-4)
+    for impl in ("chunked", "pallas"):
+        o1, s1 = gdn_fwd(sl(q, 0, half), sl(k, 0, half), sl(v, 0, half),
+                         sl(alpha, 0, half), sl(beta, 0, half), chunk_size=32,
+                         impl=impl)
+        o2, s2 = gdn_fwd(sl(q, half, t), sl(k, half, t), sl(v, half, t),
+                         sl(alpha, half, t), sl(beta, half, t), state=s1,
+                         chunk_size=32, impl=impl)
+        np.testing.assert_allclose(np.asarray(o2), ref_o[:, half:],
+                                   rtol=1e-4, atol=1e-4, err_msg=impl)
+        np.testing.assert_allclose(np.asarray(s2), ref_S, rtol=1e-4,
+                                   atol=1e-4, err_msg=impl)
+    # grad flows through the pallas warm-state path (ds branch of the vjp)
+    g = jax.grad(lambda s_: jnp.sum(gdn_fwd(
+        sl(q, half, t), sl(k, half, t), sl(v, half, t), sl(alpha, half, t),
+        sl(beta, half, t), state=s_, chunk_size=32, impl="pallas")[0] ** 2))(s1)
+    assert np.isfinite(np.asarray(g)).all()
 
 
 def test_gdn_backward_matches_scan_grads(rng):

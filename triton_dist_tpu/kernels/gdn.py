@@ -51,6 +51,7 @@ Warm-state resume (``state=``) is supported by both (the reference threads
 
 from __future__ import annotations
 
+import contextlib
 import functools
 
 import jax
@@ -61,6 +62,13 @@ from jax.experimental.pallas import tpu as pltpu
 from triton_dist_tpu.runtime.platform import interpret_mode_default
 
 DEFAULT_CHUNK = 64
+
+
+def _precision_ctx(precision: str | None):
+    """Matmul-precision context shared by forward and custom_vjp backward —
+    a single point of change so fwd/bwd numerics can't silently diverge."""
+    return (jax.default_matmul_precision(precision) if precision
+            else contextlib.nullcontext())
 
 
 # --------------------------------------------------------------------------
@@ -269,12 +277,8 @@ def _gdn_core_fwd(q, k, v, alpha, beta, state, chunk_size, precision):
 def _gdn_core_bwd(chunk_size, precision, res, cts):
     # The bwd is traced outside gdn_fwd's precision context, so re-enter it
     # here — otherwise precision="highest" would apply to the forward only.
-    import contextlib
-
     q, k, v, alpha, beta, state = res
-    ctx = (jax.default_matmul_precision(precision) if precision
-           else contextlib.nullcontext())
-    with ctx:
+    with _precision_ctx(precision):
         def fwd_fn(q_, k_, v_, a_, b_, s_):
             return gdn_fwd_chunked(q_, k_, v_, a_, b_, state=s_,
                                    chunk_size=chunk_size)
@@ -322,11 +326,7 @@ def gdn_fwd(
     is worth more than fusion here. ``auto`` therefore picks ``chunked`` —
     the same measured-delegation policy as ``kernels/gemm.py``.
     """
-    import contextlib
-
-    ctx = (jax.default_matmul_precision(precision) if precision
-           else contextlib.nullcontext())
-    with ctx:
+    with _precision_ctx(precision):
         if impl == "auto":
             impl = "chunked"
         if impl == "chunked":

@@ -172,3 +172,85 @@ def test_ag_gemm_bf16_pallas(ctx8, rng):
     out = np.asarray(f(a, b), np.float32)
     expect = np.asarray(a, np.float32) @ np.asarray(b, np.float32)
     np.testing.assert_allclose(out, expect, rtol=5e-2, atol=5e-1)
+
+
+# ------------------------------------------------- DCN-aware 2D hierarchy
+
+
+@pytest.fixture(scope="module")
+def ctx24():
+    from triton_dist_tpu.runtime.mesh import initialize_distributed
+    from triton_dist_tpu.runtime.platform import cpu_mesh
+
+    m = cpu_mesh((2, 4), ("dp", "tp"))
+    return initialize_distributed(
+        axis_names=("dp", "tp"), axis_sizes=(2, 4),
+        devices=list(m.devices.flat), set_default=False,
+    )
+
+
+def test_ag_gemm_2d_shard(ctx24, rng):
+    """Hierarchical AG-GEMM on a (2,4) mesh: DCN XLA gather + fused ICI
+    ring (reference inter-node AG-GEMM, allgather.py:387-489). Output rows
+    must come back in outer-major global order."""
+    from triton_dist_tpu.kernels import AGGemmMethod, ag_gemm_2d_shard
+
+    wo, wi = 2, 4
+    m_shard, k, n_shard = 4, 32, 16
+    a = jnp.asarray(rng.standard_normal((wo * wi * m_shard, k)), jnp.float32)
+    b = jnp.asarray(rng.standard_normal((k, wo * wi * n_shard)), jnp.float32)
+
+    for method in (AGGemmMethod.PALLAS_FUSED, AGGemmMethod.XLA_RING):
+        f = jax.jit(
+            jax.shard_map(
+                lambda a_s, b_s: ag_gemm_2d_shard(
+                    a_s, b_s, axes=("dp", "tp"), method=method
+                ),
+                mesh=ctx24.mesh,
+                in_specs=(P(("dp", "tp")), P(None, ("dp", "tp"))),
+                out_specs=P(None, ("dp", "tp")),
+                check_vma=False,
+            )
+        )
+        out = np.asarray(f(a, b))
+        expect = np.asarray(a) @ np.asarray(b)
+        np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-4,
+                                   err_msg=str(method))
+
+
+def test_gemm_rs_2d_shard(ctx24, rng):
+    """Hierarchical GEMM-RS on a (2,4) mesh: fused ICI ring + one DCN
+    reduce-scatter (reference 2D reduce_scatter context,
+    reduce_scatter.py:472-640). Row-block layout: rank (d, i) holds global
+    block i*wo + d."""
+    from triton_dist_tpu.kernels import GemmRSMethod, gemm_rs_2d_shard
+
+    wo, wi = 2, 4
+    world = wo * wi
+    m, k, n = world * 4, world * 8, 16
+    a = jnp.asarray(rng.standard_normal((m, k)), jnp.float32)
+    b = jnp.asarray(rng.standard_normal((k, n)), jnp.float32)
+
+    for method in (GemmRSMethod.PALLAS_FUSED, GemmRSMethod.XLA_RING):
+        f = jax.jit(
+            jax.shard_map(
+                lambda a_s, b_s: gemm_rs_2d_shard(
+                    a_s, b_s, axes=("dp", "tp"), method=method
+                )[None],
+                mesh=ctx24.mesh,
+                in_specs=(P(None, ("dp", "tp")), P(("dp", "tp"))),
+                out_specs=P(("dp", "tp")),
+                check_vma=False,
+            )
+        )
+        out = np.asarray(f(a, b))  # (world, m/world, n) stacked per rank
+        expect = np.asarray(a) @ np.asarray(b)
+        rows = m // world
+        for d in range(wo):
+            for i in range(wi):
+                rank = d * wi + i  # mesh order: dp-major
+                blk = i * wo + d  # layout: inner-major then outer
+                np.testing.assert_allclose(
+                    out[rank], expect[blk * rows : (blk + 1) * rows],
+                    rtol=1e-4, atol=1e-4, err_msg=f"rank ({d},{i}) {method}",
+                )

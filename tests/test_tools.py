@@ -168,6 +168,19 @@ def test_multiprocess_launcher(tmp_path):
         "full = np.asarray(a) @ np.asarray(b)  # global value spans processes:\n"
         "for sh in out2.addressable_shards:  # compare the local shards only\n"
         "    np.testing.assert_allclose(np.asarray(sh.data), full[tuple(sh.index)])\n"
+        "# Cross-rank contextual autotune (reference autotuner.py:97-250):\n"
+        "# fake per-rank timings DISAGREE on the winner (rank0: cfg a wins,\n"
+        "# rank1: cfg b wins); the max-allreduce must make both ranks pick\n"
+        "# b (max scores: a=3, b=2) — divergent picks would mean divergent\n"
+        "# HLO inside one SPMD program.\n"
+        "import triton_dist_tpu.tools.tune as tune\n"
+        "fake = {0: {'a': 1.0, 'b': 2.0}, 1: {'a': 3.0, 'b': 1.0}}\n"
+        "tune.bench_device_time = lambda f, args, **kw: fake[jax.process_index()][f()]\n"
+        "import pathlib\n"
+        "cache = tune.TuneCache(path=pathlib.Path(__file__).parent / ('tune_%d.json' % jax.process_index()))\n"
+        "best, t = tune.autotune('toy', [{'cfg': 'a'}, {'cfg': 'b'}],\n"
+        "    lambda c: (lambda: c['cfg']), (), cache=cache, use_cache=False)\n"
+        "assert best == {'cfg': 'b'} and t == 2.0, (best, t)\n"
         "print('SMOKE OK')\n"
     )
     env = dict(os.environ)

@@ -54,8 +54,6 @@ def compile_sharded(mesh, fn, arg_shapes, in_specs, out_specs):
     )
     compiled = f.lower(*arg_shapes).compile()
     assert compiled is not None
-    # Sanity: the executable really contains device code for 8 partitions.
-    assert "num_partitions=8" in compiled.as_text()[:10_000] or True
     return compiled
 
 

@@ -206,31 +206,38 @@ group_gemm_swiglu_fn.defvjp(_ggsw_fwd, _ggsw_bwd)
 # ------------------------------------------------------- flash attention vjp
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def flash_attention_fn(q, k, v, causal: bool = True, scale: float | None = None):
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention_fn(q, k, v, causal: bool = True, scale: float | None = None,
+                       bwd_block_q: int | None = None,
+                       bwd_block_k: int | None = None):
     """Differentiable flash attention: the Pallas forward (which autodiff
     can't trace) + the Pallas backward (``flash_attention_bwd`` — dq and
     dk/dv passes recomputing p exactly from the saved LSE) — O(S) memory,
     standard memory-efficient-attention math (dv = pᵀ·do, dp = do·vᵀ,
     ds = p∘(dp − δ) with δ_i = Σ_j do_ij·o_ij, dq = ds·k, dk = dsᵀ·q);
-    no (S, S) tensor ever materializes in HBM."""
+    no (S, S) tensor ever materializes in HBM.
+
+    ``bwd_block_q``/``bwd_block_k`` override the backward's block shapes
+    (None = tune-cache lookup; the offline ``tune_gemm --flash-bwd`` sweep
+    forces candidates through these)."""
     from triton_dist_tpu.kernels.flash_attn import flash_attention
 
     return flash_attention(q, k, v, causal=causal, scale=scale)
 
 
-def _flash_fwd(q, k, v, causal, scale):
+def _flash_fwd(q, k, v, causal, scale, bwd_block_q, bwd_block_k):
     from triton_dist_tpu.kernels.flash_attn import flash_attention
 
     o, lse = flash_attention(q, k, v, causal=causal, scale=scale, return_lse=True)
     return o, (q, k, v, o, lse)
 
 
-def _flash_bwd(causal, scale, res, do):
+def _flash_bwd(causal, scale, bwd_block_q, bwd_block_k, res, do):
     from triton_dist_tpu.kernels.flash_attn import flash_attention_bwd
 
     q, k, v, o, lse = res
-    return flash_attention_bwd(q, k, v, o, lse, do, causal=causal, scale=scale)
+    return flash_attention_bwd(q, k, v, o, lse, do, causal=causal, scale=scale,
+                               block_q=bwd_block_q, block_k=bwd_block_k)
 
 
 flash_attention_fn.defvjp(_flash_fwd, _flash_bwd)

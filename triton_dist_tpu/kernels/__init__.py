@@ -51,6 +51,7 @@ from triton_dist_tpu.kernels.allgather_gemm import (
     AGGemmMethod,
     AGGemmContext,
     create_ag_gemm_context,
+    ag_gemm_2d_shard,
     ag_gemm_shard,
     ag_gemm,
 )
@@ -58,6 +59,7 @@ from triton_dist_tpu.kernels.gemm_reduce_scatter import (
     GemmRSMethod,
     GemmRSContext,
     create_gemm_rs_context,
+    gemm_rs_2d_shard,
     gemm_rs_shard,
     gemm_rs,
 )
@@ -139,11 +141,13 @@ __all__ = [
     "AGGemmMethod",
     "AGGemmContext",
     "create_ag_gemm_context",
+    "ag_gemm_2d_shard",
     "ag_gemm_shard",
     "ag_gemm",
     "GemmRSMethod",
     "GemmRSContext",
     "create_gemm_rs_context",
+    "gemm_rs_2d_shard",
     "gemm_rs_shard",
     "gemm_rs",
     "GemmARMethod",

@@ -418,3 +418,52 @@ def ag_gemm(ag_ctx: AGGemmContext, a: jax.Array, b: jax.Array) -> jax.Array:
         check_vma=False,
     )
     return jax.jit(shard_f)(a, b)
+
+
+def ag_gemm_2d_shard(
+    a: jax.Array,  # (m_shard, k) — A row-shard of this (dcn, ici) rank
+    b: jax.Array,  # (k, n_shard) — B column-shard of this rank
+    *,
+    axes: tuple[str, str],  # (outer/DCN axis, inner/ICI axis)
+    mesh_axes=None,
+    method: AGGemmMethod = AGGemmMethod.AUTO,
+    config=None,
+) -> jax.Array:
+    """DCN-aware hierarchical AG-GEMM (reference inter-node AG-GEMM,
+    ``allgather.py:387-489`` + ``allgather_gemm.py``): the slow (DCN) axis
+    moves each shard exactly once as an XLA all-gather of big messages,
+    then the fast (ICI) axis runs the FUSED one-sided ring AG-GEMM on the
+    ici-times-larger panels — comm/compute overlap rides ICI, where the
+    one-sided kernel wins; the DCN leg stays a graph-level collective
+    (no device-side quiet/fence exists over DCN, SURVEY §7 hard part (c)).
+
+    A is row-sharded over BOTH axes in outer-major global order
+    (``P((outer, inner))``); returns the full ``A @ B_local`` with rows in
+    that same global order (the fused kernel gathers inner-major, so the
+    output rows are transposed back — an (ici, dcn) block swap on the
+    (m, n_local) output, cheap relative to the GEMM). Inside shard_map
+    over both axes."""
+    outer, inner = axes
+    if mesh_axes is None:
+        # Remote-DMA addressing needs every mesh axis to compute logical
+        # device ids; on a 2-axis mesh the ring would otherwise cross
+        # outer-axis groups (lost puts → deadlock).
+        mesh_axes = axes
+    wo = jax.lax.axis_size(outer)
+    wi = jax.lax.axis_size(inner)
+    m_shard, k = a.shape
+
+    # DCN leg: rank (d, i) gathers rows of all (d', i) — big messages, once.
+    a_dcn = jax.lax.all_gather(a, outer, tiled=True)  # (wo*m_shard, k)
+    # ICI leg: fused ring AG-GEMM over the inner axis; gathered row order is
+    # inner-major: [i0:(d0..dN), i1:(d0..dN), ...].
+    out = ag_gemm_shard(
+        a_dcn, b, axis=inner, mesh_axes=mesh_axes, method=method, config=config
+    )  # (wi*wo*m_shard, n_shard), inner-major rows
+    n_loc = out.shape[1]
+    # Restore outer-major global row order: (wi, wo, m, n) → (wo, wi, m, n).
+    return (
+        out.reshape(wi, wo, m_shard, n_loc)
+        .transpose(1, 0, 2, 3)
+        .reshape(wi * wo * m_shard, n_loc)
+    )

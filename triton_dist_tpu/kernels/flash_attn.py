@@ -184,6 +184,25 @@ def flash_config_for(q_sds, k_sds, v_sds, causal: bool) -> tuple[int, int]:
     return DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K
 
 
+def flash_bwd_op_name(causal: bool) -> str:
+    """Tune-cache op key for the backward kernels (dq + dk/dv)."""
+    return "flash_attn_bwd_causal" if causal else "flash_attn_bwd"
+
+
+def flash_bwd_config_for(q_sds, k_sds, v_sds, causal: bool) -> tuple[int, int]:
+    """Trace-time tuned-block lookup for the backward (offline
+    ``tools.tune_gemm --flash-bwd`` fills it; key = (q, k, v) signature,
+    same multi-host ship-the-cache contract as :func:`flash_config_for`).
+    Falls back to the forward's tuned blocks (bwd and fwd optima track each
+    other on the swept shapes), then the 1024×1024 default."""
+    from triton_dist_tpu.tools.tune import lookup
+
+    hit = lookup(flash_bwd_op_name(causal), [q_sds, k_sds, v_sds])
+    if hit:
+        return int(hit["block_q"]), int(hit["block_k"])
+    return flash_config_for(q_sds, k_sds, v_sds, causal)
+
+
 def flash_attention(
     q: jax.Array,  # (B, Hq, Sq, D)
     k: jax.Array,  # (B, Hkv, Sk, D)
@@ -329,9 +348,14 @@ def _flash_varlen_kernel(
     def _():
         q = q_ref[0]
         k = k_ref[0]
+        # exp2-domain softmax, same retune as `_flash_kernel`: fold log2(e)
+        # into the scale once so both exponentials are native VPU exp2 ops
+        # (m/l scratch hold base-2 logs; varlen publishes no LSE, so nothing
+        # converts back).
+        LOG2E = 1.4426950408889634
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale
+        ) * (scale * LOG2E)
         q_ids = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
         k_ids = ik * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
         seg_q = qseg_ref[0].reshape(block_q, 1)  # (bq, 1)
@@ -342,10 +366,10 @@ def _flash_varlen_kernel(
         m_prev = m_scr[...]
         m_cur = jnp.max(s, axis=1, keepdims=True)
         m_new = jnp.maximum(m_prev, jnp.broadcast_to(m_cur, m_prev.shape))
-        alpha = jnp.exp(m_prev - m_new)
+        alpha = jnp.exp2(m_prev - m_new)
         # Mask again after the exp: on a fully-masked row m_new == NEG_INF
-        # and exp(s - m_new) would be exp(0) = 1, not 0.
-        p = jnp.where(mask, jnp.exp(s - m_new[:, :1]), 0.0)
+        # and exp2(s - m_new) would be exp2(0) = 1, not 0.
+        p = jnp.where(mask, jnp.exp2(s - m_new[:, :1]), 0.0)
         l_scr[...] = l_scr[...] * alpha + jnp.broadcast_to(
             jnp.sum(p, axis=1, keepdims=True), m_prev.shape
         )
@@ -538,8 +562,8 @@ def flash_attention_bwd(
     *,
     causal: bool = True,
     scale: float | None = None,
-    block_q: int = 1024,
-    block_k: int = 1024,
+    block_q: int | None = None,
+    block_k: int | None = None,
     q_offset: jax.Array | None = None,
     kv_offset: jax.Array | None = None,
     dlse: jax.Array | None = None,  # (B, Hq, Sq) LSE cotangent (ring merges)
@@ -557,6 +581,10 @@ def flash_attention_bwd(
     _, hkv, sk, _ = k.shape
     group = hq // hkv
     sc = scale if scale is not None else d ** -0.5
+    if block_q is None or block_k is None:
+        tq, tk = flash_bwd_config_for(q, k, v, causal)
+        block_q = tq if block_q is None else block_q
+        block_k = tk if block_k is None else block_k
     block_q = fit_block(sq, block_q)
     block_k = fit_block(sk, block_k)
     n_q = sq // block_q

@@ -404,3 +404,42 @@ def gemm_rs(rs_ctx: GemmRSContext, a: jax.Array, b: jax.Array) -> jax.Array:
         check_vma=False,
     )
     return jax.jit(shard_f)(a, b)
+
+
+def gemm_rs_2d_shard(
+    a: jax.Array,  # (m, k_shard) — A column-shard of this (dcn, ici) rank
+    b: jax.Array,  # (k_shard, n) — B row-shard of this rank
+    *,
+    axes: tuple[str, str],  # (outer/DCN axis, inner/ICI axis)
+    mesh_axes=None,
+    method: GemmRSMethod = GemmRSMethod.AUTO,
+    gemm_config: GemmConfig | None = None,
+) -> jax.Array:
+    """DCN-aware hierarchical GEMM-RS (reference inter-node GEMM-RS,
+    ``reduce_scatter.py:472-640``): the fused ICI kernel overlaps the GEMM
+    with an intra-axis ring reduce-scatter (partial sums over this ici
+    group's K range), then ONE XLA reduce-scatter over the slow (DCN) axis
+    finishes the sum with wi-times-fewer, bigger messages — the same
+    intra-then-inter split as the reference's 2D reduce-scatter context.
+
+    K is sharded over BOTH axes; returns this rank's
+    ``(m / (wo*wi), n)`` row-chunk of the fully-summed product, rows
+    assigned inner-major then outer (rank (d, i) holds global row block
+    ``i*wo + d``). Inside shard_map over both axes."""
+    outer, inner = axes
+    if mesh_axes is None:
+        mesh_axes = axes  # full-mesh addressing, see ag_gemm_2d_shard
+    wo = jax.lax.axis_size(outer)
+    m = a.shape[0]
+    assert m % (wo * jax.lax.axis_size(inner)) == 0, (m, wo)
+
+    # ICI leg: fused GEMM + ring RS over the inner axis → (m/wi, n) rows,
+    # partially summed (this ici group's K contribution only).
+    part = gemm_rs_shard(
+        a, b, axis=inner, mesh_axes=mesh_axes, method=method,
+        gemm_config=gemm_config,
+    )
+    # DCN leg: finish the sum and scatter the rows over the outer axis.
+    return jax.lax.psum_scatter(
+        part.astype(jnp.float32), outer, scatter_dimension=0, tiled=True
+    ).astype(a.dtype)

@@ -2,11 +2,14 @@
 
 Reference: ``mega_triton_kernel/models/model_builder.py:86,216-336`` —
 ``make_*`` calls record the model's ops into the graph; ``build`` generates
-the persistent kernel. TPU: ``make_*`` records tasks AND returns the fused
-implementation closures; ``build_layer_fn`` yields the per-layer decode
-function (fused Pallas kernels + existing flash-decode/AR kernels) that
-``DenseLLM.decode_shard(mode="mega")`` scans over, all under one jit — the
-compiled executable is the generated megakernel.
+the persistent kernel. TPU: ``make_*`` records tasks; ``build_layer_fn``
+**consumes the scheduler's fusion groups** to pick kernels — an
+``attn_front`` group lowers to ``fused_ln_qkv_rope``, an ``mlp_block`` group
+to ``fused_mlp_block``, and any unmatched task to its standalone op — so a
+mutated graph observably changes the generated kernel sequence (the
+load-bearing analog of the reference's codegen dispatching on task_type,
+``core/code_generator.py:158-166``). The chosen lowering is recorded in
+``ModelBuilder.plan``.
 """
 
 from __future__ import annotations
@@ -15,7 +18,11 @@ import jax
 import jax.numpy as jnp
 
 from triton_dist_tpu.megakernel.graph import Task, TaskGraph
-from triton_dist_tpu.megakernel.kernels import fused_ln_qkv_rope, fused_mlp_block
+from triton_dist_tpu.megakernel.kernels import (
+    _rmsnorm_rows,
+    fused_ln_qkv_rope,
+    fused_mlp_block,
+)
 
 
 class ModelBuilder:
@@ -25,6 +32,10 @@ class ModelBuilder:
         mb = ModelBuilder(config, axis="tp")
         layer_fn = mb.build_layer_fn()       # also populates mb.graph
         print(mb.graph.summary())            # audit the fusion schedule
+        print(mb.plan)                       # kernels the schedule chose
+
+    To audit/override the fusion, record first, mutate ``mb.graph``, then
+    call ``build_layer_fn()`` — it lowers whatever the graph holds.
     """
 
     def __init__(self, config, axis: str = "tp", world: int = 1):
@@ -32,6 +43,7 @@ class ModelBuilder:
         self.axis = axis
         self.world = world
         self.graph = TaskGraph()
+        self.plan: list[str] = []
 
     # ------------------------------------------------------------- recording
     def make_attn_front(self):
@@ -59,55 +71,212 @@ class ModelBuilder:
 
     # --------------------------------------------------------------- codegen
     def build_layer_fn(self):
-        """Record the layer's graph, schedule fusion groups, and return
-        ``layer_fn(lp, x, k_c, v_c, lengths) -> (x', k_c', v_c')`` built
-        from the fused kernels. Shard-local (inside shard_map over axis)."""
-        from triton_dist_tpu.kernels.flash_decode import flash_decode
-        from triton_dist_tpu.kernels.gemm_allreduce import gemm_ar_shard
-
-        self.make_attn_front()
-        self.make_attn_back()
-        self.make_mlp_block()
-        self.graph.schedule()
+        """Schedule the recorded graph (recording the standard layer if the
+        graph is empty) and return ``layer_fn(lp, x, ks, vs, li, lengths) ->
+        (x', ks, vs)`` assembled group-by-group from the schedule.
+        Shard-local (inside shard_map over axis); caches are STACKED
+        (L, B, Hkv, S, D) and updated in place via ``.at[li]`` (aliased
+        under jit — a per-layer unstack/restack was measured to cost a full
+        cache copy per token, 268 MB/step at ctx=4096)."""
+        if not self.graph.tasks:
+            self.make_attn_front()
+            self.make_attn_back()
+            self.make_mlp_block()
+        groups = self.graph.schedule()
 
         c = self.config
-        axis = self.axis
         hq = c.num_q_heads // self.world
         hkv = c.num_kv_heads // self.world
         hd = c.head_dim
+
+        executors = []  # list of (env, lp, state) -> None closures
+        self.plan = []
+        for group in groups:
+            gname = group[0].group.split(":")[0]
+            ex = self._lower_group(gname, group, hq=hq, hkv=hkv, hd=hd)
+            self.plan.append(f"{gname}→{ex.__name__}")
+            executors.append(ex)
+
+        # The layer's results are wherever the graph says they are: the last
+        # task's first output is the residual stream, the cache_update
+        # task's outputs are the updated caches.
+        final_out = self.graph.tasks[-1].outputs[0]
+        cu = next(t for t in self.graph.tasks if t.op == "cache_update")
+        kc_out, vc_out = cu.outputs[0], cu.outputs[1]
+
+        def layer_fn(lp, x, ks, vs, li, lengths):
+            env = {"input:x": x, "input:pos": lengths, "input:lengths": lengths,
+                   "input:kc": (ks, li), "input:vc": (vs, li)}
+            for ex in executors:
+                ex(env, lp)
+            ks, _ = env[kc_out]
+            vs, _ = env[vc_out]
+            return env[final_out], ks, vs
+
+        layer_fn.plan = tuple(self.plan)
+        return layer_fn
+
+    # ------------------------------------------------------ group lowering
+    def _lower_group(self, gname: str, group, *, hq: int, hkv: int, hd: int):
+        """Return an executor closure for one fusion group (or one
+        standalone task). Executors read/write the value environment."""
+        c = self.config
+        axis = self.axis
         eps = c.rms_eps
 
-        def layer_fn(lp, x, k_c, v_c, lengths):
-            bsz = x.shape[0]
-            # [attn_front] one fused kernel: ln1 + qkv + head norms + rope.
-            q, k, v = fused_ln_qkv_rope(
-                x, lp["ln1"], lp["wqkv"], lp["q_norm"], lp["k_norm"], lengths,
-                num_q_heads=hq, num_kv_heads=hkv, head_dim=hd,
-                rope_theta=c.rope_theta, eps=eps,
-            )
-            q = q.reshape(bsz, hq, hd)
-            k = k.reshape(bsz, hkv, hd)
-            v = v.reshape(bsz, hkv, hd)
-            # [cache_update] XLA scatter (aliased in-place under jit).
-            bids = jnp.arange(bsz)
-            k_c = k_c.at[bids, :, lengths].set(k)
-            v_c = v_c.at[bids, :, lengths].set(v)
-            # [flash_decode] existing kernel.
-            o = flash_decode(
-                q, k_c, v_c, lengths + 1, block_k=min(256, k_c.shape[2])
-            ).reshape(bsz, hq * hd)
-            # [o_proj + AR] overlapped collective matmul.
-            attn_out = gemm_ar_shard(o, lp["wo"], axis=axis)
-            x1 = x + attn_out
-            # [mlp_block] one fused kernel: ln2 + gate/up + swiglu + down.
-            mlp_partial = fused_mlp_block(
-                x1, lp["ln2"], lp["mlp_gate"], lp["mlp_up"], lp["mlp_down"], eps=eps
-            )
-            from triton_dist_tpu.kernels.allreduce import AllReduceMethod, all_reduce_shard
+        from triton_dist_tpu.kernels.flash_decode import flash_decode
+        from triton_dist_tpu.kernels.gemm_allreduce import gemm_ar_shard
+        from triton_dist_tpu.kernels.allreduce import AllReduceMethod, all_reduce_shard
+        from triton_dist_tpu.layers.tp import apply_rope
 
-            mlp_out = all_reduce_shard(
-                mlp_partial.astype(jnp.float32), axis=axis, method=AllReduceMethod.AUTO
-            ).astype(x.dtype)
-            return x1 + mlp_out, k_c, v_c
+        param = lambda name: name.split(":", 1)[1]
 
-        return layer_fn
+        # The fused executors consume the GROUP's recorded dataflow (task
+        # inputs/outputs), same contract as the standalone lowerings — a
+        # mutated graph that rebinds value names flows through both paths
+        # identically instead of silently reading hardcoded keys.
+        if gname == "attn_front":
+            # [rmsnorm(x, ln), linear(·, w), head_norm(·, qn, kn), rope(·, pos)]
+            ln_t, lin_t, hn_t, rope_t = group
+            x_in, ln_p = ln_t.inputs[0], param(ln_t.inputs[1])
+            w_p = param(lin_t.inputs[1])
+            qn_p, kn_p = param(hn_t.inputs[1]), param(hn_t.inputs[2])
+            pos_in = rope_t.inputs[1]
+            out_q, out_k, out_v = rope_t.outputs
+
+            def fused_attn_front(env, lp):
+                x = env[x_in]
+                b = x.shape[0]
+                q, k, v = fused_ln_qkv_rope(
+                    x, lp[ln_p], lp[w_p], lp[qn_p], lp[kn_p],
+                    env[pos_in], num_q_heads=hq, num_kv_heads=hkv,
+                    head_dim=hd, rope_theta=c.rope_theta, eps=eps,
+                )
+                env[out_q] = q.reshape(b, hq, hd)
+                env[out_k] = k.reshape(b, hkv, hd)
+                env[out_v] = v.reshape(b, hkv, hd)
+            return fused_attn_front
+
+        if gname == "mlp_block":
+            # [rmsnorm(x1, ln), linear(·, wg, wu), swiglu, linear(·, wd)]
+            ln_t, gu_t, _, dn_t = group
+            x_in, ln_p = ln_t.inputs[0], param(ln_t.inputs[1])
+            g_p, u_p = param(gu_t.inputs[1]), param(gu_t.inputs[2])
+            d_p = param(dn_t.inputs[1])
+            out_v = dn_t.outputs[0]
+
+            def fused_mlp(env, lp):
+                env[out_v] = fused_mlp_block(
+                    env[x_in], lp[ln_p], lp[g_p], lp[u_p], lp[d_p], eps=eps,
+                )
+            return fused_mlp
+
+        # ----- standalone lowerings (unmatched tasks) -----
+        task = group[0]
+        op = task.op
+
+        if op == "rmsnorm":
+            def standalone_rmsnorm(env, lp, t=task):
+                x = env[t.inputs[0]]
+                env[t.outputs[0]] = _rmsnorm_rows(
+                    x.astype(jnp.float32), lp[param(t.inputs[1])], eps, x.dtype
+                )
+            return standalone_rmsnorm
+
+        if op == "linear":
+            def standalone_linear(env, lp, t=task):
+                x = env[t.inputs[0]]
+                ws = [lp[param(i)] for i in t.inputs[1:]]
+                outs = [
+                    jnp.dot(x, w, preferred_element_type=jnp.float32).astype(x.dtype)
+                    for w in ws
+                ]
+                env[t.outputs[0]] = outs[0] if len(outs) == 1 else jnp.concatenate(outs, -1)
+            return standalone_linear
+
+        if op == "head_norm":
+            def standalone_head_norm(env, lp, t=task):
+                qkv = env[t.inputs[0]]
+                b = qkv.shape[0]
+                h3 = qkv.reshape(b, hq + 2 * hkv, hd)
+                qn = lp[param(t.inputs[1])]
+                kn = lp[param(t.inputs[2])]
+                q = _rmsnorm_rows(h3[:, :hq].astype(jnp.float32), qn, eps, qkv.dtype)
+                k = _rmsnorm_rows(
+                    h3[:, hq : hq + hkv].astype(jnp.float32), kn, eps, qkv.dtype
+                )
+                env[t.outputs[0]] = jnp.concatenate(
+                    [q, k, h3[:, hq + hkv :]], axis=1
+                ).reshape(b, -1)
+            return standalone_head_norm
+
+        if op == "rope":
+            def standalone_rope(env, lp, t=task):
+                qkv = env[t.inputs[0]]
+                b = qkv.shape[0]
+                pos = env[t.inputs[1]]
+                h3 = qkv.reshape(b, hq + 2 * hkv, hd)
+                # apply_rope wants (B, H, S, D) + pos (B, S): decode is S=1
+                # (exactly TP_Attn.decode's q[:, :, 0] convention).
+                rot = lambda u: apply_rope(
+                    u[:, :, None, :], pos[:, None], c.rope_theta
+                )[:, :, 0]
+                env[t.outputs[0]] = rot(h3[:, :hq])
+                env[t.outputs[1]] = rot(h3[:, hq : hq + hkv])
+                env[t.outputs[2]] = h3[:, hq + hkv :]
+            return standalone_rope
+
+        if op == "cache_update":
+            def standalone_cache_update(env, lp, t=task):
+                k_new, v_new = env[t.inputs[0]], env[t.inputs[1]]
+                ks, li = env[t.inputs[2]]
+                vs, _ = env[t.inputs[3]]
+                lengths = env[t.inputs[4]]
+                bids = jnp.arange(k_new.shape[0])
+                ks = ks.at[li, bids, :, lengths].set(k_new)
+                vs = vs.at[li, bids, :, lengths].set(v_new)
+                env[t.outputs[0]] = (ks, li)
+                env[t.outputs[1]] = (vs, li)
+            return standalone_cache_update
+
+        if op == "flash_decode":
+            def standalone_flash_decode(env, lp, t=task):
+                q = env[t.inputs[0]]
+                ks, li = env[t.inputs[1]]
+                vs, _ = env[t.inputs[2]]
+                lengths = env[t.inputs[3]]
+                b = q.shape[0]
+                env[t.outputs[0]] = flash_decode(
+                    q, ks[li], vs[li], lengths + 1,
+                    block_k=min(256, ks.shape[3]),
+                ).reshape(b, hq * hd)
+            return standalone_flash_decode
+
+        if op == "linear_allreduce":
+            def standalone_linear_ar(env, lp, t=task):
+                env[t.outputs[0]] = gemm_ar_shard(
+                    env[t.inputs[0]], lp[param(t.inputs[1])], axis=axis
+                )
+            return standalone_linear_ar
+
+        if op == "add":
+            def standalone_add(env, lp, t=task):
+                env[t.outputs[0]] = env[t.inputs[0]] + env[t.inputs[1]]
+            return standalone_add
+
+        if op == "swiglu":
+            def standalone_swiglu(env, lp, t=task):
+                gu = env[t.inputs[0]].astype(jnp.float32)
+                g, u = jnp.split(gu, 2, axis=-1)
+                env[t.outputs[0]] = (jax.nn.silu(g) * u).astype(env[t.inputs[0]].dtype)
+            return standalone_swiglu
+
+        if op == "allreduce":
+            def standalone_allreduce(env, lp, t=task):
+                x = env[t.inputs[0]]
+                env[t.outputs[0]] = all_reduce_shard(
+                    x.astype(jnp.float32), axis=axis, method=AllReduceMethod.AUTO
+                ).astype(env["input:x"].dtype)
+            return standalone_allreduce
+
+        raise NotImplementedError(f"no lowering for task op {op!r}")

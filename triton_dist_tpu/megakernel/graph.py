@@ -25,6 +25,7 @@ class Task:
     inputs: tuple[str, ...]
     outputs: tuple[str, ...]
     group: str | None = None  # fusion group id assigned by the scheduler
+    pinned: bool = False  # pinned tasks never fuse (scheduler override)
 
 
 # Chains the codegen knows how to fuse into one Pallas kernel, checked in
@@ -42,6 +43,17 @@ class TaskGraph:
     def __init__(self):
         self.tasks: list[Task] = []
         self._producers: dict[str, str] = {}
+
+    def pin_standalone(self, name: str) -> None:
+        """Exclude a task from fusion (scheduler override): any chain window
+        containing it falls apart into standalone lowerings. The audit knob
+        that makes the graph load-bearing — pinning observably changes the
+        generated kernel sequence without changing semantics."""
+        for t in self.tasks:
+            if t.name == name:
+                t.pinned = True
+                return
+        raise KeyError(f"no task named {name!r}")
 
     def add(self, task: Task) -> Task:
         for out in task.outputs:
@@ -68,7 +80,7 @@ class TaskGraph:
             for ops, gname in FUSABLE_CHAINS:
                 window = self.tasks[i : i + len(ops)]
                 if len(window) == len(ops) and all(
-                    t.op == o for t, o in zip(window, ops)
+                    t.op == o and not t.pinned for t, o in zip(window, ops)
                 ):
                     # The chain must be a straight line: each task feeds the
                     # next (no external consumer would break fusion on TPU —

@@ -59,7 +59,7 @@ def fused_mlp_block(
     w_down: jax.Array,  # (ff, d)
     *,
     eps: float = 1e-6,
-    block_f: int = 384,
+    block_f: int | None = None,
     residual: bool = False,
     vmem_limit_mb: int | None = 100,
 ) -> jax.Array:
@@ -73,6 +73,10 @@ def fused_mlp_block(
 
     b, d = x.shape
     ff = w_gate.shape[1]
+    if block_f is None:
+        # On-chip sweep (v5e, d=4096 ff=12288): bsz=1 peaks at 512-wide
+        # tiles (793 GB/s vs 742 at 384); bsz>=8 prefers 768 (766 GB/s).
+        block_f = 512 if b <= 4 else 768
     bf = fit_block(ff, block_f)
     n_f = ff // bf
 
@@ -229,3 +233,59 @@ def fused_ln_qkv_rope(
     k = flat[:, hq * hd : (hq + hkv) * hd]
     v = flat[:, (hq + hkv) * hd :]
     return q, k, v
+
+
+def _norm_head_kernel(x_ref, nw_ref, w_ref, o_ref, xn, *, eps):
+    vi = pl.program_id(0)
+
+    @pl.when(vi == 0)
+    def _():
+        xn[...] = _rmsnorm_rows(
+            x_ref[...].astype(jnp.float32), nw_ref[0], eps, xn.dtype
+        )
+
+    o_ref[...] = jnp.dot(xn[...], w_ref[...], preferred_element_type=jnp.float32)
+
+
+def fused_norm_head(
+    x: jax.Array,  # (B, d) residual stream after the last layer
+    norm_w: jax.Array,  # (d,)
+    lm_head: jax.Array,  # (d, V)
+    *,
+    eps: float = 1e-6,
+    block_v: int = 1024,  # on-chip sweep: 744→749 GB/s (bsz=1), 727→818 (bsz=8)
+    vmem_limit_mb: int | None = 100,
+) -> jax.Array:
+    """Final RMSNorm → lm_head projection in ONE kernel, streaming the
+    vocab-column tiles once (the lm_head is lm-head-sized — ~268 MB at 8B
+    widths — so its streaming efficiency matters as much as a layer's MLP).
+    Returns f32 logits (B, V)."""
+    from triton_dist_tpu.kernels.gemm import fit_block
+
+    b, d = x.shape
+    v = lm_head.shape[1]
+    bv = fit_block(v, block_v)
+    n_v = v // bv
+
+    return pl.pallas_call(
+        functools.partial(_norm_head_kernel, eps=eps),
+        grid=(n_v,),
+        in_specs=[
+            pl.BlockSpec((b, d), lambda i: (0, 0)),
+            pl.BlockSpec((1, d), lambda i: (0, 0)),
+            pl.BlockSpec((d, bv), lambda i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((b, bv), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((b, v), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((b, d), x.dtype)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+            vmem_limit_bytes=vmem_limit_mb * 1024 * 1024 if vmem_limit_mb else None,
+        ),
+        interpret=interpret_mode_default(),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * b * d * v,
+            bytes_accessed=d * v * lm_head.dtype.itemsize + 4 * b * v,
+            transcendentals=0,
+        ),
+    )(x, norm_w.reshape(1, d), lm_head)

@@ -237,14 +237,12 @@ class DenseLLM:
 
         mega_layer = ModelBuilder(c, axis=self.axis, world=self.world).build_layer_fn()
         x = p.embed[token]
-        ks_out, vs_out = [], []
         for i, lp in enumerate(mega_layers):
-            x, k_i, v_i = mega_layer(lp, x, ks[i], vs[i], lengths)
-            ks_out.append(k_i)
-            vs_out.append(v_i)
-        x = RMSNorm(weight=p.final_norm, eps=c.rms_eps)(x)
-        logits = jnp.dot(x, p.lm_head, preferred_element_type=jnp.float32)
-        return logits, jnp.stack(ks_out), jnp.stack(vs_out)
+            x, ks, vs = mega_layer(lp, x, ks, vs, i, lengths)
+        from triton_dist_tpu.megakernel.kernels import fused_norm_head
+
+        logits = fused_norm_head(x, p.final_norm, p.lm_head, eps=c.rms_eps)
+        return logits, ks, vs
 
     def decode_shard(self, p: DenseParams, token: jax.Array, ks, vs, lengths, mode: str):
         """Inside shard_map. token (B,) → (logits (B, V_local), updated caches).

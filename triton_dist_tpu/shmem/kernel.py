@@ -16,6 +16,7 @@ and remote-DMA ops natively — so the wrapper's job reduces to launch hygiene:
 
 from __future__ import annotations
 
+import functools
 import itertools
 from typing import Any
 
@@ -43,6 +44,28 @@ def next_collective_id() -> int:
 MAX_COLLECTIVE_IDS = 32
 
 
+def reset_collective_ids() -> None:
+    """Clear the registry. For long-lived processes that run many *separate*
+    compiled programs: ids only need uniqueness within one program, so a
+    process cycling through >32 distinct collective kernels across jobs can
+    reset between them instead of dying on the aliasing guard."""
+    _collective_id_registry.clear()
+
+
+def kernel_key(kernel) -> str:
+    """Stable registry key for a kernel callable. ``functools.partial``
+    objects have no ``__qualname__`` and their ``repr`` embeds an object
+    address — using that would burn a fresh id slot on EVERY retrace.
+    Unwrap to the underlying function plus a repr of the bound static args
+    (axis names, tile sizes… — stable across traces), so retraces reuse
+    their slot while genuinely different configurations stay distinct."""
+    if isinstance(kernel, functools.partial):
+        args = ",".join(map(repr, kernel.args))
+        kw = ",".join(f"{k}={v!r}" for k, v in sorted(kernel.keywords.items()))
+        return f"{kernel_key(kernel.func)}({args};{kw})"
+    return getattr(kernel, "__qualname__", None) or repr(kernel)
+
+
 def collective_id_for(name: str) -> int:
     """Stable collective id keyed by kernel name.
 
@@ -61,8 +84,10 @@ def collective_id_for(name: str) -> int:
                 f"collective_id_for({name!r}): {MAX_COLLECTIVE_IDS} distinct "
                 "collective kernels already registered; a new id would alias "
                 "an existing kernel's barrier semaphore. Pass an explicit "
-                "collective_id to dist_pallas_call to reuse one safely, or "
-                "reset the registry in a fresh process."
+                "collective_id to dist_pallas_call to reuse one safely, or — "
+                "if the earlier kernels belong to already-finished compiled "
+                "programs — call shmem.kernel.reset_collective_ids() between "
+                "jobs (ids only need uniqueness within one program)."
             )
         _collective_id_registry[name] = len(_collective_id_registry)
     return _collective_id_registry[name]
@@ -90,7 +115,7 @@ def dist_pallas_call(
             # traced into the same program never alias, while retraces of the
             # same kernel reuse their id. SPMD tracing is identical on every
             # process, so the registry stays consistent across ranks.
-            collective_id = collective_id_for(getattr(kernel, "__qualname__", repr(kernel)))
+            collective_id = collective_id_for(kernel_key(kernel))
         compiler_params = pltpu.CompilerParams(
             has_side_effects=collective,
             collective_id=collective_id,

@@ -90,6 +90,53 @@ def lookup(op_name: str, args: Sequence, cache: TuneCache | None = None) -> dict
     return dict(hit["cfg"]) if hit else None
 
 
+def _cache_hit_all_ranks_agree(usable) -> bool:
+    """True iff every SPMD process found the SAME usable cached config.
+    Single-process: plain hit check. Multi-process: allgather a digest of
+    the config (0 = miss) — any rank missing or disagreeing sends everyone
+    to the collective re-tune loop together, never split."""
+    import jax
+
+    if jax.process_count() == 1:
+        return usable is not None
+    import hashlib
+    import json
+
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    if usable is None:
+        digest = np.int64(0)
+    else:
+        payload = json.dumps(_as_dict(usable), sort_keys=True, default=repr)
+        digest = np.frombuffer(
+            hashlib.sha256(payload.encode()).digest()[:8], np.int64
+        )[0]
+        if digest == 0:  # astronomically unlikely; 0 is reserved for "miss"
+            digest = np.int64(1)
+    all_d = multihost_utils.process_allgather(digest)
+    return bool(all_d[0] != 0 and (all_d == all_d[0]).all())
+
+
+def cross_rank_time(t: float) -> float:
+    """Combine one candidate's timing across SPMD processes: MAX over ranks
+    (the reference's contextual autotuner allreduces candidate timings so
+    every rank picks the same winner, ``autotuner.py:97-250``; max because
+    a collective op runs at the slowest rank's pace). A rank whose candidate
+    FAILED contributes +inf — it still participates in the allgather, so
+    ranks never diverge on which candidates were timed (a skip on one rank
+    would deadlock the collective). No-op in single-process jobs."""
+    import jax
+
+    if jax.process_count() == 1:
+        return t
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    all_t = multihost_utils.process_allgather(np.float32(t))
+    return float(np.max(all_t))
+
+
 def autotune(
     op_name: str,
     candidates: Sequence,
@@ -108,26 +155,38 @@ def autotune(
     Times each candidate whole-op on the device (collective ops included —
     single-controller wall time is the collective time); a candidate that
     raises scores +inf, matching the reference autotuner's error handling.
-    Returns ``(best_candidate, best_time_s)`` and persists the winner.
+    In multi-process jobs every rank times every candidate and the scores
+    are max-allreduced (:func:`cross_rank_time`) before the pick, so all
+    ranks persist the SAME winner — the cross-rank contextual-autotune
+    contract. Returns ``(best_candidate, best_time_s)`` and persists it.
     """
     cache = cache or default_cache()
     key = f"{op_name}|{arg_signature(args)}"
     if use_cache:
         hit = cache.get(key)
+        usable = None
         if hit is not None:
             want = hit["cfg"]
-            for c in candidates:
-                if _as_dict(c) == want:
-                    return c, hit["time_s"]
-            # cfg no longer in the candidate space → re-tune below
+            usable = next((c for c in candidates if _as_dict(c) == want), None)
+            # usable is None: cfg no longer in the candidate space → re-tune
+        # The hit/miss decision must be COLLECTIVE: if one rank returned
+        # here while another (stale/missing cache file) entered the timing
+        # loop, the loop's per-candidate allgather would hang forever.
+        # Every rank proceeds to re-tune unless ALL ranks hold the same
+        # usable config.
+        if _cache_hit_all_ranks_agree(usable):
+            return usable, hit["time_s"]
 
     best, best_t = None, float("inf")
     for c in candidates:
         try:
             t = bench_device_time(build(c), args, chain=chain, iters=iters, reps=reps)
-        except Exception as e:  # noqa: BLE001 — bad tile config → skip, like ref
+        except Exception as e:  # noqa: BLE001 — bad tile config → +inf, like ref
             if verbose:
                 print(f"[tune] {op_name} {c}: FAIL {type(e).__name__}: {e}")
+            t = float("inf")
+        t = cross_rank_time(t)
+        if t == float("inf"):
             continue
         if verbose:
             print(f"[tune] {op_name} {c}: {t * 1e6:.1f} us")

@@ -37,7 +37,12 @@ def tune_square_gemm(size: int, dtype, *, verbose: bool = True):
 
 
 FLASH_BLOCK_SPACE = [
-    (256, 256), (512, 512), (512, 1024), (1024, 512),
+    # Causal tile quantization: a (bq, bk) tile crossing the diagonal runs
+    # full MXU work but only ~half counts, so executed/useful ≈ 0.75 at
+    # 1024² (s=2k) vs 0.89 at 256² — smaller q-blocks trade per-step
+    # overhead against wasted diagonal FLOPs. Sweep both regimes.
+    (128, 256), (128, 512), (256, 128), (256, 256), (256, 512), (256, 1024),
+    (512, 256), (512, 512), (512, 1024), (1024, 256), (1024, 512),
     (1024, 1024), (1024, 2048), (2048, 1024), (2048, 2048),
 ]
 
@@ -80,12 +85,57 @@ def tune_flash(b, hq, hkv, s, d, dtype, *, causal: bool = True, verbose: bool = 
     return best, t
 
 
+def tune_flash_bwd(b, hq, hkv, s, d, dtype, *, causal: bool = True,
+                   verbose: bool = True):
+    """Sweep backward (dq + dk/dv) block shapes and persist the winner;
+    ``flash_bwd_config_for`` reads it at trace time. Times the full
+    ``jax.grad`` step (fwd recompute + both bwd kernels) — the quantity a
+    training step actually pays."""
+    from triton_dist_tpu.function import flash_attention_fn
+    from triton_dist_tpu.kernels.flash_attn import flash_bwd_op_name
+
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(kq, (b, hq, s, d), jnp.float32).astype(dtype)
+    k = jax.random.normal(kk, (b, hkv, s, d), jnp.float32).astype(dtype)
+    v = jax.random.normal(kv, (b, hkv, s, d), jnp.float32).astype(dtype)
+    space = [
+        {"block_q": bq, "block_k": bk}
+        for bq, bk in FLASH_BLOCK_SPACE
+        if s % bq == 0 and s % bk == 0
+    ] or [{"block_q": 1024, "block_k": 1024}]
+
+    def build(cfg):
+        def step(q_, k_, v_):
+            return jax.grad(
+                lambda a, b_, c: jnp.sum(
+                    flash_attention_fn(
+                        a, b_, c, causal, bwd_block_q=cfg["block_q"],
+                        bwd_block_k=cfg["block_k"],
+                    ).astype(jnp.float32)
+                ),
+                argnums=(0, 1, 2),
+            )(q_, k_, v_)[0]
+        return step
+
+    best, t = autotune(
+        flash_bwd_op_name(causal), space, build, (q, k, v), verbose=verbose
+    )
+    flops = 2 * 2 * b * hq * s * s * d * (0.5 if causal else 1.0) * 4.5
+    if verbose:
+        print(f"[tune_flash_bwd] b{b} h{hq}/{hkv} s{s} d{d}: best {best} "
+              f"{flops / t / 1e12:.1f} TFLOP/s (grad step)")
+    return best, t
+
+
 def main():
     p = argparse.ArgumentParser()
-    p.add_argument("--mkn", type=int, nargs="+", default=[2048, 4096, 8192])
+    p.add_argument("--mkn", type=int, nargs="*", default=[2048, 4096, 8192])
     p.add_argument("--dtype", default="bfloat16")
     p.add_argument("--flash", type=int, nargs=5, metavar=("B", "HQ", "HKV", "S", "D"),
                    help="also tune flash attention at this shape")
+    p.add_argument("--flash-bwd", type=int, nargs=5,
+                   metavar=("B", "HQ", "HKV", "S", "D"),
+                   help="also tune the flash backward (grad step) at this shape")
     p.add_argument("--non-causal", action="store_true",
                    help="tune the non-causal flash cache key instead")
     p.add_argument("-q", "--quiet", action="store_true")
@@ -96,6 +146,9 @@ def main():
     if args.flash:
         tune_flash(*args.flash, dtype, causal=not args.non_causal,
                    verbose=not args.quiet)
+    if args.flash_bwd:
+        tune_flash_bwd(*args.flash_bwd, dtype, causal=not args.non_causal,
+                       verbose=not args.quiet)
     print(f"cache: {default_cache().path}")
 
 

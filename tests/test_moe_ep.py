@@ -270,10 +270,14 @@ def test_all_to_all_2d():
     np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-6, atol=1e-6)
 
 
-def test_ep_moe_fused_kernel_vs_dense(ctx4, rng):
-    """ONE-kernel dispatch+expert-MLP (mega-EP analog, kernels/ep_fused.py)
-    matches the dense reference; exercises the in-kernel a2a + grouped
-    gate/up/SwiGLU/down with ff tiling (n_f > 1)."""
+@pytest.mark.parametrize(
+    "variant", ["combine_in_kernel", "two_step", "fp8_wire"]
+)
+def test_ep_moe_fused_kernel_vs_dense(ctx4, rng, variant):
+    """ONE-kernel dispatch+expert-MLP+combine (mega-EP analog,
+    kernels/ep_fused.py) matches the dense reference; exercises the
+    in-kernel a2a, grouped gate/up/SwiGLU/down with ff tiling (n_f > 1),
+    the in-kernel return-a2a combine leg, and the fp8 dispatch wire."""
     from triton_dist_tpu.kernels.ep_fused import ep_moe_fused_kernel_shard
     from moe_ref import moe_dense_ref
 
@@ -283,12 +287,18 @@ def test_ep_moe_fused_kernel_vs_dense(ctx4, rng):
     wg = jnp.asarray(rng.standard_normal((e, d, ff)), jnp.float32) * 0.1
     wu = jnp.asarray(rng.standard_normal((e, d, ff)), jnp.float32) * 0.1
     wd = jnp.asarray(rng.standard_normal((e, ff, d)), jnp.float32) * 0.1
+    kw = {
+        "combine_in_kernel": {"combine_in_kernel": True},
+        "two_step": {"combine_in_kernel": False},
+        "fp8_wire": {"combine_in_kernel": True, "wire_fp8": True},
+    }[variant]
 
     def fn(x_, wr_, wg_, wu_, wd_):
         return ep_moe_fused_kernel_shard(
             x_[0], wr_, wg_, wu_, wd_, num_experts=e, top_k=k,
             capacity_factor=8.0, axis="tp", mesh_axes=("tp",),
             block_f=32,  # force n_f=2: accumulate across ff tiles in-kernel
+            **kw,
         )[None]
 
     out = np.asarray(
@@ -300,6 +310,7 @@ def test_ep_moe_fused_kernel_vs_dense(ctx4, rng):
             )
         )(x, wr, wg, wu, wd)
     )
+    tol = 3e-2 if variant == "fp8_wire" else 2e-4  # e4m3 wire: ~2 mantissa bits
     for r in range(WORLD):
         ref = moe_dense_ref(x[r], wr, wg, wu, wd, k)
-        np.testing.assert_allclose(out[r], ref, rtol=2e-4, atol=2e-4, err_msg=f"rank {r}")
+        np.testing.assert_allclose(out[r], ref, rtol=tol, atol=tol, err_msg=f"rank {r}")

@@ -99,3 +99,46 @@ def test_race_detection_clean(ctx4, rng):
             np.testing.assert_allclose(
                 out2[r], ref[r * chunk:(r + 1) * chunk], rtol=1e-5, atol=1e-5
             )
+
+
+def test_race_detection_ep_fused_combine(ctx4, rng):
+    """The one-kernel dispatch+MLP+combine passes the race detector: its
+    y_stage reuse discipline (drain expert e's outbound puts before expert
+    e+1 overwrites the staging panel) is exactly the class of bug this
+    catches first."""
+    from triton_dist_tpu.kernels.ep_fused import fused_dispatch_mlp_combine_shard
+
+    world, e_local, cap, d, ff = WORLD, 2, 4, 16, 32
+    send = jnp.asarray(
+        rng.standard_normal((world, world, e_local * cap, d)), jnp.float32
+    ) * 0.3
+    wg = jnp.asarray(rng.standard_normal((world * e_local, d, ff)), jnp.float32) * 0.1
+    wu = jnp.asarray(rng.standard_normal((world * e_local, d, ff)), jnp.float32) * 0.1
+    wd = jnp.asarray(rng.standard_normal((world * e_local, ff, d)), jnp.float32) * 0.1
+
+    with race_detection(True):
+        def fn(s, g, u, dn):
+            return fused_dispatch_mlp_combine_shard(
+                s[0], g, u, dn, capacity=cap, axis="tp", mesh_axes=("tp",),
+                block_f=16,
+            )[None]
+
+        out = np.asarray(
+            sm(ctx4, fn, (P("tp"), P("tp"), P("tp"), P("tp")), P("tp"))(
+                send, wg, wu, wd
+            )
+        )
+    # Reference: comb[me, p-row] = peer p's experts on my tokens.
+    sendn = np.asarray(send, np.float32)
+    silu = lambda v: v / (1.0 + np.exp(-v))
+    for me in range(world):
+        for p in range(world):
+            for e in range(e_local):
+                ge = p * e_local + e
+                xs = sendn[me, p, e * cap:(e + 1) * cap]  # my tokens for (p, e)
+                h = silu(xs @ np.asarray(wg[ge])) * (xs @ np.asarray(wu[ge]))
+                ref = h @ np.asarray(wd[ge])
+                np.testing.assert_allclose(
+                    out[me, p, e * cap:(e + 1) * cap], ref,
+                    rtol=2e-4, atol=2e-4, err_msg=f"me={me} p={p} e={e}",
+                )

@@ -132,6 +132,30 @@ def test_lowering_ep_fused_dispatch_mlp(tpu_mesh):
     )
 
 
+def test_lowering_ep_fused_combine(tpu_mesh):
+    """The one-kernel dispatch+MLP+combine (in-kernel return a2a, VMEM-
+    sourced remote puts) compiles for the 8-chip topology — both wire
+    dtypes."""
+    from triton_dist_tpu.kernels.ep_fused import fused_dispatch_mlp_combine_shard
+
+    e_local, cap, d, ff = 2, 64, 256, 512
+    send = jax.ShapeDtypeStruct((WORLD, WORLD, e_local * cap, d), jnp.bfloat16)
+    wg = jax.ShapeDtypeStruct((WORLD * e_local, d, ff), jnp.bfloat16)
+    wu = jax.ShapeDtypeStruct((WORLD * e_local, d, ff), jnp.bfloat16)
+    wd = jax.ShapeDtypeStruct((WORLD * e_local, ff, d), jnp.bfloat16)
+    for fp8 in (False, True):
+        compile_sharded(
+            tpu_mesh,
+            lambda s, g, u, dn, fp8=fp8: fused_dispatch_mlp_combine_shard(
+                s[0], g, u, dn, capacity=cap, axis="tp", mesh_axes=("tp",),
+                block_f=256, wire_fp8=fp8,
+            )[None],
+            (send, wg, wu, wd),
+            (P("tp"), P("tp"), P("tp"), P("tp")),
+            P("tp"),
+        )
+
+
 def test_lowering_ring_attention(tpu_mesh):
     """SP ring attention (sp.py) — per-step remote KV rotation + flash
     consumer — compiles for the 8-chip topology."""

@@ -17,15 +17,20 @@ communication. TPU redesign of the same idea:
   communication buffers must be pallas inputs/outputs, not ANY scratch) and
   are re-gathered per expert into VMEM once per expert — token panels are
   tiny next to expert weights in the decode regime this serves.
-* The combine leg stays at jit level (``combine_leg_shard``) — its return
-  a2a is dominated by the down-GEMM it follows, which XLA already overlaps.
+* ``_fused_dispatch_mlp_combine_kernel`` additionally runs the COMBINE leg
+  in-kernel (reference ``mega_kernel_moe_grouped_gemm_combine_token``
+  :1020): each expert's output chunks fly home via one-sided puts the
+  moment its down-GEMM finishes, overlapping the next expert's weight
+  streaming; only the local weighted unpermute remains at jit level. With
+  ``wire_fp8`` the dispatch leg moves e4m3 + per-token scales (reference
+  v2 wire, :1288) and dequantizes during the per-expert VMEM gather —
+  half the dispatch bytes in-kernel.
 
-Capacity/limits: the per-expert token panel ``(world·C, d)`` (×2: input +
-f32 accumulator) plus three ``(d, block_f)``-class weight tiles must fit
-VMEM; ``fused_moe_supported`` checks this and callers fall back to the
-jit-level composition (``ep_moe_ll_shard``) — same functional result,
-kernel-granular overlap only. fp8 wire is jit-level-only for now (the
-in-kernel a2a moves the model dtype).
+Capacity/limits: the per-expert token panel ``(world·C, d)`` (input +
+f32 accumulator + y staging) plus three ``(d, block_f)``-class weight
+tiles must fit VMEM; ``fused_moe_supported`` checks this and callers fall
+back to the jit-level composition (``ep_moe_ll_shard``) — same functional
+result, kernel-granular overlap only.
 """
 
 from __future__ import annotations
@@ -42,24 +47,50 @@ from triton_dist_tpu.kernels.gemm import fit_block
 from triton_dist_tpu.shmem.kernel import collective_id_for, dist_pallas_call
 
 
-def _fused_dispatch_mlp_kernel(
-    send_ref,  # ANY (world, E_local*C, d) — row p = my tokens for peer p
-    wg_ref,  # (1, d, bf) VMEM tile of w_gate[e]
-    wu_ref,  # (1, d, bf)
-    wd_ref,  # (1, bf, d)
-    y_ref,  # (1, world*C, d) expert output panel
-    recv_ref,  # ANY (world, E_local*C, d) — comm landing buffer
-    xs,  # VMEM (world*C, d) model dtype — expert e's token panel
-    acc,  # VMEM (world*C, d) f32
-    send_sem,
-    recv_sem,
-    copy_sem,
-    *,
+def _fused_ep_kernel(
+    *refs,
     axis,
     mesh_axes,
     cap: int,
     n_f: int,
+    e_local: int,
+    fp8: bool,
+    combine: bool,
 ):
+    """ONE kernel for the mega-EP pipeline, both variants (reference
+    ``mega_kernel_dispatch_token_moe_grouped_gemm`` :839 and
+    ``..._combine_token`` :1020):
+
+    * dispatch: one-sided token puts, weight pipeline streaming under the
+      a2a drain; with ``fp8``, e4m3 payloads + per-token scales move on the
+      wire (reference v2, :1288) and dequantize during the VMEM gather;
+    * grouped gate/up→SwiGLU→down per local expert;
+    * with ``combine``: each expert's output chunks fly straight home via
+      one-sided puts the moment its down-GEMM finishes — the return a2a of
+      expert e overlaps expert e+1's weight streaming — else the expert
+      panels are written to the ``y`` output (jit-level combine follows).
+
+    ONE body for both variants on purpose: the send/drain/gather semaphore
+    discipline is the bug-prone part, and a fix must not have to land
+    twice."""
+    it = iter(refs)
+    send_ref = next(it)
+    scl_ref = next(it) if fp8 else None
+    wg_ref, wu_ref, wd_ref = next(it), next(it), next(it)
+    comb_ref = next(it) if combine else None
+    y_ref = None if combine else next(it)
+    recv_ref = next(it)
+    scl_recv_ref = next(it) if fp8 else None
+    xs = next(it)
+    acc = next(it)
+    y_stage = next(it) if combine else None
+    xs_s = next(it) if fp8 else None
+    send_sem, recv_sem, copy_sem = next(it), next(it), next(it)
+    if combine:
+        comb_send_sem, comb_recv_sem, comb_local_sem = next(it), next(it), next(it)
+    assert next(it, None) is None, "ref list mismatch"
+
+    model_dtype = y_stage.dtype if combine else y_ref.dtype
     e_i = pl.program_id(0)
     f_i = pl.program_id(1)
     me = tpl.rank(axis)
@@ -67,11 +98,17 @@ def _fused_dispatch_mlp_kernel(
 
     @pl.when(jnp.logical_and(e_i == 0, f_i == 0))
     def _():
-        # Peers may still be reading recv from a previous step.
+        # Peers may still be reading recv/comb from a previous step.
         tpl.barrier_all(axis, mesh_axes=mesh_axes)
         cp = pltpu.make_async_copy(send_ref.at[me], recv_ref.at[me], copy_sem)
         cp.start()
         cp.wait()
+        if fp8:
+            cp2 = pltpu.make_async_copy(
+                scl_ref.at[me], scl_recv_ref.at[me], copy_sem
+            )
+            cp2.start()
+            cp2.wait()
 
         def send(i, _):
             peer = jax.lax.rem(me + i, world)
@@ -79,15 +116,25 @@ def _fused_dispatch_mlp_kernel(
                 send_ref.at[peer], recv_ref.at[me], send_sem, recv_sem, peer,
                 axis=axis, mesh_axes=mesh_axes,
             ).start()
+            if fp8:
+                tpl.putmem_signal(
+                    scl_ref.at[peer], scl_recv_ref.at[me], send_sem, recv_sem,
+                    peer, axis=axis, mesh_axes=mesh_axes,
+                ).start()
             return 0
 
         jax.lax.fori_loop(1, world, send, 0)
 
         def drain(i, _):
-            # Each arrival delivers one (E_local*C, d) chunk; the weight
-            # pipeline for expert 0 is already streaming while we sit here.
+            # Each arrival delivers one (E_local*C, d) chunk (+ scales); the
+            # weight pipeline for expert 0 streams while we sit here.
             tpl.wait_recv(recv_sem, recv_ref.at[me])
             pltpu.make_async_copy(send_ref.at[me], send_ref.at[me], send_sem).wait()
+            if fp8:
+                tpl.wait_recv(recv_sem, scl_recv_ref.at[me])
+                pltpu.make_async_copy(
+                    scl_ref.at[me], scl_ref.at[me], send_sem
+                ).wait()
             return 0
 
         jax.lax.fori_loop(1, world, drain, 0)
@@ -104,6 +151,12 @@ def _fused_dispatch_mlp_kernel(
                 xs.at[pl.ds(s * cap, cap)],
                 copy_sem,
             ).start()
+            if fp8:
+                pltpu.make_async_copy(
+                    scl_recv_ref.at[s, pl.ds(e_i * cap, cap)],
+                    xs_s.at[pl.ds(s * cap, cap)],
+                    copy_sem,
+                ).start()
             return 0
 
         jax.lax.fori_loop(0, world, fetch, 0)
@@ -112,34 +165,191 @@ def _fused_dispatch_mlp_kernel(
             pltpu.make_async_copy(
                 xs.at[pl.ds(s * cap, cap)], xs.at[pl.ds(s * cap, cap)], copy_sem
             ).wait()
+            if fp8:
+                pltpu.make_async_copy(
+                    xs_s.at[pl.ds(s * cap, cap)], xs_s.at[pl.ds(s * cap, cap)],
+                    copy_sem,
+                ).wait()
             return 0
 
         jax.lax.fori_loop(0, world, drain_fetch, 0)
         acc[...] = jnp.zeros_like(acc)
 
-    g = jnp.dot(xs[...], wg_ref[0], preferred_element_type=jnp.float32)
-    u = jnp.dot(xs[...], wu_ref[0], preferred_element_type=jnp.float32)
-    h = (jax.nn.silu(g) * u).astype(xs.dtype)
+    if fp8:
+        panel = (xs[...].astype(jnp.float32) * xs_s[...]).astype(model_dtype)
+    else:
+        panel = xs[...]
+    g = jnp.dot(panel, wg_ref[0], preferred_element_type=jnp.float32)
+    u = jnp.dot(panel, wu_ref[0], preferred_element_type=jnp.float32)
+    h = (jax.nn.silu(g) * u).astype(model_dtype)
     acc[...] += jnp.dot(h, wd_ref[0], preferred_element_type=jnp.float32)
+
+    if not combine:
+        @pl.when(f_i == n_f - 1)
+        def _():
+            y_ref[0] = acc[...].astype(y_ref.dtype)
+        return
+
+    def _drain_one_expert_outbound():
+        """Wait the (world-1) remote sends + 1 local copy one expert issued
+        from y_stage — it must be quiescent before anyone overwrites it
+        (and comb_local_sem is dedicated: copy_sem's fetch byte counts
+        must not absorb the combine copy's bytes, or a fetch drain could
+        'complete' on the wrong DMA and read xs early)."""
+        def drain_sends(i, _):
+            pltpu.make_async_copy(
+                y_stage.at[pl.ds(0, cap)], y_stage.at[pl.ds(0, cap)],
+                comb_send_sem,
+            ).wait()
+            return 0
+
+        jax.lax.fori_loop(0, world - 1, drain_sends, 0)
+        pltpu.make_async_copy(
+            y_stage.at[pl.ds(0, cap)], y_stage.at[pl.ds(0, cap)],
+            comb_local_sem,
+        ).wait()
 
     @pl.when(f_i == n_f - 1)
     def _():
-        y_ref[0] = acc[...].astype(y_ref.dtype)
+        # COMBINE leg: this expert's output chunks fly home NOW, overlapping
+        # the next expert's weight streaming. Destination slot on owner s is
+        # (my rank, this expert) — the (world·E_local, C, d) global-expert-
+        # major layout the weighted unpermute expects.
+        @pl.when(e_i > 0)
+        def _():
+            _drain_one_expert_outbound()  # y_stage still flying for e_i-1
+
+        y_stage[...] = acc[...].astype(y_stage.dtype)
+
+        def send_back(s, _):
+            src = y_stage.at[pl.ds(s * cap, cap)]
+
+            @pl.when(s == me)
+            def _():
+                pltpu.make_async_copy(
+                    src, comb_ref.at[me, pl.ds(e_i * cap, cap)], comb_local_sem
+                ).start()
+
+            @pl.when(s != me)
+            def _():
+                tpl.putmem_signal(
+                    src, comb_ref.at[me, pl.ds(e_i * cap, cap)],
+                    comb_send_sem, comb_recv_sem, s,
+                    axis=axis, mesh_axes=mesh_axes,
+                ).start()
+            return 0
+
+        jax.lax.fori_loop(0, world, send_back, 0)
+
+    @pl.when(jnp.logical_and(e_i == e_local - 1, f_i == n_f - 1))
+    def _():
+        # Drain the last expert's outbound leg, then every peer expert's
+        # arrival — the jit-level unpermute reads comb_ref next.
+        _drain_one_expert_outbound()
+
+        def drain_arrivals(i, _):
+            p = i // e_local
+            p = jnp.where(p >= me, p + 1, p)  # skip self
+            e = jax.lax.rem(i, e_local)
+            tpl.wait_recv(comb_recv_sem, comb_ref.at[p, pl.ds(e * cap, cap)])
+            return 0
+
+        jax.lax.fori_loop(0, (world - 1) * e_local, drain_arrivals, 0)
 
 
 def fused_moe_supported(world: int, cap: int, d: int, ff: int,
                         itemsize: int, block_f: int = 512,
-                        vmem_limit_mb: int = 100) -> bool:
+                        vmem_limit_mb: int = 100,
+                        combine: bool = True) -> bool:
     """Static feasibility check for the fused kernel's VMEM plan: token
-    panel + f32 accumulator + double-buffered weight tiles + the
-    double-buffered (world·C, d) output block (its index map varies with
-    the expert grid dim, so the pipeline keeps two resident). The plan is
-    expert-count-independent — per-expert state lives in the same buffers."""
+    panel + f32 accumulator (+ y staging for the combine variant) +
+    double-buffered weight tiles + the double-buffered (world·C, d) output
+    block (its index map varies with the expert grid dim, so the pipeline
+    keeps two resident). The plan is expert-count-independent — per-expert
+    state lives in the same buffers."""
     bf = fit_block(ff, block_f)
-    panel = world * cap * d * (itemsize + 4)
+    panel = world * cap * d * (itemsize + 4 + (itemsize if combine else 0))
     tiles = 2 * (2 * d * bf + bf * d) * itemsize  # double-buffered g/u/d tiles
     out_blocks = 2 * world * cap * d * itemsize
     return panel + tiles + out_blocks <= vmem_limit_mb * 1024 * 1024
+
+
+def _fused_ep_call(send, w_gate, w_up, w_down, *, capacity, axis, mesh_axes,
+                   block_f, vmem_limit_mb, combine, wire_fp8):
+    """Shared launch plumbing for both variants of ``_fused_ep_kernel``."""
+    world = jax.lax.axis_size(axis)
+    _, chunk, d = send.shape
+    e_local = chunk // capacity
+    ff = w_gate.shape[-1]
+    bf = fit_block(ff, block_f)
+    n_f = ff // bf
+    model_dtype = send.dtype
+
+    if wire_fp8:
+        from triton_dist_tpu.kernels.low_latency_a2a import quantize_fp8
+
+        q, scl = quantize_fp8(send.reshape(world * chunk, d))
+        send_ops = (q.reshape(world, chunk, d), scl.reshape(world, chunk, 1))
+    else:
+        send_ops = (send,)
+    wire_dtype = send_ops[0].dtype
+
+    in_specs = [pl.BlockSpec(memory_space=pl.ANY)] * len(send_ops) + [
+        pl.BlockSpec((1, d, bf), lambda e, f: (e, 0, f)),
+        pl.BlockSpec((1, d, bf), lambda e, f: (e, 0, f)),
+        pl.BlockSpec((1, bf, d), lambda e, f: (e, f, 0)),
+    ]
+    out_specs = []
+    out_shape = []
+    if combine:
+        out_specs.append(pl.BlockSpec(memory_space=pl.ANY))
+        out_shape.append(jax.ShapeDtypeStruct((world, chunk, d), model_dtype))
+    else:
+        out_specs.append(
+            pl.BlockSpec((1, world * capacity, d), lambda e, f: (e, 0, 0))
+        )
+        out_shape.append(
+            jax.ShapeDtypeStruct((e_local, world * capacity, d), model_dtype)
+        )
+    out_specs.append(pl.BlockSpec(memory_space=pl.ANY))  # recv
+    out_shape.append(jax.ShapeDtypeStruct((world, chunk, d), wire_dtype))
+    if wire_fp8:
+        out_specs.append(pl.BlockSpec(memory_space=pl.ANY))  # scale recv
+        out_shape.append(jax.ShapeDtypeStruct((world, chunk, 1), jnp.float32))
+
+    scratch = [
+        pltpu.VMEM((world * capacity, d), wire_dtype),  # xs
+        pltpu.VMEM((world * capacity, d), jnp.float32),  # acc
+    ]
+    if combine:
+        scratch.append(pltpu.VMEM((world * capacity, d), model_dtype))  # y_stage
+    if wire_fp8:
+        scratch.append(pltpu.VMEM((world * capacity, 1), jnp.float32))  # xs_s
+    scratch += [pltpu.SemaphoreType.DMA] * (6 if combine else 3)
+
+    res = dist_pallas_call(
+        functools.partial(
+            _fused_ep_kernel,
+            axis=axis, mesh_axes=mesh_axes, cap=capacity, n_f=n_f,
+            e_local=e_local, fp8=wire_fp8, combine=combine,
+        ),
+        grid=(e_local, n_f),
+        in_specs=in_specs,
+        out_specs=tuple(out_specs),
+        out_shape=tuple(out_shape),
+        scratch_shapes=scratch,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary"),
+            vmem_limit_bytes=vmem_limit_mb * 1024 * 1024,
+            has_side_effects=True,
+            # Distinct barrier semaphore per kernel VARIANT: two variants in
+            # one program must not alias.
+            collective_id=collective_id_for(
+                f"_fused_ep_kernel:combine={combine}:fp8={wire_fp8}"
+            ),
+        ),
+    )(*send_ops, w_gate, w_up, w_down)
+    return res[0]
 
 
 def fused_dispatch_mlp_shard(
@@ -153,15 +363,13 @@ def fused_dispatch_mlp_shard(
     mesh_axes=None,
     block_f: int = 512,
     vmem_limit_mb: int = 100,
+    wire_fp8: bool = False,
 ) -> jax.Array:
     """a2a-dispatch + grouped gate/up/SwiGLU/down in one kernel. Returns the
     per-expert output panels (E_local, world*C, d). Inside shard_map."""
     world = jax.lax.axis_size(axis)
     _, chunk, d = send.shape
     e_local = chunk // capacity
-    ff = w_gate.shape[-1]
-    bf = fit_block(ff, block_f)
-    n_f = ff // bf
 
     if world == 1:
         from triton_dist_tpu.kernels.group_gemm import group_gemm, group_gemm_swiglu
@@ -169,42 +377,48 @@ def fused_dispatch_mlp_shard(
         xs = send.reshape(e_local, capacity, d)
         return group_gemm(group_gemm_swiglu(xs, w_gate, w_up), w_down)
 
-    grid = (e_local, n_f)
-    y, _recv = dist_pallas_call(
-        functools.partial(
-            _fused_dispatch_mlp_kernel,
-            axis=axis, mesh_axes=mesh_axes, cap=capacity, n_f=n_f,
-        ),
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec((1, d, bf), lambda e, f: (e, 0, f)),
-            pl.BlockSpec((1, d, bf), lambda e, f: (e, 0, f)),
-            pl.BlockSpec((1, bf, d), lambda e, f: (e, f, 0)),
-        ],
-        out_specs=(
-            pl.BlockSpec((1, world * capacity, d), lambda e, f: (e, 0, 0)),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ),
-        out_shape=(
-            jax.ShapeDtypeStruct((e_local, world * capacity, d), send.dtype),
-            jax.ShapeDtypeStruct(send.shape, send.dtype),
-        ),
-        scratch_shapes=[
-            pltpu.VMEM((world * capacity, d), send.dtype),
-            pltpu.VMEM((world * capacity, d), jnp.float32),
-            pltpu.SemaphoreType.DMA,
-            pltpu.SemaphoreType.DMA,
-            pltpu.SemaphoreType.DMA,
-        ],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("arbitrary", "arbitrary"),
-            vmem_limit_bytes=vmem_limit_mb * 1024 * 1024,
-            has_side_effects=True,
-            collective_id=collective_id_for("_fused_dispatch_mlp_kernel"),
-        ),
-    )(send, w_gate, w_up, w_down)
-    return y
+    return _fused_ep_call(
+        send, w_gate, w_up, w_down, capacity=capacity, axis=axis,
+        mesh_axes=mesh_axes, block_f=block_f, vmem_limit_mb=vmem_limit_mb,
+        combine=False, wire_fp8=wire_fp8,
+    )
+
+
+def fused_dispatch_mlp_combine_shard(
+    send: jax.Array,  # (world, E_local*C, d) destination-major slot grid
+    w_gate: jax.Array,  # (E_local, d, ff)
+    w_up: jax.Array,
+    w_down: jax.Array,
+    *,
+    capacity: int,
+    axis: str = "ep",
+    mesh_axes=None,
+    block_f: int = 512,
+    vmem_limit_mb: int = 100,
+    wire_fp8: bool = False,
+) -> jax.Array:
+    """a2a-dispatch + grouped MLP + return-a2a COMBINE in ONE kernel.
+    Returns the combine landing buffer (world, E_local*C, d) — from peer p,
+    p's experts' outputs for MY tokens, global-expert-major — ready for the
+    local weighted unpermute (``moe_utils.combine``). ``wire_fp8`` moves
+    e4m3 + per-token scales on the dispatch wire (half the dispatch bytes).
+    Inside shard_map."""
+    world = jax.lax.axis_size(axis)
+    _, chunk, d = send.shape
+    e_local = chunk // capacity
+
+    if world == 1:
+        from triton_dist_tpu.kernels.group_gemm import group_gemm, group_gemm_swiglu
+
+        xs = send.reshape(e_local, capacity, d)
+        y = group_gemm(group_gemm_swiglu(xs, w_gate, w_up), w_down)
+        return y.reshape(1, e_local * capacity, d)
+
+    return _fused_ep_call(
+        send, w_gate, w_up, w_down, capacity=capacity, axis=axis,
+        mesh_axes=mesh_axes, block_f=block_f, vmem_limit_mb=vmem_limit_mb,
+        combine=True, wire_fp8=wire_fp8,
+    )
 
 
 def ep_moe_fused_kernel_shard(
@@ -222,19 +436,23 @@ def ep_moe_fused_kernel_shard(
     block_f: int = 512,
     fallback_wire_fp8: bool = False,
     use_pallas_a2a: bool = False,
+    combine_in_kernel: bool = True,
+    wire_fp8: bool = False,
 ) -> jax.Array:
-    """Full fused-EP MoE: route → ONE-KERNEL dispatch+expert-MLP → combine
-    (reference ``ep_all2all_fused`` end-to-end composition). Falls back to
-    the jit-level ``ep_moe_ll_shard`` when the fused kernel's VMEM plan
+    """Full fused-EP MoE: route → ONE KERNEL (dispatch + expert MLP +
+    return-a2a combine) → local weighted unpermute (reference
+    ``ep_all2all_fused`` end-to-end composition, combine in-kernel at
+    :1020). ``wire_fp8`` moves e4m3 + scales on the dispatch wire inside
+    the kernel (reference v2, :1288). ``combine_in_kernel=False`` keeps
+    the older two-step form (kernel → jit-level combine a2a). Falls back
+    to the jit-level ``ep_moe_ll_shard`` when the fused kernel's VMEM plan
     doesn't fit — with ``fallback_wire_fp8`` deciding that path's wire
-    dtype (the fused kernel itself always moves the model dtype) and
-    ``use_pallas_a2a`` selecting the fallback's and combine leg's transport
-    (default False = XLA, matching ``EP_MoE.use_pallas_a2a``; the fused
-    kernel's own in-kernel a2a is inherently the pallas one either way).
-    Inside shard_map."""
+    dtype and ``use_pallas_a2a`` its transport (default False = XLA,
+    matching ``EP_MoE.use_pallas_a2a``). Inside shard_map."""
     from triton_dist_tpu.kernels.low_latency_a2a import combine_leg_shard
     from triton_dist_tpu.kernels.moe_utils import (
         capacity_for,
+        combine,
         dispatch as local_dispatch,
         make_routing_plan,
         topk_routing,
@@ -246,7 +464,8 @@ def ep_moe_fused_kernel_shard(
     ff = w_gate.shape[-1]
     cap = capacity_for(t, top_k, num_experts, capacity_factor)
 
-    if not fused_moe_supported(world, cap, d, ff, x.dtype.itemsize, block_f):
+    if not fused_moe_supported(world, cap, d, ff, x.dtype.itemsize, block_f,
+                               combine=combine_in_kernel):
         from triton_dist_tpu.kernels.low_latency_a2a import ep_moe_ll_shard
 
         return ep_moe_ll_shard(
@@ -260,9 +479,15 @@ def ep_moe_fused_kernel_shard(
     idx, w = topk_routing(logits, top_k)
     plan = make_routing_plan(idx, num_experts, cap)
     send = local_dispatch(x, plan).reshape(world, e_local * cap, d)
+    if combine_in_kernel:
+        comb = fused_dispatch_mlp_combine_shard(
+            send, w_gate, w_up, w_down, capacity=cap, axis=axis,
+            mesh_axes=mesh_axes, block_f=block_f, wire_fp8=wire_fp8,
+        )
+        return combine(comb.reshape(world * e_local, cap, d), plan, w, t)
     y = fused_dispatch_mlp_shard(
         send, w_gate, w_up, w_down, capacity=cap, axis=axis,
-        mesh_axes=mesh_axes, block_f=block_f,
+        mesh_axes=mesh_axes, block_f=block_f, wire_fp8=wire_fp8,
     )
     return combine_leg_shard(
         y, plan, t, w, axis=axis, mesh_axes=mesh_axes, use_pallas=use_pallas_a2a

@@ -49,13 +49,15 @@ class EP_MoE:
         if self.fused_kernel:
             from triton_dist_tpu.kernels.ep_fused import ep_moe_fused_kernel_shard
 
-            # If low_latency is ALSO set, honor its fp8 wire in the
-            # VMEM-fallback path (the fused kernel itself is model-dtype).
+            # If low_latency is ALSO set, the fp8 wire applies in BOTH
+            # forms: in-kernel (e4m3 + scales on the dispatch puts) and in
+            # the VMEM-fallback jit path.
             return ep_moe_fused_kernel_shard(
                 x, self.w_router, self.w_gate, self.w_up, self.w_down,
                 num_experts=self.num_experts, top_k=self.top_k,
                 capacity_factor=self.capacity_factor,
                 axis=self.axis, mesh_axes=self.mesh_axes,
+                wire_fp8=self.low_latency,
                 fallback_wire_fp8=self.low_latency,
                 use_pallas_a2a=self.use_pallas_a2a,
             )

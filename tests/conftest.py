@@ -41,6 +41,11 @@ DEFAULT_TEST_TIMEOUT_S = int(os.environ.get("TDT_TEST_TIMEOUT", "180"))
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "timeout(seconds): per-test hang watchdog limit")
+    config.addinivalue_line(
+        "markers",
+        "tpu: runs compiled (non-interpret) kernels on the real chip; "
+        "auto-skips when no TPU is reachable (see tests/test_on_tpu.py)",
+    )
 
 
 @pytest.fixture(autouse=True)

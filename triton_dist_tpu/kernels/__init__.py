@@ -81,6 +81,7 @@ from triton_dist_tpu.kernels.ep_a2a import (
 )
 from triton_dist_tpu.kernels.ep_fused import (
     ep_moe_fused_kernel_shard,
+    fused_dispatch_mlp_combine_shard,
     fused_dispatch_mlp_shard,
     fused_moe_supported,
 )
@@ -115,6 +116,7 @@ __all__ = [
     "create_all_to_all_context",
     "fast_all_to_all",
     "ep_moe_fused_kernel_shard",
+    "fused_dispatch_mlp_combine_shard",
     "fused_dispatch_mlp_shard",
     "fused_moe_supported",
     "AllGatherMethod",

@@ -260,17 +260,21 @@ def _fused_ep_kernel(
 def fused_moe_supported(world: int, cap: int, d: int, ff: int,
                         itemsize: int, block_f: int = 512,
                         vmem_limit_mb: int = 100,
-                        combine: bool = True) -> bool:
+                        combine: bool = True,
+                        wire_fp8: bool = False) -> bool:
     """Static feasibility check for the fused kernel's VMEM plan: token
-    panel + f32 accumulator (+ y staging for the combine variant) +
-    double-buffered weight tiles + the double-buffered (world·C, d) output
-    block (its index map varies with the expert grid dim, so the pipeline
-    keeps two resident). The plan is expert-count-independent — per-expert
-    state lives in the same buffers."""
+    panel (xs at WIRE itemsize + f32 accumulator, + y staging in combine
+    mode) + double-buffered weight tiles + — in the combine=False variant
+    only — the double-buffered (world·C, d) y output block (its index map
+    varies with the expert grid dim, so the pipeline keeps two resident;
+    the combine variant's landing buffer is ANY/HBM and costs no VMEM).
+    The plan is expert-count-independent — per-expert state lives in the
+    same buffers."""
     bf = fit_block(ff, block_f)
-    panel = world * cap * d * (itemsize + 4 + (itemsize if combine else 0))
+    xs_item = 1 if wire_fp8 else itemsize
+    panel = world * cap * d * (xs_item + 4 + (itemsize if combine else 0))
     tiles = 2 * (2 * d * bf + bf * d) * itemsize  # double-buffered g/u/d tiles
-    out_blocks = 2 * world * cap * d * itemsize
+    out_blocks = 0 if combine else 2 * world * cap * d * itemsize
     return panel + tiles + out_blocks <= vmem_limit_mb * 1024 * 1024
 
 
@@ -465,7 +469,7 @@ def ep_moe_fused_kernel_shard(
     cap = capacity_for(t, top_k, num_experts, capacity_factor)
 
     if not fused_moe_supported(world, cap, d, ff, x.dtype.itemsize, block_f,
-                               combine=combine_in_kernel):
+                               combine=combine_in_kernel, wire_fp8=wire_fp8):
         from triton_dist_tpu.kernels.low_latency_a2a import ep_moe_ll_shard
 
         return ep_moe_ll_shard(

@@ -8,6 +8,7 @@ the runtime's own error output.
 """
 
 import os
+import pathlib
 import shutil
 import subprocess
 
@@ -62,3 +63,50 @@ def test_cpp_runtime_end_to_end(tmp_path):
     # expected_*.bin was computed on the CPU sim; the runtime ran on TPU —
     # different f32 matmul internals, so compare at accumulation tolerance.
     assert aot.compare_outputs(art, rtol=2e-3) == 1
+
+
+def test_aot_config_space_dispatch(tmp_path):
+    """Config-space export + runtime dispatch (reference aot_compile_spaces,
+    compile_aot.py:62 + ep_a2a.py:64-77): a grid of (signature, algo)
+    variants exports as one space; AotSpace selects by input signature and
+    algo, raising loudly off-grid."""
+    import jax.numpy as jnp
+
+    from triton_dist_tpu.tools.aot import AotSpace, export_aot_space
+
+    def build(block=4):
+        # The algo changes the traced program (tile-summed matmul).
+        def f(a, b):
+            acc = jnp.zeros((a.shape[0], b.shape[1]), jnp.float32)
+            for i in range(0, a.shape[1], block):
+                acc += a[:, i:i + block] @ b[i:i + block, :]
+            return acc
+        return f
+
+    x8 = np.ones((8, 8), np.float32)
+    x16 = np.ones((16, 8), np.float32)
+    w = np.ones((8, 4), np.float32)
+    space = [
+        {"args": (x8, w), "algo": {"block": 4}},
+        {"args": (x8, w), "algo": {"block": 8}},
+        {"args": (x16, w), "algo": {"block": 4}},
+    ]
+    root = export_aot_space("toy_gemm", build, space, os.fspath(tmp_path))
+
+    sp = AotSpace(root)
+    assert len(sp.points) == 3
+    # Signature-only dispatch: first exported algo wins for (8,8).
+    art = sp.select((x8, w))
+    assert "block-4" in art
+    # Explicit algo dispatch.
+    art8 = sp.select((x8, w), algo={"block": 8})
+    assert "block-8" in art8 and art8 != art
+    # Different shape → different artifact.
+    assert sp.select((x16, w)) not in (art, art8)
+    # Every artifact is a full runnable export (program + manifests).
+    for p in sp.points:
+        d = pathlib.Path(root) / p["artifact"]
+        assert (d / "program.mlir").exists() and (d / "manifest.txt").exists()
+    # Off-grid signature fails loudly.
+    with pytest.raises(KeyError):
+        sp.select((np.ones((3, 8), np.float32), w))

@@ -23,32 +23,35 @@ import sys
 
 import pytest
 
-pytestmark = pytest.mark.tpu
+# timeout(420) raises the conftest hang watchdog ABOVE the subprocess
+# timeouts below — otherwise a slow Mosaic compile would os._exit the whole
+# session at 180 s before the subprocess timeout could convert it to a skip.
+pytestmark = [pytest.mark.tpu, pytest.mark.timeout(420)]
 
 _ROOT = pathlib.Path(__file__).parents[1]
 
 
-def _run_fresh(code: str, timeout: int = 240) -> subprocess.CompletedProcess:
+def _run_fresh(code: str, timeout: int = 300) -> subprocess.CompletedProcess:
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)  # drop the sim's 8-CPU forcing
     env.pop("JAX_PLATFORMS", None)
     env["PYTHONPATH"] = str(_ROOT) + os.pathsep + env.get("PYTHONPATH", "")
-    return subprocess.run(
-        [sys.executable, "-c", code], capture_output=True, text=True,
-        timeout=timeout, env=env,
-    )
+    try:
+        return subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=timeout, env=env,
+        )
+    except subprocess.TimeoutExpired:
+        pytest.skip("on-chip run exceeded its timeout (slow/hung tunnel)")
 
 
 @pytest.fixture(scope="module")
 def tpu_available():
-    try:
-        r = _run_fresh(
-            "import jax; d = jax.devices()[0];"
-            "print('TPU' if d.platform != 'cpu' else 'CPU')",
-            timeout=90,
-        )
-    except subprocess.TimeoutExpired:
-        pytest.skip("device tunnel hung")
+    r = _run_fresh(
+        "import jax; d = jax.devices()[0];"
+        "print('TPU' if d.platform != 'cpu' else 'CPU')",
+        timeout=90,
+    )
     if r.returncode != 0 or "TPU" not in r.stdout:
         pytest.skip(f"no TPU reachable: {r.stderr[-200:]}")
     return True

@@ -154,3 +154,98 @@ def compare_outputs(artifact_dir: str, *, rtol: float = 1e-4) -> int:
             np.testing.assert_array_equal(g, e)
         n += 1
     return n
+
+
+# --------------------------------------------------------------------------
+# Config-space export + runtime dispatch (reference ``aot_compile_spaces``,
+# compile_aot.py:62, usage ep_a2a.py:64-77: a grid of signatures and
+# algo-infos compiled ahead of time, dispatched at runtime).
+# --------------------------------------------------------------------------
+
+
+def _space_key(sig: str, algo: dict) -> str:
+    """Directory-safe point key: signature + sorted algo items."""
+    algo_part = "_".join(f"{k}-{v}" for k, v in sorted(algo.items()))
+    sig_part = sig.replace(",", "+").replace(":", ".")
+    return f"{sig_part}__{algo_part}" if algo_part else sig_part
+
+
+def export_aot_space(name: str, build, space, outdir: str) -> str:
+    """Export a GRID of compiled variants of one op (the
+    ``aot_compile_spaces`` analog): ``space`` is a list of
+    ``{"args": (arrays...), "algo": {...static config...}}`` points;
+    ``build(**algo)`` returns the traceable function for that config. Each
+    point lands in ``outdir/name/<key>/`` as a full ``export_aot`` artifact,
+    and ``outdir/name/space.json`` maps every point's input signature +
+    algo to its artifact — the dispatch table :class:`AotSpace` (and any
+    non-Python serving layer: it is plain JSON + the C runtime's artifact
+    format) selects from."""
+    import json
+
+    from triton_dist_tpu.tools.tune import arg_signature
+
+    root = pathlib.Path(outdir) / name
+    root.mkdir(parents=True, exist_ok=True)
+    table = []
+    for point in space:
+        args = point["args"]
+        algo = dict(point.get("algo", {}))
+        sig = arg_signature(args)
+        key = _space_key(sig, algo)
+        export_aot(build(**algo), args, str(root / key))
+        table.append({"signature": sig, "algo": algo, "artifact": key})
+    (root / "space.json").write_text(json.dumps(
+        {"name": name, "points": table}, indent=1, sort_keys=True))
+    return str(root)
+
+
+class AotSpace:
+    """Runtime dispatcher over an exported config space: pick the artifact
+    whose signature matches the inputs (and, optionally, a requested algo),
+    then hand it to the C++ runtime (``run_aot``) or any PJRT host."""
+
+    def __init__(self, root: str):
+        import json
+
+        self.root = pathlib.Path(root)
+        data = json.loads((self.root / "space.json").read_text())
+        self.name = data["name"]
+        self.points = data["points"]
+
+    def select(self, args, algo: dict | None = None) -> str:
+        """Artifact dir for these inputs. With ``algo=None`` and several
+        algo variants for the signature, the FIRST exported wins (export
+        order is preference order, like the reference's algo_info lists)."""
+        from triton_dist_tpu.tools.tune import arg_signature
+
+        sig = arg_signature(args)
+        for p in self.points:
+            if p["signature"] == sig and (algo is None or p["algo"] == algo):
+                return str(self.root / p["artifact"])
+        raise KeyError(
+            f"AotSpace {self.name!r}: no artifact for signature {sig!r}"
+            + (f" with algo {algo}" if algo else "")
+            + f"; have {[(p['signature'], p['algo']) for p in self.points]}"
+        )
+
+    def run(self, args, algo: dict | None = None, workdir: str | None = None,
+            **kw):
+        """Dispatch + execute through the C++ runtime on THESE input values.
+        The selected artifact is COPIED to a per-run directory first — the
+        exported artifact stays pristine (its expected_*.bin self-validation
+        pairs with its export-time inputs) and concurrent dispatches can't
+        interleave input writes. Returns (CompletedProcess, run_dir)."""
+        import shutil
+        import tempfile
+
+        art = pathlib.Path(self.select(args, algo))
+        run_dir = pathlib.Path(workdir or tempfile.mkdtemp(prefix="aot_run_"))
+        if run_dir.exists() and run_dir != art:
+            shutil.rmtree(run_dir, ignore_errors=True)
+        shutil.copytree(art, run_dir)
+        for i, a in enumerate(args):
+            a = np.asarray(a)
+            (run_dir / f"input_{i}.bin").write_bytes(
+                np.ascontiguousarray(a).tobytes()
+            )
+        return run_aot(str(run_dir), **kw), str(run_dir)

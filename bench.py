@@ -225,7 +225,8 @@ def bench_overlap_model(on_tpu, flash_tflops):
     single-chip runs can't measure multi-chip overlap, so BENCH records the
     model inputs the multi-chip judge run plugs measurements into."""
     from triton_dist_tpu.tools.perf_model import (
-        allgather_time_s, attention_time_s, chip_spec, gemm_time_s,
+        CHIPS, allgather_time_s, attention_time_s, chip_spec, gemm_time_s,
+        overlap_efficiency,
     )
 
     spec = chip_spec()
@@ -252,8 +253,6 @@ def bench_overlap_model(on_tpu, flash_tflops):
         # this TP shape (N=512/chip) the ring is the bigger leg on BOTH
         # chips — the metric says how completely the compute leg hides
         # under it (model: ~0.97 ≥ the 0.9 target on v5e and v5p alike).
-        from triton_dist_tpu.tools.perf_model import CHIPS, overlap_efficiency
-
         # Fixed chip specs (NOT the host's): these are recorded model
         # inputs, and a v5p host must not mislabel them.
         for label, sp in (("v5e", CHIPS["tpu v5 lite"]), ("v5p", CHIPS["tpu v5"])):

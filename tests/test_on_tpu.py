@@ -32,26 +32,31 @@ _ROOT = pathlib.Path(__file__).parents[1]
 
 
 def _run_fresh(code: str, timeout: int = 300) -> subprocess.CompletedProcess:
+    """Run in a fresh interpreter seeing the real backend. TimeoutExpired
+    propagates: once the availability probe has PASSED, a timeout in a test
+    body is a genuine on-chip hang and must FAIL, not skip — this suite's
+    whole job is catching compiled-kernel deadlocks (only the probe itself
+    converts timeouts to skips)."""
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)  # drop the sim's 8-CPU forcing
     env.pop("JAX_PLATFORMS", None)
     env["PYTHONPATH"] = str(_ROOT) + os.pathsep + env.get("PYTHONPATH", "")
-    try:
-        return subprocess.run(
-            [sys.executable, "-c", code], capture_output=True, text=True,
-            timeout=timeout, env=env,
-        )
-    except subprocess.TimeoutExpired:
-        pytest.skip("on-chip run exceeded its timeout (slow/hung tunnel)")
+    return subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=timeout, env=env,
+    )
 
 
 @pytest.fixture(scope="module")
 def tpu_available():
-    r = _run_fresh(
-        "import jax; d = jax.devices()[0];"
-        "print('TPU' if d.platform != 'cpu' else 'CPU')",
-        timeout=90,
-    )
+    try:
+        r = _run_fresh(
+            "import jax; d = jax.devices()[0];"
+            "print('TPU' if d.platform != 'cpu' else 'CPU')",
+            timeout=90,
+        )
+    except subprocess.TimeoutExpired:
+        pytest.skip("device tunnel hung")
     if r.returncode != 0 or "TPU" not in r.stdout:
         pytest.skip(f"no TPU reachable: {r.stderr[-200:]}")
     return True

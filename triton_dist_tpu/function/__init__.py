@@ -11,6 +11,7 @@ compiler-default collectives.
 from triton_dist_tpu.function.collectives import (
     ag_gemm_fn,
     flash_attention_fn,
+    flash_attention_varlen_fn,
     flash_attention_lse_fn,
     ring_attention_fn,
     gemm_rs_fn,
@@ -23,6 +24,7 @@ from triton_dist_tpu.function.ep_moe import ep_moe_fused_fn
 __all__ = [
     "ag_gemm_fn",
     "flash_attention_fn",
+    "flash_attention_varlen_fn",
     "flash_attention_lse_fn",
     "ring_attention_fn",
     "gemm_rs_fn",

@@ -314,3 +314,36 @@ def ring_attention_fn(
         )
 
     return ring_schedule(q, k, v, axis=axis, causal=causal, attend=attend)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(4,))
+def flash_attention_varlen_fn(q, k, v, cu_seqlens, scale: float | None = None):
+    """Differentiable varlen (packed-sequence) flash attention: the Pallas
+    forward + the segment-masked Pallas backward
+    (``flash_attention_varlen_bwd``) — packed-SFT training over cu_seqlens
+    batches with O(T) memory. ``cu_seqlens`` is data (no grad)."""
+    from triton_dist_tpu.kernels.flash_attn import flash_attention_varlen
+
+    return flash_attention_varlen(q, k, v, cu_seqlens, scale=scale)
+
+
+def _flash_varlen_fwd(q, k, v, cu_seqlens, scale):
+    from triton_dist_tpu.kernels.flash_attn import flash_attention_varlen
+
+    o, lse = flash_attention_varlen(
+        q, k, v, cu_seqlens, scale=scale, return_lse=True
+    )
+    return o, (q, k, v, o, lse, cu_seqlens)
+
+
+def _flash_varlen_bwd(scale, res, do):
+    from triton_dist_tpu.kernels.flash_attn import flash_attention_varlen_bwd
+
+    q, k, v, o, lse, cu_seqlens = res
+    dq, dk, dv = flash_attention_varlen_bwd(
+        q, k, v, o, lse, do, cu_seqlens, scale=scale
+    )
+    return dq, dk, dv, None
+
+
+flash_attention_varlen_fn.defvjp(_flash_varlen_fwd, _flash_varlen_bwd)

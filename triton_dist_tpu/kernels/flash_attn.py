@@ -330,7 +330,7 @@ def flash_attention(
 
 
 def _flash_varlen_kernel(
-    q_ref, k_ref, v_ref, qseg_ref, kseg_ref, o_ref,
+    q_ref, k_ref, v_ref, qseg_ref, kseg_ref, o_ref, lse_ref,
     acc_scr, m_scr, l_scr, *, scale, block_q, block_k, n_kv,
 ):
     ik = pl.program_id(2)
@@ -382,8 +382,15 @@ def _flash_varlen_kernel(
     @pl.when(ik == n_kv - 1)
     def _():
         l = l_scr[:, :1]
-        l = jnp.where(l == 0.0, 1.0, l)  # padding rows → zero output
+        empty = l == 0.0  # padding rows → zero output
+        l = jnp.where(empty, 1.0, l)
         o_ref[0] = (acc_scr[...] / l).astype(o_ref.dtype)
+        if lse_ref is not None:
+            # m/l are base-2; publish nats. Padding rows get NEG_INF so the
+            # backward's lse guard zeroes their p exactly.
+            LOG2E = 1.4426950408889634
+            lse = (m_scr[:, 0] + jnp.log2(jnp.maximum(l_scr[:, 0], 1e-30))) / LOG2E
+            lse_ref[0, 0] = jnp.where(empty[:, 0], NEG_INF, lse)
 
 
 def flash_attention_varlen(
@@ -395,6 +402,7 @@ def flash_attention_varlen(
     scale: float | None = None,
     block_q: int = 1024,
     block_k: int = 1024,
+    return_lse: bool = False,
 ) -> jax.Array:
     """Varlen (cu_seqlens) causal flash attention over packed sequences —
     the reference's ``sp_ag_attention_intra_node.py`` varlen path. Tokens
@@ -410,23 +418,25 @@ def flash_attention_varlen(
     block_k = fit_block(t, block_k)
     n_kv = t // block_k
 
-    # Segment id per packed position; padding tail gets -1 (never matches
-    # a K segment because the Q row's own segment is also -1... it *does*
-    # match — so give Q padding -1 and K padding -2: no pair matches).
-    pos = jnp.arange(t, dtype=jnp.int32)
-    seg = jnp.searchsorted(cu_seqlens[1:], pos, side="right").astype(jnp.int32)
-    valid = pos < cu_seqlens[-1]
-    seg_q = jnp.where(valid, seg, -1).reshape(1, t)
-    seg_k = jnp.where(valid, seg, -2).reshape(1, t)
+    # One segment-id source for fwd AND bwd: a sentinel/side drift between
+    # them would silently break gradients (saved LSE vs recomputed p).
+    seg_q, seg_k = _varlen_segments(cu_seqlens, t)
 
     def kv_index(bh, iq_, ik_):
         return bh // group, ik_, 0
 
-    return pl.pallas_call(
-        functools.partial(
-            _flash_varlen_kernel, scale=scale, block_q=block_q,
-            block_k=block_k, n_kv=n_kv,
-        ),
+    out_specs = [pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0))]
+    out_shape = [jax.ShapeDtypeStruct((hq, t, d), q.dtype)]
+    if return_lse:
+        out_specs.append(pl.BlockSpec((1, 1, block_q), lambda bh, iq, ik: (bh, 0, iq)))
+        out_shape.append(jax.ShapeDtypeStruct((hq, 1, t), jnp.float32))
+
+    kernel = functools.partial(
+        _flash_varlen_kernel, scale=scale, block_q=block_q,
+        block_k=block_k, n_kv=n_kv,
+    )
+    res = pl.pallas_call(
+        kernel if return_lse else (lambda *refs: kernel(*refs[:6], None, *refs[6:])),
         grid=(hq, t // block_q, n_kv),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0)),
@@ -435,8 +445,8 @@ def flash_attention_varlen(
             pl.BlockSpec((1, block_q), lambda bh, iq, ik: (0, iq)),
             pl.BlockSpec((1, block_k), lambda bh, iq, ik: (0, ik)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0)),
-        out_shape=jax.ShapeDtypeStruct((hq, t, d), q.dtype),
+        out_specs=out_specs if return_lse else out_specs[0],
+        out_shape=out_shape if return_lse else out_shape[0],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
             pltpu.VMEM((block_q, LANES), jnp.float32),
@@ -447,6 +457,10 @@ def flash_attention_varlen(
         ),
         interpret=interpret_mode_default(),
     )(q, k, v, seg_q, seg_k)
+    if return_lse:
+        o, lse = res
+        return o, lse.reshape(hq, t)
+    return res
 
 
 def attention_reference(q, k, v, *, causal=True, scale=None):
@@ -773,3 +787,217 @@ def flash_attention_bwd(
         dk.reshape(b, hkv, sk, d),
         dv.reshape(b, hkv, sk, d),
     )
+
+
+# ------------------------------------------------------- varlen backward
+
+
+def _varlen_segments(cu_seqlens: jax.Array, t: int):
+    """Per-position segment ids; Q padding −1, K padding −2 (never match)."""
+    pos = jnp.arange(t, dtype=jnp.int32)
+    seg = jnp.searchsorted(cu_seqlens[1:], pos, side="right").astype(jnp.int32)
+    valid = pos < cu_seqlens[-1]
+    return (jnp.where(valid, seg, -1).reshape(1, t),
+            jnp.where(valid, seg, -2).reshape(1, t))
+
+
+def flash_attention_varlen_bwd(
+    q: jax.Array,  # (Hq, T, D) packed
+    k: jax.Array,  # (Hkv, T, D)
+    v: jax.Array,
+    o: jax.Array,  # (Hq, T, D) saved forward output
+    lse: jax.Array,  # (Hq, T) saved log-sum-exp (nats; NEG_INF on padding)
+    do: jax.Array,  # (Hq, T, D) output cotangent
+    cu_seqlens: jax.Array,
+    *,
+    scale: float | None = None,
+    block_q: int = 1024,
+    block_k: int = 1024,
+):
+    """Varlen backward: the dense two-kernel (dq; dk/dv) structure with the
+    packed-segment mask — ``(q_id ≥ k_id) ∧ (seg_q == seg_k)`` — replacing
+    the causal-offset mask, p recomputed exactly from the saved LSE in the
+    exp2 domain. Padding rows carry lse = NEG_INF and o = 0, so their p and
+    δ vanish and they contribute nothing. Returns (dq, dk, dv).
+
+    Reference scope note: the reference's varlen attention lives inside its
+    SP prefill path and is inference-only; this backward extends the varlen
+    kernel to training (packed-sequence SFT), same discipline as the dense
+    ``flash_attention_bwd``."""
+    hq, t, d = q.shape
+    hkv = k.shape[0]
+    group = hq // hkv
+    sc = scale if scale is not None else d ** -0.5
+    block_q = fit_block(t, block_q)
+    block_k = fit_block(t, block_k)
+    n_q = t // block_q
+    n_kv = t // block_k
+    LOG2E = 1.4426950408889634
+
+    seg_q, seg_k = _varlen_segments(cu_seqlens, t)
+    lse2 = (lse.astype(jnp.float32) * LOG2E).reshape(hq, 1, t)
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    delta = delta.reshape(hq, 1, t)
+
+    def kv_index(bh, iq_, ik_):
+        return bh // group, ik_, 0
+
+    def dq_kernel(lse2_ref, delta_ref, q_ref, k_ref, v_ref, do_ref,
+                  qseg_ref, kseg_ref, dq_ref, dq_scr):
+        iq = pl.program_id(1)
+        ik = pl.program_id(2)
+
+        @pl.when(ik == 0)
+        def _():
+            dq_scr[...] = jnp.zeros_like(dq_scr)
+
+        # Packed-causal skip: same-segment keys never lie ahead of the
+        # diagonal of the packed stream.
+        @pl.when(ik * block_k <= iq * block_q + block_q - 1)
+        def _():
+            qq = q_ref[0]
+            kk = k_ref[0]
+            s2 = jax.lax.dot_general(
+                qq, kk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * (sc * LOG2E)
+            q_ids = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_ids = ik * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            mask = jnp.logical_and(
+                q_ids >= k_ids,
+                qseg_ref[0].reshape(block_q, 1) == kseg_ref[0].reshape(1, block_k),
+            )
+            s2 = jnp.where(mask, s2, NEG_INF)
+            lse2v = lse2_ref[0, 0][:, None]
+            p = jnp.exp2(s2 - lse2v)
+            p = jnp.where(lse2v > NEG_INF * 0.5, p, 0.0)
+            dp = jax.lax.dot_general(
+                do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            ds = p * (dp - delta_ref[0, 0][:, None]) * sc
+            dq_scr[...] += jax.lax.dot_general(
+                ds.astype(q_ref.dtype), kk, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+        @pl.when(ik == n_kv - 1)
+        def _():
+            dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
+
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(hq, n_q, n_kv),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q), lambda bh, iq, ik: (bh, 0, iq)),
+            pl.BlockSpec((1, 1, block_q), lambda bh, iq, ik: (bh, 0, iq)),
+            pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0)),
+            pl.BlockSpec((1, block_k, d), kv_index),
+            pl.BlockSpec((1, block_k, d), kv_index),
+            pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0)),
+            pl.BlockSpec((1, block_q), lambda bh, iq, ik: (0, iq)),
+            pl.BlockSpec((1, block_k), lambda bh, iq, ik: (0, ik)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0)),
+        out_shape=jax.ShapeDtypeStruct((hq, t, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret_mode_default(),
+    )(lse2, delta, q, k, v, do, seg_q, seg_k)
+
+    n_inner = group * n_q
+
+    def q_row(bh, ik_, jj):
+        return bh * group + jj // n_q, jj % n_q, 0
+
+    def q_scalar(bh, ik_, jj):
+        return bh * group + jj // n_q, 0, jj % n_q
+
+    def qseg_row(bh, ik_, jj):
+        return 0, jj % n_q
+
+    def dkv_kernel(lse2_ref, delta_ref, q_ref, k_ref, v_ref, do_ref,
+                   qseg_ref, kseg_ref, dk_ref, dv_ref, dk_scr, dv_scr):
+        ik = pl.program_id(1)
+        jj = pl.program_id(2)
+        iq = jax.lax.rem(jj, n_q)
+
+        @pl.when(jj == 0)
+        def _():
+            dk_scr[...] = jnp.zeros_like(dk_scr)
+            dv_scr[...] = jnp.zeros_like(dv_scr)
+
+        @pl.when(ik * block_k <= iq * block_q + block_q - 1)
+        def _():
+            qq = q_ref[0]
+            kk = k_ref[0]
+            s2 = jax.lax.dot_general(
+                qq, kk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * (sc * LOG2E)
+            q_ids = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_ids = ik * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            mask = jnp.logical_and(
+                q_ids >= k_ids,
+                qseg_ref[0].reshape(block_q, 1) == kseg_ref[0].reshape(1, block_k),
+            )
+            s2 = jnp.where(mask, s2, NEG_INF)
+            lse2v = lse2_ref[0, 0][:, None]
+            p = jnp.exp2(s2 - lse2v)
+            p = jnp.where(lse2v > NEG_INF * 0.5, p, 0.0)
+            dv_scr[...] += jax.lax.dot_general(
+                p.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            dp = jax.lax.dot_general(
+                do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            ds = p * (dp - delta_ref[0, 0][:, None]) * sc
+            dk_scr[...] += jax.lax.dot_general(
+                ds.astype(q_ref.dtype), qq, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+        @pl.when(jj == n_inner - 1)
+        def _():
+            dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+            dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(hkv, n_kv, n_inner),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q), q_scalar),
+            pl.BlockSpec((1, 1, block_q), q_scalar),
+            pl.BlockSpec((1, block_q, d), q_row),
+            pl.BlockSpec((1, block_k, d), lambda bh, ik_, jj: (bh, ik_, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, ik_, jj: (bh, ik_, 0)),
+            pl.BlockSpec((1, block_q, d), q_row),
+            pl.BlockSpec((1, block_q), qseg_row),
+            pl.BlockSpec((1, block_k), lambda bh, ik_, jj: (0, ik_)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, block_k, d), lambda bh, ik_, jj: (bh, ik_, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, ik_, jj: (bh, ik_, 0)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((hkv, t, d), k.dtype),
+            jax.ShapeDtypeStruct((hkv, t, d), v.dtype),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret_mode_default(),
+    )(lse2, delta, q, k, v, do, seg_q, seg_k)
+    return dq, dk, dv

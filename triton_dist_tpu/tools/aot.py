@@ -232,17 +232,32 @@ class AotSpace:
             **kw):
         """Dispatch + execute through the C++ runtime on THESE input values.
         The selected artifact is COPIED to a per-run directory first — the
-        exported artifact stays pristine (its expected_*.bin self-validation
-        pairs with its export-time inputs) and concurrent dispatches can't
-        interleave input writes. Returns (CompletedProcess, run_dir)."""
+        exported artifact stays pristine and concurrent dispatches can't
+        interleave input writes. The copy drops the export-time
+        expected_*.bin (they pair with the export-time inputs, not these —
+        ``compare_outputs`` on a run dir would be comparing against the
+        wrong baseline). ``workdir`` must not already exist and must not
+        lie inside the space root (nothing is ever deleted here). Returns
+        (CompletedProcess, run_dir)."""
         import shutil
         import tempfile
 
-        art = pathlib.Path(self.select(args, algo))
-        run_dir = pathlib.Path(workdir or tempfile.mkdtemp(prefix="aot_run_"))
-        if run_dir.exists() and run_dir != art:
-            shutil.rmtree(run_dir, ignore_errors=True)
-        shutil.copytree(art, run_dir)
+        art = pathlib.Path(self.select(args, algo)).resolve()
+        if workdir is None:
+            run_dir = pathlib.Path(tempfile.mkdtemp(prefix="aot_run_")) / "art"
+        else:
+            run_dir = pathlib.Path(workdir)
+            if run_dir.exists():
+                raise ValueError(f"workdir {run_dir} already exists")
+            if self.root.resolve() in run_dir.resolve().parents:
+                raise ValueError(
+                    f"workdir {run_dir} lies inside the exported space "
+                    f"{self.root} — refusing to write there"
+                )
+        shutil.copytree(
+            art, run_dir,
+            ignore=shutil.ignore_patterns("expected_*.bin", "outputs_manifest.txt"),
+        )
         for i, a in enumerate(args):
             a = np.asarray(a)
             (run_dir / f"input_{i}.bin").write_bytes(

@@ -56,6 +56,11 @@ def test_moe_model_runs(dense_model):
     out_s = np.asarray(eng_s.serve(ids, gen_len=4))
     np.testing.assert_array_equal(out_d, out_x)
     np.testing.assert_array_equal(out_s, out_x)
+    # MoE through the mega backend: the graph lowers the MLP block via the
+    # 'moe' task (TP_MoE), attention front stays fused.
+    eng_m = Engine(model, backend="mega", max_len=16)
+    out_m = np.asarray(eng_m.serve(ids, gen_len=4))
+    np.testing.assert_array_equal(out_m, out_x)
 
 
 def test_engine_sampling(dense_model):

@@ -350,8 +350,8 @@ def _flash_varlen_kernel(
         k = k_ref[0]
         # exp2-domain softmax, same retune as `_flash_kernel`: fold log2(e)
         # into the scale once so both exponentials are native VPU exp2 ops
-        # (m/l scratch hold base-2 logs; varlen publishes no LSE, so nothing
-        # converts back).
+        # (m/l scratch hold base-2 logs; the optional LSE output converts
+        # to nats at the final step, matching the dense kernel).
         LOG2E = 1.4426950408889634
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -403,7 +403,7 @@ def flash_attention_varlen(
     block_q: int = 1024,
     block_k: int = 1024,
     return_lse: bool = False,
-) -> jax.Array:
+):
     """Varlen (cu_seqlens) causal flash attention over packed sequences —
     the reference's ``sp_ag_attention_intra_node.py`` varlen path. Tokens
     attend causally within their own segment only; rows in padding segments

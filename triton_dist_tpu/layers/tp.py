@@ -203,6 +203,11 @@ class TP_Attn:
         return out, (k_cache, v_cache)
 
 
+#: Shared decode capacity factor for TP MoE callers (models/dense.py and
+#: megakernel/builder.py must route tokens identically or backends diverge).
+DECODE_MOE_CAPACITY_FACTOR = 2.0
+
+
 @_pytree_dataclass
 class TP_MoE:
     """Tensor-parallel MoE: experts replicated across ranks, the ff dim of

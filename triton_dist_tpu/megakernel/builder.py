@@ -38,10 +38,12 @@ class ModelBuilder:
     call ``build_layer_fn()`` — it lowers whatever the graph holds.
     """
 
-    def __init__(self, config, axis: str = "tp", world: int = 1):
+    def __init__(self, config, axis: str = "tp", world: int = 1,
+                 mesh_axes=None):
         self.config = config
         self.axis = axis
         self.world = world
+        self.mesh_axes = mesh_axes
         self.graph = TaskGraph()
         self.plan: list[str] = []
 
@@ -69,6 +71,20 @@ class ModelBuilder:
         g.add(Task("mlp_ar", "allreduce", ("v:mlp_partial",), ("v:mlp_out",)))
         g.add(Task("resid2", "add", ("v:x1", "v:mlp_out"), ("v:x2",)))
 
+    def make_moe_block(self):
+        """MoE variant of the MLP block: routed grouped-expert MLP + AR in
+        one task (``TP_MoE`` lowers it — the reference's MoE stays outside
+        its megakernel too, ``model_builder.py`` dense-only)."""
+        g = self.graph
+        g.add(Task("ln2", "rmsnorm", ("v:x1", "param:ln2"), ("v:xn2",)))
+        g.add(Task(
+            "moe", "moe",
+            ("v:xn2", "param:router", "param:mlp_gate", "param:mlp_up",
+             "param:mlp_down"),
+            ("v:mlp_out",),
+        ))
+        g.add(Task("resid2", "add", ("v:x1", "v:mlp_out"), ("v:x2",)))
+
     # --------------------------------------------------------------- codegen
     def build_layer_fn(self):
         """Schedule the recorded graph (recording the standard layer if the
@@ -81,7 +97,10 @@ class ModelBuilder:
         if not self.graph.tasks:
             self.make_attn_front()
             self.make_attn_back()
-            self.make_mlp_block()
+            if getattr(self.config, "is_moe", False):
+                self.make_moe_block()
+            else:
+                self.make_mlp_block()
         groups = self.graph.schedule()
 
         c = self.config
@@ -278,5 +297,23 @@ class ModelBuilder:
                     x.astype(jnp.float32), axis=axis, method=AllReduceMethod.AUTO
                 ).astype(env["input:x"].dtype)
             return standalone_allreduce
+
+        if op == "moe":
+            from triton_dist_tpu.layers.tp import DECODE_MOE_CAPACITY_FACTOR, TP_MoE
+
+            mesh_axes = self.mesh_axes
+
+            def standalone_moe(env, lp, t=task):
+                moe = TP_MoE(
+                    w_router=lp[param(t.inputs[1])],
+                    w_gate=lp[param(t.inputs[2])],
+                    w_up=lp[param(t.inputs[3])],
+                    w_down=lp[param(t.inputs[4])],
+                    top_k=c.top_k,
+                    capacity_factor=DECODE_MOE_CAPACITY_FACTOR, axis=axis,
+                    mesh_axes=mesh_axes,
+                )
+                env[t.outputs[0]] = moe(env[t.inputs[0]], mode="dist_ar")
+            return standalone_moe
 
         raise NotImplementedError(f"no lowering for task op {op!r}")

@@ -25,7 +25,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from triton_dist_tpu.models.config import ModelConfig
-from triton_dist_tpu.layers.tp import TP_Attn, TP_MLP, TP_MoE, RMSNorm, _pytree_dataclass, static_field
+from triton_dist_tpu.layers.tp import DECODE_MOE_CAPACITY_FACTOR, TP_Attn, TP_MLP, TP_MoE, RMSNorm, _pytree_dataclass, static_field
 from triton_dist_tpu.runtime.mesh import DistContext
 
 
@@ -155,7 +155,8 @@ class DenseLLM:
         if c.is_moe:
             return TP_MoE(
                 w_router=lp["router"], w_gate=lp["mlp_gate"], w_up=lp["mlp_up"],
-                w_down=lp["mlp_down"], top_k=c.top_k, capacity_factor=2.0, axis=self.axis,
+                w_down=lp["mlp_down"], top_k=c.top_k,
+                capacity_factor=DECODE_MOE_CAPACITY_FACTOR, axis=self.axis,
                 mesh_axes=self.ctx.axis_names,
             )
         return TP_MLP(
@@ -230,12 +231,15 @@ class DenseLLM:
     def decode_shard_mega(self, p: DenseParams, mega_layers: list, token, ks, vs, lengths):
         """Megakernel decode: each block is one fused Pallas kernel
         (megakernel/builder.py), layers python-unrolled over the pre-split
-        ``mega_layers`` param dicts. MoE MLPs aren't in the fused set yet."""
+        ``mega_layers`` param dicts. MoE models lower their MLP through the
+        graph's ``moe`` task (TP_MoE — routed grouped experts, like the
+        reference's MoE staying outside its megakernel)."""
         c = self.config
-        assert not c.is_moe, "mega decode supports dense MLP models"
         from triton_dist_tpu.megakernel.builder import ModelBuilder
 
-        mega_layer = ModelBuilder(c, axis=self.axis, world=self.world).build_layer_fn()
+        mega_layer = ModelBuilder(
+            c, axis=self.axis, world=self.world, mesh_axes=self.ctx.axis_names
+        ).build_layer_fn()
         x = p.embed[token]
         for i, lp in enumerate(mega_layers):
             x, ks, vs = mega_layer(lp, x, ks, vs, i, lengths)

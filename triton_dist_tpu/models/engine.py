@@ -104,7 +104,6 @@ class Engine:
         )
 
         if backend == "mega":
-            assert not model.config.is_moe, "mega backend supports dense MLP models"
             # Pre-split per-layer params (see DenseLLM.split_layer_params:
             # Pallas operands must be whole buffers, not loop-sliced views).
             # NOTE: this keeps a second copy of the layer weights resident
@@ -121,6 +120,8 @@ class Engine:
                 "k_norm": s.k_norm, "ln2": s.ln2, "mlp_gate": s.mlp_gate,
                 "mlp_up": s.mlp_up, "mlp_down": s.mlp_down,
             }
+            if model.config.is_moe:
+                stacked["router"] = s.router
             lspec = {k: P(*v[1:]) if len(v) > 1 else P() for k, v in stacked.items()}
             mega_specs = [dict(lspec) for _ in self._mega_layers]
 

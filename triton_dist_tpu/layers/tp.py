@@ -203,9 +203,13 @@ class TP_Attn:
         return out, (k_cache, v_cache)
 
 
-#: Shared decode capacity factor for TP MoE callers (models/dense.py and
-#: megakernel/builder.py must route tokens identically or backends diverge).
-DECODE_MOE_CAPACITY_FACTOR = 2.0
+#: Shared TP-MoE routing capacity factor — governs BOTH prefill and decode
+#: (DenseLLM._mlp serves both) and the mega backend's moe task: every caller
+#: must route tokens identically or backends diverge on dropped tokens.
+MOE_CAPACITY_FACTOR = 2.0
+
+#: Backwards-compatible alias (pre-r3 name).
+DECODE_MOE_CAPACITY_FACTOR = MOE_CAPACITY_FACTOR
 
 
 @_pytree_dataclass

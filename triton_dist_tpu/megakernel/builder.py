@@ -299,7 +299,7 @@ class ModelBuilder:
             return standalone_allreduce
 
         if op == "moe":
-            from triton_dist_tpu.layers.tp import DECODE_MOE_CAPACITY_FACTOR, TP_MoE
+            from triton_dist_tpu.layers.tp import MOE_CAPACITY_FACTOR, TP_MoE
 
             mesh_axes = self.mesh_axes
 
@@ -310,7 +310,7 @@ class ModelBuilder:
                     w_up=lp[param(t.inputs[3])],
                     w_down=lp[param(t.inputs[4])],
                     top_k=c.top_k,
-                    capacity_factor=DECODE_MOE_CAPACITY_FACTOR, axis=axis,
+                    capacity_factor=MOE_CAPACITY_FACTOR, axis=axis,
                     mesh_axes=mesh_axes,
                 )
                 env[t.outputs[0]] = moe(env[t.inputs[0]], mode="dist_ar")

@@ -25,7 +25,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from triton_dist_tpu.models.config import ModelConfig
-from triton_dist_tpu.layers.tp import DECODE_MOE_CAPACITY_FACTOR, TP_Attn, TP_MLP, TP_MoE, RMSNorm, _pytree_dataclass, static_field
+from triton_dist_tpu.layers.tp import MOE_CAPACITY_FACTOR, TP_Attn, TP_MLP, TP_MoE, RMSNorm, _pytree_dataclass, static_field
 from triton_dist_tpu.runtime.mesh import DistContext
 
 
@@ -156,7 +156,7 @@ class DenseLLM:
             return TP_MoE(
                 w_router=lp["router"], w_gate=lp["mlp_gate"], w_up=lp["mlp_up"],
                 w_down=lp["mlp_down"], top_k=c.top_k,
-                capacity_factor=DECODE_MOE_CAPACITY_FACTOR, axis=self.axis,
+                capacity_factor=MOE_CAPACITY_FACTOR, axis=self.axis,
                 mesh_axes=self.ctx.axis_names,
             )
         return TP_MLP(

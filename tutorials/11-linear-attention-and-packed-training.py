@@ -1,0 +1,79 @@
+"""Tutorial 11 — chunked linear attention (GDN) and packed-sequence training.
+
+Two round-3 capabilities beyond the reference's inference-only scope:
+
+1. **Chunked Gated DeltaNet** (`kernels/gdn.py`, reference ``gdn.py``'s
+   chunked tensor-core forward): the per-token recurrence
+   ``S_t = α_t S_{t-1} + β_t k_tᵀ(v_t − k_t S_{t-1})`` batched onto the MXU
+   via the WY/UT transform — 17× the sequential scan at T=4k on-chip —
+   with warm-state resume (streaming decode) and a backward.
+2. **Varlen flash attention with a training backward**
+   (`flash_attention_varlen_fn`): packed sequences (cu_seqlens), segment-
+   masked Pallas fwd+bwd — the packed-SFT training path.
+"""
+
+
+def main(ctx):
+    import jax
+    import jax.numpy as jnp, numpy as np  # noqa: E401
+
+    # ----------------------------------------------------- 1. chunked GDN
+    from triton_dist_tpu.kernels import gdn_fwd
+    from triton_dist_tpu.kernels.gdn import gdn_reference
+
+    h, t, dk, dv = 2, 128, 32, 32
+    ks = jax.random.split(jax.random.PRNGKey(0), 5)
+    q = jax.random.normal(ks[0], (h, t, dk), jnp.float32) * 0.3
+    k = jax.random.normal(ks[1], (h, t, dk), jnp.float32)
+    k = k / jnp.linalg.norm(k, axis=-1, keepdims=True)  # GDN: unit keys
+    v = jax.random.normal(ks[2], (h, t, dv), jnp.float32) * 0.3
+    alpha = 0.9 + 0.1 * jax.random.uniform(ks[3], (h, t))  # decay gate
+    beta = 0.9 * jax.random.uniform(ks[4], (h, t))  # write strength
+
+    o, S = jax.jit(gdn_fwd)(q, k, v, alpha, beta)
+    ref_o, ref_S = gdn_reference(q, k, v, alpha, beta)
+    np.testing.assert_allclose(np.asarray(o), ref_o, rtol=1e-4, atol=1e-4)
+    print(f"[gdn] chunked forward matches the recurrence oracle: o {o.shape}")
+
+    # Warm-state streaming: continue token-by-token from the saved state.
+    o1, s_mid = gdn_fwd(q[:, :96], k[:, :96], v[:, :96],
+                        alpha[:, :96], beta[:, :96])
+    for i in range(96, t):
+        oi, s_mid = gdn_fwd(q[:, i:i+1], k[:, i:i+1], v[:, i:i+1],
+                            alpha[:, i:i+1], beta[:, i:i+1], state=s_mid)
+    np.testing.assert_allclose(np.asarray(s_mid), ref_S, rtol=1e-4, atol=1e-4)
+    print("[gdn] warm-state streaming reaches the same final state")
+
+    # Differentiable: train through the chunked kernel.
+    g = jax.grad(lambda q_: jnp.sum(gdn_fwd(q_, k, v, alpha, beta)[0] ** 2))(q)
+    print(f"[gdn] grad through the chunked path: |dq| max "
+          f"{float(jnp.abs(g).max()):.4f}")
+
+    # --------------------------------- 2. packed-sequence (varlen) training
+    from triton_dist_tpu.function import flash_attention_varlen_fn
+
+    hq, hkv, T, d = 4, 2, 96, 32
+    cu = jnp.asarray([0, 30, 64, 96], jnp.int32)  # three packed sequences
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(1), 3)
+    q2 = jax.random.normal(kq, (hq, T, d), jnp.float32) * 0.4
+    k2 = jax.random.normal(kk, (hkv, T, d), jnp.float32) * 0.4
+    v2 = jax.random.normal(kv, (hkv, T, d), jnp.float32) * 0.4
+
+    def loss(q_, k_, v_):
+        # Tokens attend causally within their own segment only.
+        o_ = flash_attention_varlen_fn(q_, k_, v_, cu)
+        return jnp.sum(o_.astype(jnp.float32) ** 2)
+
+    val, grads = jax.value_and_grad(loss, argnums=(0, 1, 2))(q2, k2, v2)
+    assert all(np.isfinite(np.asarray(g_)).all() for g_ in grads)
+    print(f"[varlen] packed-SFT loss {float(val):.3f}; segment-masked Pallas "
+          f"bwd grads: dq {grads[0].shape}, dk {grads[1].shape}, "
+          f"dv {grads[2].shape}")
+    print("tutorial 11 OK")
+
+
+if __name__ == "__main__":
+    from tutorial_util import setup
+
+    ctx, *_ = setup()
+    main(ctx)

@@ -50,7 +50,10 @@ def compile_sharded(mesh, fn, arg_shapes, in_specs, out_specs):
         jax.shard_map(
             fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
         ),
-        in_shardings=tuple(NamedSharding(mesh, s) for s in in_specs),
+        in_shardings=jax.tree.map(
+            lambda s: NamedSharding(mesh, s), tuple(in_specs),
+            is_leaf=lambda x: isinstance(x, P),
+        ),
     )
     compiled = f.lower(*arg_shapes).compile()
     assert compiled is not None
@@ -154,6 +157,58 @@ def test_lowering_ep_fused_combine(tpu_mesh):
             (P("tp"), P("tp"), P("tp"), P("tp")),
             P("tp"),
         )
+
+
+def test_lowering_mega_decode_layer(tpu_mesh):
+    """A full megakernel decode layer (fused LN+QKV+RoPE, cache update,
+    flash decode, o-proj AR, fused MLP block, one-shot AR) compiles for the
+    8-chip topology at TP8 Qwen3-8B-width shapes — the whole mega backend's
+    per-layer program through Mosaic."""
+    from triton_dist_tpu.megakernel.builder import ModelBuilder
+    from triton_dist_tpu.models import ModelConfig
+
+    cfg = ModelConfig(
+        vocab_size=32768, hidden_size=4096, intermediate_size=12288,
+        num_layers=1, num_q_heads=32, num_kv_heads=8, head_dim=128,
+        dtype="bfloat16",
+    )
+    layer_fn = ModelBuilder(
+        cfg, axis="tp", world=WORLD, mesh_axes=("tp",)
+    ).build_layer_fn()
+    bsz, S = 8, 512
+    hkv_l = cfg.num_kv_heads // WORLD
+    d = cfg.hidden_size
+    # GLOBAL shapes; the tp shardings below hand each rank its shard.
+    lp = {
+        "ln1": jax.ShapeDtypeStruct((d,), jnp.bfloat16),
+        "wqkv": jax.ShapeDtypeStruct(
+            (d, (cfg.num_q_heads + 2 * cfg.num_kv_heads) * cfg.head_dim),
+            jnp.bfloat16),
+        "q_norm": jax.ShapeDtypeStruct((cfg.head_dim,), jnp.bfloat16),
+        "k_norm": jax.ShapeDtypeStruct((cfg.head_dim,), jnp.bfloat16),
+        "wo": jax.ShapeDtypeStruct(
+            (cfg.num_q_heads * cfg.head_dim, d), jnp.bfloat16),
+        "ln2": jax.ShapeDtypeStruct((d,), jnp.bfloat16),
+        "mlp_gate": jax.ShapeDtypeStruct(
+            (d, cfg.intermediate_size), jnp.bfloat16),
+        "mlp_up": jax.ShapeDtypeStruct(
+            (d, cfg.intermediate_size), jnp.bfloat16),
+        "mlp_down": jax.ShapeDtypeStruct(
+            (cfg.intermediate_size, d), jnp.bfloat16),
+    }
+    x = jax.ShapeDtypeStruct((bsz, d), jnp.bfloat16)
+    ks = jax.ShapeDtypeStruct((1, bsz, WORLD * hkv_l, S, cfg.head_dim), jnp.bfloat16)
+    lengths = jax.ShapeDtypeStruct((bsz,), jnp.int32)
+
+    compile_sharded(
+        tpu_mesh,
+        lambda lp_, x_, ks_, vs_, len_: layer_fn(lp_, x_, ks_, vs_, 0, len_)[0],
+        (lp, x, ks, ks, lengths),
+        ({k: (P(None, "tp") if k in ("wqkv", "mlp_gate", "mlp_up")
+              else P("tp", None) if k in ("wo", "mlp_down") else P())
+          for k in lp}, P(), P(None, None, "tp"), P(None, None, "tp"), P()),
+        P(),
+    )
 
 
 def test_lowering_ring_attention(tpu_mesh):

@@ -142,3 +142,63 @@ def test_race_detection_ep_fused_combine(ctx4, rng):
                     out[me, p, e * cap:(e + 1) * cap], ref,
                     rtol=2e-4, atol=2e-4, err_msg=f"me={me} p={p} e={e}",
                 )
+
+
+def test_race_detection_2d_hierarchy(rng):
+    """The DCN-aware 2D AG-GEMM / GEMM-RS compositions pass the race
+    detector on a (2,4) mesh — multi-axis logical-device addressing is
+    exactly where a wrong ring neighbor shows up as a race or lost put."""
+    from triton_dist_tpu.kernels import (
+        AGGemmMethod, GemmRSMethod, ag_gemm_2d_shard, gemm_rs_2d_shard,
+    )
+    from triton_dist_tpu.runtime.mesh import initialize_distributed
+    from triton_dist_tpu.runtime.platform import cpu_mesh
+
+    m24 = cpu_mesh((2, 4), ("dp", "tp"))
+    ctx = initialize_distributed(
+        axis_names=("dp", "tp"), axis_sizes=(2, 4),
+        devices=list(m24.devices.flat), set_default=False,
+    )
+    wo, wi = 2, 4
+    world = wo * wi
+    a = jnp.asarray(rng.standard_normal((world * 4, 32)), jnp.float32)
+    b = jnp.asarray(rng.standard_normal((32, world * 8)), jnp.float32)
+
+    with race_detection(True):
+        f = jax.jit(
+            jax.shard_map(
+                lambda a_, b_: ag_gemm_2d_shard(
+                    a_, b_, axes=("dp", "tp"), method=AGGemmMethod.PALLAS_FUSED
+                ),
+                mesh=ctx.mesh,
+                in_specs=(P(("dp", "tp")), P(None, ("dp", "tp"))),
+                out_specs=P(None, ("dp", "tp")), check_vma=False,
+            )
+        )
+        np.testing.assert_allclose(
+            np.asarray(f(a, b)), np.asarray(a) @ np.asarray(b),
+            rtol=1e-4, atol=1e-4,
+        )
+
+        a2 = jnp.asarray(rng.standard_normal((world * 2, world * 8)), jnp.float32)
+        b2 = jnp.asarray(rng.standard_normal((world * 8, 16)), jnp.float32)
+        g = jax.jit(
+            jax.shard_map(
+                lambda a_, b_: gemm_rs_2d_shard(
+                    a_, b_, axes=("dp", "tp"), method=GemmRSMethod.PALLAS_FUSED
+                )[None],
+                mesh=ctx.mesh,
+                in_specs=(P(None, ("dp", "tp")), P(("dp", "tp"))),
+                out_specs=P(("dp", "tp")), check_vma=False,
+            )
+        )
+        out = np.asarray(g(a2, b2))
+        expect = np.asarray(a2) @ np.asarray(b2)
+        rows = a2.shape[0] // world
+        for d_ in range(wo):
+            for i in range(wi):
+                rank, blk = d_ * wi + i, i * wo + d_
+                np.testing.assert_allclose(
+                    out[rank], expect[blk * rows:(blk + 1) * rows],
+                    rtol=1e-4, atol=1e-4,
+                )

@@ -106,3 +106,13 @@ def ctx2():
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)
+
+
+@pytest.fixture(scope="session")
+def ctx24():
+    """(2, 4) dp x tp mesh — the DCN-aware 2D hierarchy's test substrate."""
+    m = cpu_mesh((2, 4), ("dp", "tp"))
+    return initialize_distributed(
+        axis_names=("dp", "tp"), axis_sizes=(2, 4),
+        devices=list(m.devices.flat), set_default=False,
+    )

@@ -177,18 +177,6 @@ def test_ag_gemm_bf16_pallas(ctx8, rng):
 # ------------------------------------------------- DCN-aware 2D hierarchy
 
 
-@pytest.fixture(scope="module")
-def ctx24():
-    from triton_dist_tpu.runtime.mesh import initialize_distributed
-    from triton_dist_tpu.runtime.platform import cpu_mesh
-
-    m = cpu_mesh((2, 4), ("dp", "tp"))
-    return initialize_distributed(
-        axis_names=("dp", "tp"), axis_sizes=(2, 4),
-        devices=list(m.devices.flat), set_default=False,
-    )
-
-
 def test_ag_gemm_2d_shard(ctx24, rng):
     """Hierarchical AG-GEMM on a (2,4) mesh: DCN XLA gather + fused ICI
     ring (reference inter-node AG-GEMM, allgather.py:387-489). Output rows

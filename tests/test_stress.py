@@ -144,21 +144,15 @@ def test_race_detection_ep_fused_combine(ctx4, rng):
                 )
 
 
-def test_race_detection_2d_hierarchy(rng):
+def test_race_detection_2d_hierarchy(ctx24, rng):
     """The DCN-aware 2D AG-GEMM / GEMM-RS compositions pass the race
     detector on a (2,4) mesh — multi-axis logical-device addressing is
     exactly where a wrong ring neighbor shows up as a race or lost put."""
     from triton_dist_tpu.kernels import (
         AGGemmMethod, GemmRSMethod, ag_gemm_2d_shard, gemm_rs_2d_shard,
     )
-    from triton_dist_tpu.runtime.mesh import initialize_distributed
-    from triton_dist_tpu.runtime.platform import cpu_mesh
 
-    m24 = cpu_mesh((2, 4), ("dp", "tp"))
-    ctx = initialize_distributed(
-        axis_names=("dp", "tp"), axis_sizes=(2, 4),
-        devices=list(m24.devices.flat), set_default=False,
-    )
+    ctx = ctx24
     wo, wi = 2, 4
     world = wo * wi
     a = jnp.asarray(rng.standard_normal((world * 4, 32)), jnp.float32)

@@ -9,6 +9,7 @@ from triton_dist_tpu.models.kv_cache import KVCache
 from triton_dist_tpu.models.dense import DenseLLM, Qwen3MoE, DenseParams, init_params
 from triton_dist_tpu.models.engine import Engine
 from triton_dist_tpu.models.weights import AutoLLM, load_hf_weights
+from triton_dist_tpu.models import checkpoint
 
 __all__ = [
     "ModelConfig",
@@ -20,5 +21,6 @@ __all__ = [
     "init_params",
     "Engine",
     "AutoLLM",
+    "checkpoint",
     "load_hf_weights",
 ]

@@ -1,0 +1,56 @@
+"""Checkpoint save/restore for model parameters (orbax-backed).
+
+Scope note: the reference has NO checkpointing (SURVEY §5 — inference-
+oriented, weights only ever load from HF). This module goes beyond it so the
+training side (``function/`` autograd + optimizer states as plain pytrees)
+has a durable save/resume path; sharded arrays restore with their shardings
+via orbax's native SPMD support.
+
+API: ``save(path, params)`` / ``restore(path, like=params_or_absspec)`` —
+``like`` supplies the target structure and (when its leaves are sharded
+jax.Arrays or ShapeDtypeStructs with shardings) the placement to restore
+onto, so a checkpoint written on one mesh restores onto another.
+"""
+
+from __future__ import annotations
+
+import pathlib
+
+import jax
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.StandardCheckpointer()
+
+
+def save(path: str, params) -> str:
+    """Write a parameter pytree (any mix of replicated/sharded jax.Arrays)
+    to ``path`` (created; must not already hold a checkpoint)."""
+    p = pathlib.Path(path).resolve()
+    ckptr = _checkpointer()
+    ckptr.save(p, params)
+    ckptr.wait_until_finished()
+    return str(p)
+
+
+def restore(path: str, like):
+    """Read a checkpoint into the structure/shardings of ``like`` (a pytree
+    of jax.Arrays or ShapeDtypeStructs). Cross-mesh restore: pass ``like``
+    built on the NEW mesh and orbax reshards on load."""
+    p = pathlib.Path(path).resolve()
+
+    def as_abstract(a):
+        if a is None or isinstance(a, jax.ShapeDtypeStruct) or not hasattr(a, "shape"):
+            # None leaves (dense models' router) and non-array scalars
+            # (optimizer step counts) pass through — orbax restores them
+            # as saved.
+            return a
+        return jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                    sharding=getattr(a, "sharding", None))
+
+    abstract = jax.tree.map(
+        as_abstract, like, is_leaf=lambda x: x is None or hasattr(x, "shape")
+    )
+    return _checkpointer().restore(p, abstract)

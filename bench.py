@@ -306,12 +306,15 @@ def bench_gdn(on_tpu):
             "gdn_speedup_vs_scan": round(t_scan / t_chunk, 2)}
 
 
-def bench_mega_decode(on_tpu):
+def bench_mega_decode(on_tpu, size: str = "big"):
     """Megakernel decode step vs the XLA backend (reference megakernel.md's
     headline table) — 8-layer Qwen3-8B-width model, single chip, the serving
     regime bsz=8 ctx=4096 where fusion beats the compiler decisively
     (measured 1.57×; full regime table in docs/megakernel.md — at bsz=1
-    ctx=512 both backends sit at the HBM-bandwidth ceiling and tie)."""
+    ctx=512 both backends sit at the HBM-bandwidth ceiling and tie).
+
+    ``size="small"`` is the degraded-tunnel fallback (4 layers, ctx 2048):
+    a slow remote-compile day must yield SOME mega metric, not a skip."""
     from triton_dist_tpu.models import DenseLLM, ModelConfig
     from triton_dist_tpu.models.engine import bench_decode_table
     from triton_dist_tpu.runtime.mesh import initialize_distributed
@@ -321,9 +324,10 @@ def bench_mega_decode(on_tpu):
     ctx = initialize_distributed(
         axis_names=("tp",), devices=jax.devices()[:1], set_default=False
     )
+    layers, ctx_len, iters = (8, 4096, 128) if size == "big" else (4, 2048, 96)
     cfg = ModelConfig(
         vocab_size=32768, hidden_size=4096, intermediate_size=12288,
-        num_layers=8, num_q_heads=32, num_kv_heads=8, head_dim=128,
+        num_layers=layers, num_q_heads=32, num_kv_heads=8, head_dim=128,
         dtype="bfloat16",
     )
     model = DenseLLM(cfg, ctx, key=jax.random.PRNGKey(0))
@@ -332,12 +336,12 @@ def bench_mega_decode(on_tpu):
     # the tunnel's wall-clock jitter (±20 ms observed) or the subtraction
     # goes negative / sub-HBM-floor. max_len bounds the KV cache.
     t = bench_decode_table(
-        model, backends=("xla", "mega"), bsz=8, prompt_len=64, iters=128,
-        max_len=4096,
+        model, backends=("xla", "mega"), bsz=8, prompt_len=64, iters=iters,
+        max_len=ctx_len,
     )
     import math
 
-    out = {}
+    out = {"mega_decode_config": f"L{layers} bsz8 ctx{ctx_len}"}
     if math.isfinite(t["mega"]):
         out["mega_decode_ms"] = round(t["mega"] * 1e3, 4)
     if math.isfinite(t["xla"]) and math.isfinite(t["mega"]) and t["mega"] > 0:
@@ -387,20 +391,26 @@ def main():
     import subprocess
     import sys
 
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c",
-             "import json, jax, bench; on_tpu = jax.devices()[0].platform != 'cpu';"
-             "out = bench.bench_mega_decode(on_tpu) if on_tpu else {'mega_decode_skipped': 'cpu'};"
-             "print(json.dumps(out))"],
-            capture_output=True, text=True, timeout=max(budget_s * 0.45, 60),
-            cwd=os.path.dirname(os.path.abspath(__file__)),
-            env={**os.environ, "PYTHONPATH": os.path.dirname(os.path.abspath(__file__))
-                 + os.pathsep + os.environ.get("PYTHONPATH", "")},
-        )
-        if r.returncode == 0 and r.stdout.strip():
-            extra.update(json.loads(r.stdout.strip().splitlines()[-1]))
-        else:
+    def _mega_attempt(size: str, timeout_s: float) -> bool:
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c",
+                 "import json, jax, bench; on_tpu = jax.devices()[0].platform != 'cpu';"
+                 f"out = bench.bench_mega_decode(on_tpu, size={size!r}) if on_tpu"
+                 " else {'mega_decode_skipped': 'cpu'};"
+                 "print(json.dumps(out))"],
+                capture_output=True, text=True, timeout=max(timeout_s, 60),
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+                env={**os.environ, "PYTHONPATH": os.path.dirname(os.path.abspath(__file__))
+                     + os.pathsep + os.environ.get("PYTHONPATH", "")},
+            )
+            if r.returncode == 0 and r.stdout.strip():
+                # A successful (fallback) run supersedes any earlier
+                # attempt's failure keys — the report must not claim both.
+                extra.pop("mega_decode_skipped", None)
+                extra.pop("mega_decode_error", None)
+                extra.update(json.loads(r.stdout.strip().splitlines()[-1]))
+                return True
             # The actionable line is the exception, not JAX's frame-filter
             # preamble: pick the last line naming an Error/Exception.
             lines = (r.stderr or "").strip().splitlines()
@@ -409,10 +419,21 @@ def main():
                 lines[-1] if lines else "",
             )
             extra["mega_decode_error"] = f"rc={r.returncode}: {err.strip()[:160]}"
-    except subprocess.TimeoutExpired:
-        extra["mega_decode_skipped"] = "timeout"
-    except Exception as e:  # noqa: BLE001
-        extra["mega_decode_error"] = f"{type(e).__name__}"
+        except subprocess.TimeoutExpired:
+            extra["mega_decode_skipped"] = f"timeout({size})"
+        except Exception as e:  # noqa: BLE001
+            extra["mega_decode_error"] = f"{type(e).__name__}"
+        return False
+
+    # Two-tier: the headline 8-layer ctx-4096 config first; if a degraded
+    # tunnel eats its window, a smaller config still lands a mega metric.
+    # The fallback window is capped by what the watchdog leaves (it fires
+    # at budget*1.5) minus headroom for the primary metric — on tiny
+    # budgets the fallback is skipped rather than starving bench_flash.
+    if not _mega_attempt("big", budget_s * 0.45):
+        fallback_window = min(remaining() * 0.5, budget_s * 1.5 - (budget_s - remaining()) - 120)
+        if fallback_window >= 60:
+            _mega_attempt("small", fallback_window)
 
     on_tpu = jax.devices()[0].platform != "cpu"
     f = bench_flash(on_tpu)

@@ -236,3 +236,43 @@ def test_flash_config_cache(tmp_path, monkeypatch):
     assert flash_config_for(q, k, v, True) == (128, 64)
     # Non-causal key is distinct.
     assert flash_config_for(q, k, v, False) == (1024, 1024)
+
+
+def test_flash_bwd_config_cache(tmp_path, monkeypatch):
+    """flash_attention_bwd consults its own tune-cache key at trace time,
+    falling back to the FORWARD's tuned blocks (bwd and fwd optima track
+    each other), then the default."""
+    import jax
+    import jax.numpy as jnp
+
+    from triton_dist_tpu.kernels.flash_attn import (
+        flash_bwd_config_for,
+        flash_bwd_op_name,
+        flash_op_name,
+    )
+    from triton_dist_tpu.tools import tune
+
+    monkeypatch.setenv("TDT_TUNE_CACHE", str(tmp_path / "cache.json"))
+    q = jax.ShapeDtypeStruct((1, 4, 256, 32), jnp.float32)
+    k = jax.ShapeDtypeStruct((1, 2, 256, 32), jnp.float32)
+    v = jax.ShapeDtypeStruct((1, 2, 256, 32), jnp.float32)
+    # Total miss → default.
+    assert flash_bwd_config_for(q, k, v, True) == (1024, 1024)
+    # Forward-tuned only → bwd inherits the forward's blocks.
+    cache = tune.TuneCache()
+    cache.put(
+        f"{flash_op_name(True)}|{tune.arg_signature([q, k, v])}",
+        {"cfg": {"block_q": 256, "block_k": 128}, "time_s": 1e-3, "version": "x"},
+    )
+    cache.save()
+    tune._default_cache = None
+    assert flash_bwd_config_for(q, k, v, True) == (256, 128)
+    # A dedicated bwd entry (tune_gemm --flash-bwd) takes precedence.
+    cache = tune.TuneCache()
+    cache.put(
+        f"{flash_bwd_op_name(True)}|{tune.arg_signature([q, k, v])}",
+        {"cfg": {"block_q": 64, "block_k": 64}, "time_s": 1e-3, "version": "x"},
+    )
+    cache.save()
+    tune._default_cache = None
+    assert flash_bwd_config_for(q, k, v, True) == (64, 64)

@@ -32,6 +32,8 @@ from triton_dist_tpu.runtime.platform import interpret_mode_default
 
 LANES = 128
 NEG_INF = -1e30
+#: log2(e): folds nat-domain scores into the exp2-domain softmax everywhere.
+LOG2E = 1.4426950408889634
 
 
 # Re-exported for backward compatibility; canonical home is kernels/gemm.py.
@@ -79,7 +81,6 @@ def _flash_kernel(
     # per tile so both exponentials are native VPU exp2 ops with no extra
     # (bq, bk)-sized multiply (m/l scratch then hold base-2 logs; only the
     # final LSE converts back to nats).
-    LOG2E = 1.4426950408889634
 
     def compute(masked):
         q = q_ref[0]  # (bq, d)
@@ -352,7 +353,6 @@ def _flash_varlen_kernel(
         # into the scale once so both exponentials are native VPU exp2 ops
         # (m/l scratch hold base-2 logs; the optional LSE output converts
         # to nats at the final step, matching the dense kernel).
-        LOG2E = 1.4426950408889634
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * (scale * LOG2E)
@@ -388,7 +388,6 @@ def _flash_varlen_kernel(
         if lse_ref is not None:
             # m/l are base-2; publish nats. Padding rows get NEG_INF so the
             # backward's lse guard zeroes their p exactly.
-            LOG2E = 1.4426950408889634
             lse = (m_scr[:, 0] + jnp.log2(jnp.maximum(l_scr[:, 0], 1e-30))) / LOG2E
             lse_ref[0, 0] = jnp.where(empty[:, 0], NEG_INF, lse)
 
@@ -482,6 +481,49 @@ def attention_reference(q, k, v, *, causal=True, scale=None):
 # ------------------------------------------------------------------ backward
 
 
+def _bwd_p_ds(qq, kk, do_tile, v_tile, lse2_col, delta_col, sc, mask=None):
+    """Shared backward tile math (dense AND varlen, dq AND dk/dv kernels):
+    p recomputed exactly from the saved LSE in the exp2 domain, then
+    ds = p∘(dp − δ)·scale. ONE implementation on purpose — this is the
+    precision-sensitive core, and a fix must never need to land four times.
+    Masked positions give exp2(−inf) = 0; rows whose whole step was masked
+    (lse ≈ −inf) are forced to 0 so zero cotangents never meet an inf."""
+    s2 = jax.lax.dot_general(
+        qq, kk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * (sc * LOG2E)
+    if mask is not None:
+        s2 = jnp.where(mask, s2, NEG_INF)
+    p = jnp.exp2(s2 - lse2_col)
+    p = jnp.where(lse2_col > NEG_INF * 0.5, p, 0.0)
+    dp = jax.lax.dot_general(
+        do_tile, v_tile, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    ds = p * (dp - delta_col) * sc
+    return p, ds
+
+
+def _causal_mask(q_off, iq, ik, block_q, block_k):
+    """Dense causal mask in global coordinates (q rows offset by q_off)."""
+    q_ids = q_off + iq * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_ids = ik * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    return q_ids >= k_ids
+
+
+def _varlen_mask(iq, ik, block_q, block_k, qseg_ref, kseg_ref):
+    """Packed-segment mask: causal within the stream AND same segment."""
+    q_ids = iq * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_ids = ik * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    return jnp.logical_and(
+        q_ids >= k_ids,
+        qseg_ref[0].reshape(block_q, 1) == kseg_ref[0].reshape(1, block_k),
+    )
+
+
 def _flash_bwd_dq_kernel(
     offs_ref,  # SMEM (2,) int32 [q_offset, kv_offset] or None (static)
     lse2_ref,  # (1, 1, bq) f32 — saved LSE × log2(e)
@@ -509,37 +551,18 @@ def _flash_bwd_dq_kernel(
     iq = pl.program_id(1)
     ik = pl.program_id(2)
     q_off = offs_ref[0] - offs_ref[1] if offs_ref is not None else kv_len - sq
-    LOG2E = 1.4426950408889634
 
     @pl.when(ik == 0)
     def _():
         dq_scr[...] = jnp.zeros_like(dq_scr)
 
     def compute(masked):
-        qq = q_ref[0]
         kk = k_ref[0]
-        s2 = jax.lax.dot_general(
-            qq, kk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * (scale * LOG2E)
-        if masked:
-            q_ids = q_off + iq * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            k_ids = ik * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s2 = jnp.where(q_ids >= k_ids, s2, NEG_INF)
-        lse2 = lse2_ref[0, 0][:, None]
-        # Exact softmax from the saved LSE; masked positions give exp2(-inf)=0,
-        # and rows whose whole step was masked (lse2 ≈ -inf → exp2(+inf)) are
-        # forced to 0.
-        p = jnp.exp2(s2 - lse2)  # (bq, bk) f32
-        p = jnp.where(lse2 > NEG_INF * 0.5, p, 0.0)
-        dp = jax.lax.dot_general(
-            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
+        mask = _causal_mask(q_off, iq, ik, block_q, block_k) if masked else None
+        _, ds = _bwd_p_ds(
+            q_ref[0], kk, do_ref[0], v_ref[0], lse2_ref[0, 0][:, None],
+            delta_ref[0, 0][:, None], scale, mask,
         )
-        ds = p * (dp - delta_ref[0, 0][:, None]) * scale
         dq_scr[...] += jax.lax.dot_general(
             ds.astype(q_ref.dtype), kk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -603,7 +626,6 @@ def flash_attention_bwd(
     block_k = fit_block(sk, block_k)
     n_q = sq // block_q
     n_kv = sk // block_k
-    LOG2E = 1.4426950408889634
     dynamic = q_offset is not None or kv_offset is not None
 
     lse2 = (lse.astype(jnp.float32) * LOG2E).reshape(b * hq, 1, sq)
@@ -692,31 +714,15 @@ def flash_attention_bwd(
 
         def compute(masked):
             qq = q_ref[0]
-            kk = k_ref[0]
-            s2 = jax.lax.dot_general(
-                qq, kk, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            ) * (sc * LOG2E)
-            if masked:
-                q_ids = q_off + iq * block_q + jax.lax.broadcasted_iota(
-                    jnp.int32, (block_q, block_k), 0
-                )
-                k_ids = ik * block_k + jax.lax.broadcasted_iota(
-                    jnp.int32, (block_q, block_k), 1
-                )
-                s2 = jnp.where(q_ids >= k_ids, s2, NEG_INF)
-            lse2 = lse2_ref[0, 0][:, None]
-            p = jnp.exp2(s2 - lse2)
-            p = jnp.where(lse2 > NEG_INF * 0.5, p, 0.0)
+            mask = _causal_mask(q_off, iq, ik, block_q, block_k) if masked else None
+            p, ds = _bwd_p_ds(
+                qq, k_ref[0], do_ref[0], v_ref[0], lse2_ref[0, 0][:, None],
+                delta_ref[0, 0][:, None], sc, mask,
+            )
             dv_scr[...] += jax.lax.dot_general(
                 p.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
-            dp = jax.lax.dot_general(
-                do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            ds = p * (dp - delta_ref[0, 0][:, None]) * sc
             dk_scr[...] += jax.lax.dot_general(
                 ds.astype(q_ref.dtype), qq, (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
@@ -832,7 +838,6 @@ def flash_attention_varlen_bwd(
     block_k = fit_block(t, block_k)
     n_q = t // block_q
     n_kv = t // block_k
-    LOG2E = 1.4426950408889634
 
     seg_q, seg_k = _varlen_segments(cu_seqlens, t)
     lse2 = (lse.astype(jnp.float32) * LOG2E).reshape(hq, 1, t)
@@ -855,29 +860,12 @@ def flash_attention_varlen_bwd(
         # diagonal of the packed stream.
         @pl.when(ik * block_k <= iq * block_q + block_q - 1)
         def _():
-            qq = q_ref[0]
             kk = k_ref[0]
-            s2 = jax.lax.dot_general(
-                qq, kk, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            ) * (sc * LOG2E)
-            q_ids = iq * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_ids = ik * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            mask = jnp.logical_and(
-                q_ids >= k_ids,
-                qseg_ref[0].reshape(block_q, 1) == kseg_ref[0].reshape(1, block_k),
+            _, ds = _bwd_p_ds(
+                q_ref[0], kk, do_ref[0], v_ref[0], lse2_ref[0, 0][:, None],
+                delta_ref[0, 0][:, None], sc,
+                _varlen_mask(iq, ik, block_q, block_k, qseg_ref, kseg_ref),
             )
-            s2 = jnp.where(mask, s2, NEG_INF)
-            lse2v = lse2_ref[0, 0][:, None]
-            p = jnp.exp2(s2 - lse2v)
-            p = jnp.where(lse2v > NEG_INF * 0.5, p, 0.0)
-            dp = jax.lax.dot_general(
-                do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            ds = p * (dp - delta_ref[0, 0][:, None]) * sc
             dq_scr[...] += jax.lax.dot_general(
                 ds.astype(q_ref.dtype), kk, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
@@ -934,32 +922,15 @@ def flash_attention_varlen_bwd(
         @pl.when(ik * block_k <= iq * block_q + block_q - 1)
         def _():
             qq = q_ref[0]
-            kk = k_ref[0]
-            s2 = jax.lax.dot_general(
-                qq, kk, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            ) * (sc * LOG2E)
-            q_ids = iq * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_ids = ik * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            mask = jnp.logical_and(
-                q_ids >= k_ids,
-                qseg_ref[0].reshape(block_q, 1) == kseg_ref[0].reshape(1, block_k),
+            p, ds = _bwd_p_ds(
+                qq, k_ref[0], do_ref[0], v_ref[0], lse2_ref[0, 0][:, None],
+                delta_ref[0, 0][:, None], sc,
+                _varlen_mask(iq, ik, block_q, block_k, qseg_ref, kseg_ref),
             )
-            s2 = jnp.where(mask, s2, NEG_INF)
-            lse2v = lse2_ref[0, 0][:, None]
-            p = jnp.exp2(s2 - lse2v)
-            p = jnp.where(lse2v > NEG_INF * 0.5, p, 0.0)
             dv_scr[...] += jax.lax.dot_general(
                 p.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
-            dp = jax.lax.dot_general(
-                do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            ds = p * (dp - delta_ref[0, 0][:, None]) * sc
             dk_scr[...] += jax.lax.dot_general(
                 ds.astype(q_ref.dtype), qq, (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,

@@ -100,6 +100,17 @@ def test_builder_graph_summary():
     assert "attn_front" in s and "mlp_block" in s and "flash_decode" in s
 
 
+def test_builder_requires_cache_update():
+    """A hand-recorded graph without attention fails with a clear error,
+    not a bare StopIteration (r3 advisor)."""
+    from triton_dist_tpu.models.config import PRESETS
+
+    mb = ModelBuilder(PRESETS["test-dense"], world=1)
+    mb.make_attn_front()  # no attn_back → no cache_update task
+    with pytest.raises(ValueError, match="cache_update"):
+        mb.build_layer_fn()
+
+
 @pytest.fixture(scope="module")
 def dense_model():
     from triton_dist_tpu.models import DenseLLM, PRESETS

@@ -242,3 +242,38 @@ def test_gemm_rs_2d_shard(ctx24, rng):
                     out[rank], expect[blk * rows : (blk + 1) * rows],
                     rtol=1e-4, atol=1e-4, err_msg=f"rank ({d},{i}) {method}",
                 )
+
+
+def test_gemm_rs_2d_reorder_to_outer_major(ctx24, rng):
+    """reorder_2d_rows_inner_to_outer_major fixes the 2D GEMM-RS layout
+    hazard (r3 advisor): after the permute, assembling under
+    out_specs=P(("dp","tp")) yields exactly A @ B in global row order."""
+    from triton_dist_tpu.kernels import (
+        GemmRSMethod, gemm_rs_2d_shard, reorder_2d_rows_inner_to_outer_major,
+    )
+
+    wo, wi = 2, 4
+    world = wo * wi
+    m, k, n = world * 4, world * 8, 16
+    a = jnp.asarray(rng.standard_normal((m, k)), jnp.float32)
+    b = jnp.asarray(rng.standard_normal((k, n)), jnp.float32)
+
+    f = jax.jit(
+        jax.shard_map(
+            lambda a_s, b_s: reorder_2d_rows_inner_to_outer_major(
+                gemm_rs_2d_shard(
+                    a_s, b_s, axes=("dp", "tp"),
+                    method=GemmRSMethod.XLA_RING,
+                ),
+                axes=("dp", "tp"),
+            ),
+            mesh=ctx24.mesh,
+            in_specs=(P(None, ("dp", "tp")), P(("dp", "tp"))),
+            out_specs=P(("dp", "tp")),
+            check_vma=False,
+        )
+    )
+    np.testing.assert_allclose(
+        np.asarray(f(a, b)), np.asarray(a) @ np.asarray(b),
+        rtol=1e-4, atol=1e-4,
+    )

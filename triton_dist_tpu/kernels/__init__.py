@@ -62,6 +62,7 @@ from triton_dist_tpu.kernels.gemm_reduce_scatter import (
     gemm_rs_2d_shard,
     gemm_rs_shard,
     gemm_rs,
+    reorder_2d_rows_inner_to_outer_major,
 )
 from triton_dist_tpu.kernels.gemm_allreduce import (
     GemmARMethod,
@@ -150,6 +151,7 @@ __all__ = [
     "GemmRSContext",
     "create_gemm_rs_context",
     "gemm_rs_2d_shard",
+    "reorder_2d_rows_inner_to_outer_major",
     "gemm_rs_shard",
     "gemm_rs",
     "GemmARMethod",

@@ -442,7 +442,15 @@ def ag_gemm_2d_shard(
     that same global order (the fused kernel gathers inner-major, so the
     output rows are transposed back — an (ici, dcn) block swap on the
     (m, n_local) output, cheap relative to the GEMM). Inside shard_map
-    over both axes."""
+    over both axes.
+
+    .. warning:: **Layout asymmetry vs ``gemm_rs_2d_shard``.** This
+       function consumes/produces OUTER-major ``P((outer, inner))`` rows
+       (the permutation back is rank-local, so it's free to offer), but
+       ``gemm_rs_2d_shard``'s output row OWNERSHIP is inner-major
+       ``P((inner, outer))`` — chaining the two (e.g. megatron-style
+       AG-GEMM → GEMM-RS) needs the spec flipped or a
+       ``reorder_2d_rows_inner_to_outer_major`` on the RS output."""
     outer, inner = axes
     if mesh_axes is None:
         # Remote-DMA addressing needs every mesh axis to compute logical

@@ -120,13 +120,19 @@ def _chunk_precompute(qc, kc, vc, ac, bc):
     kk = kc @ kc.T  # (C, C)
     qk = qc @ kc.T
 
-    d_prev = jnp.where(strict, jnp.exp(g_prev - g.T), 0.0)
+    # Mask the exponent BEFORE exponentiating: on masked (upper-triangle)
+    # entries G_{t-1}−G_j ≥ 0 grows with cumulative in-chunk decay and
+    # overflows exp for mean α ≲ 0.25 at C=64; the where-vjp then turns
+    # 0·inf into NaN, poisoning every gradient. Masking the argument keeps
+    # both the forward intermediate and the vjp finite.
+    d_prev = jnp.where(strict, jnp.exp(jnp.where(strict, g_prev - g.T, 0.0)),
+                       0.0)
     a = (b_col * d_prev) * kk  # strictly lower
     x = _tri_inverse_unit_lower(jnp.eye(c, dtype=a.dtype) + a)
 
     u_v = x @ (b_col * vc)  # (C, dv)
     w = x @ ((b_col * jnp.exp(g_prev)) * kc)  # (C, dk)
-    p = qk * jnp.where(incl, jnp.exp(g - g.T), 0.0)  # (C, C)
+    p = qk * jnp.where(incl, jnp.exp(jnp.where(incl, g - g.T, 0.0)), 0.0)
     q_gamma = jnp.exp(g) * qc  # (C, dk)
     gamma_c = jnp.exp(g[c - 1, 0])
     k_out = jnp.exp(g[c - 1, 0] - g) * kc  # (C, dk)

@@ -425,7 +425,18 @@ def gemm_rs_2d_shard(
     K is sharded over BOTH axes; returns this rank's
     ``(m / (wo*wi), n)`` row-chunk of the fully-summed product, rows
     assigned inner-major then outer (rank (d, i) holds global row block
-    ``i*wo + d``). Inside shard_map over both axes."""
+    ``i*wo + d``). Inside shard_map over both axes.
+
+    .. warning:: **Layout asymmetry vs ``ag_gemm_2d_shard``.** This
+       function's output is INNER-major — assembling it under
+       ``out_specs=P((outer, inner))`` silently row-permutes the result.
+       Use ``out_specs=P((inner, outer))``, or permute with
+       ``reorder_2d_rows_inner_to_outer_major`` (extra copy).
+       ``ag_gemm_2d_shard`` pays a local block transpose to return
+       outer-major because its permutation is rank-local; here the row
+       OWNERSHIP itself is inner-major (``psum_scatter`` over the outer
+       axis scatters the inner leg's output), so outer-major ownership
+       would need an extra cross-rank exchange — callers choose."""
     outer, inner = axes
     if mesh_axes is None:
         mesh_axes = axes  # full-mesh addressing, see ag_gemm_2d_shard
@@ -443,3 +454,19 @@ def gemm_rs_2d_shard(
     return jax.lax.psum_scatter(
         part.astype(jnp.float32), outer, scatter_dimension=0, tiled=True
     ).astype(a.dtype)
+
+
+def reorder_2d_rows_inner_to_outer_major(x: jax.Array, *, axes) -> jax.Array:
+    """Move ``gemm_rs_2d_shard``'s inner-major row ownership (rank (d, i)
+    holds global block ``i*wo + d``) to outer-major ``P((outer, inner))``
+    order (rank (d, i) holds block ``d*wi + i``) with ONE
+    collective-permute — each rank forwards its whole block exactly once.
+    Use when composing with outer-major consumers such as
+    ``ag_gemm_2d_shard`` (see the layout warnings on both)."""
+    outer, inner = axes
+    wo = jax.lax.axis_size(outer)
+    wi = jax.lax.axis_size(inner)
+    # Linear rank over (outer, inner) is d*wi + i; it holds block i*wo + d,
+    # which outer-major order places on linear rank i*wo + d.
+    perm = [(d * wi + i, i * wo + d) for d in range(wo) for i in range(wi)]
+    return jax.lax.ppermute(x, (outer, inner), perm)

@@ -120,7 +120,13 @@ class ModelBuilder:
         # task's first output is the residual stream, the cache_update
         # task's outputs are the updated caches.
         final_out = self.graph.tasks[-1].outputs[0]
-        cu = next(t for t in self.graph.tasks if t.op == "cache_update")
+        cu = next((t for t in self.graph.tasks if t.op == "cache_update"), None)
+        if cu is None:
+            raise ValueError(
+                "megakernel graph must contain a cache_update task: "
+                "build_layer_fn returns (residual, k_cache, v_cache) and "
+                "reads the caches off that task's outputs. For attention-free "
+                "graphs, lower the groups directly via _lower_group.")
         kc_out, vc_out = cu.outputs[0], cu.outputs[1]
 
         def layer_fn(lp, x, ks, vs, li, lengths):
@@ -292,10 +298,12 @@ class ModelBuilder:
 
         if op == "allreduce":
             def standalone_allreduce(env, lp, t=task):
+                # Output dtype follows the task's own input value, not a
+                # hardcoded env key — a graph with renamed inputs lowers fine.
                 x = env[t.inputs[0]]
                 env[t.outputs[0]] = all_reduce_shard(
                     x.astype(jnp.float32), axis=axis, method=AllReduceMethod.AUTO
-                ).astype(env["input:x"].dtype)
+                ).astype(x.dtype)
             return standalone_allreduce
 
         if op == "moe":

@@ -20,8 +20,10 @@ import jax.numpy as jnp
 from triton_dist_tpu.megakernel.graph import Task, TaskGraph
 from triton_dist_tpu.megakernel.kernels import (
     _rmsnorm_rows,
+    fused_attn_back,
     fused_ln_qkv_rope,
     fused_mlp_block,
+    fused_moe_block,
 )
 
 
@@ -181,6 +183,90 @@ class ModelBuilder:
                 env[out_k] = k.reshape(b, hkv, hd)
                 env[out_v] = v.reshape(b, hkv, hd)
             return fused_attn_front
+
+        if gname == "attn_back":
+            # [cache_update(k,v,kc,vc,len), flash_decode(q,·,·,len),
+            #  linear_allreduce(·, wo), add(x, ·)] — one fused kernel for the
+            #  sweep + o-proj partial; AR + residual at graph level; the HBM
+            #  cache append is an in-place scatter OFF the attention path.
+            cu_t, fd_t, oar_t, add_t = group
+            k_in, v_in = cu_t.inputs[0], cu_t.inputs[1]
+            kc_in, vc_in, len_in = cu_t.inputs[2], cu_t.inputs[3], cu_t.inputs[4]
+            q_in = fd_t.inputs[0]
+            wo_p = param(oar_t.inputs[1])
+            resid_in = (add_t.inputs[0] if add_t.inputs[1] == oar_t.outputs[0]
+                        else add_t.inputs[1])
+            kc_out, vc_out = cu_t.outputs
+            out_v = add_t.outputs[0]
+            world = self.world
+
+            def fused_attn_back_ex(env, lp):
+                q = env[q_in]
+                k_new, v_new = env[k_in], env[v_in]
+                ks, li = env[kc_in]
+                vs, _ = env[vc_in]
+                lengths = env[len_in]
+                b = q.shape[0]
+                partial = fused_attn_back(
+                    q, k_new, v_new, ks[li], vs[li], lengths, lp[wo_p],
+                    block_k=min(256, ks.shape[3]),
+                )  # (B, d_model) f32 o-proj partial
+                # Same rounding points as gemm_ar_shard's decode (ONE_SHOT)
+                # path: cast the partial to model dtype, then all-reduce.
+                attn_out = partial.astype(q.dtype).reshape(b, -1)
+                if world > 1:
+                    attn_out = all_reduce_shard(
+                        attn_out, axis=axis, method=AllReduceMethod.ONE_SHOT
+                    )
+                env[out_v] = env[resid_in] + attn_out
+                # The cache_update task's semantic outputs: one-row in-place
+                # scatter per sequence, scheduled by XLA in parallel with
+                # the fused sweep (which already folded the new token in).
+                bids = jnp.arange(b)
+                ks = ks.at[li, bids, :, lengths].set(k_new)
+                vs = vs.at[li, bids, :, lengths].set(v_new)
+                env[kc_out] = (ks, li)
+                env[vc_out] = (vs, li)
+            return fused_attn_back_ex
+
+        if gname == "moe_block":
+            # The routed-experts MLP through ONE Pallas kernel (fused
+            # gate/up→SwiGLU→down, h never in HBM) — routing/dispatch, AR
+            # and the weighted unpermute stay at graph level with TP_MoE's
+            # exact rounding points (fp32 partials on the wire). BEYOND the
+            # reference megakernel (dense-only). pin_standalone("moe")
+            # falls back to the jit-level TP_MoE lowering.
+            t_task = group[0]
+            x_in = t_task.inputs[0]
+            r_p, g_p, u_p, d_p = (param(i) for i in t_task.inputs[1:])
+            out_v = t_task.outputs[0]
+            world = self.world
+            mesh_axes = self.mesh_axes
+
+            def fused_moe_ex(env, lp):
+                from triton_dist_tpu.layers.tp import MOE_CAPACITY_FACTOR
+                from triton_dist_tpu.kernels.moe_utils import (
+                    capacity_for, combine, dispatch, make_routing_plan,
+                    topk_routing,
+                )
+
+                x = env[x_in]
+                tkn = x.shape[0]
+                n_e = lp[r_p].shape[1]
+                logits = jnp.dot(x, lp[r_p], preferred_element_type=jnp.float32)
+                idx, wts = topk_routing(logits, c.top_k)
+                cap = capacity_for(tkn, c.top_k, n_e, MOE_CAPACITY_FACTOR)
+                plan = make_routing_plan(idx, n_e, cap)
+                xe = dispatch(x, plan)  # (E, C, d)
+                y = fused_moe_block(xe, lp[g_p], lp[u_p], lp[d_p])
+                out = combine(y, plan, wts, tkn, out_dtype=jnp.float32)
+                if world > 1:
+                    out = all_reduce_shard(
+                        out, axis=axis, mesh_axes=mesh_axes,
+                        method=AllReduceMethod.AUTO,
+                    )
+                env[out_v] = out.astype(x.dtype)
+            return fused_moe_ex
 
         if gname == "mlp_block":
             # [rmsnorm(x1, ln), linear(·, wg, wu), swiglu, linear(·, wd)]

@@ -33,7 +33,11 @@ class Task:
 # per-task-type dispatch (code_generator.py:158-166).
 FUSABLE_CHAINS = (
     (("rmsnorm", "linear", "head_norm", "rope"), "attn_front"),
+    (("cache_update", "flash_decode", "linear_allreduce", "add"), "attn_back"),
     (("rmsnorm", "linear", "swiglu", "linear"), "mlp_block"),
+    # Length-1 "chain": routes the moe task through the fused routed-experts
+    # kernel; pin_standalone("moe") falls back to the jit-level TP_MoE.
+    (("moe",), "moe_block"),
 )
 
 

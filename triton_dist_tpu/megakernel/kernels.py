@@ -16,6 +16,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from triton_dist_tpu.kernels.flash_attn import LANES, NEG_INF
 from triton_dist_tpu.runtime.platform import interpret_mode_default
 
 
@@ -233,6 +234,254 @@ def fused_ln_qkv_rope(
     k = flat[:, hq * hd : (hq + hkv) * hd]
     v = flat[:, (hq + hkv) * hd :]
     return q, k, v
+
+
+def _moe_block_kernel(xe_ref, wg_ref, wu_ref, wd_ref, y_ref, acc, *, n_f: int):
+    f_i = pl.program_id(1)
+
+    @pl.when(f_i == 0)
+    def _():
+        acc[...] = jnp.zeros_like(acc)
+
+    x = xe_ref[0]  # (C, d)
+    g = jnp.dot(x, wg_ref[0], preferred_element_type=jnp.float32)
+    u = jnp.dot(x, wu_ref[0], preferred_element_type=jnp.float32)
+    h = (jax.nn.silu(g) * u).astype(x.dtype)
+    acc[...] += jnp.dot(h, wd_ref[0], preferred_element_type=jnp.float32)
+
+    @pl.when(f_i == n_f - 1)
+    def _():
+        y_ref[0] = acc[...]
+
+
+def fused_moe_block(
+    xe: jax.Array,  # (E, C, d) capacity-padded dispatched token panels
+    w_gate: jax.Array,  # (E, d, ff)
+    w_up: jax.Array,  # (E, d, ff)
+    w_down: jax.Array,  # (E, ff, d)
+    *,
+    block_f: int | None = None,
+    vmem_limit_mb: int | None = 100,
+) -> jax.Array:
+    """Routed-experts panel compute in ONE kernel: per expert, gate/up →
+    SwiGLU → down with the f32 (C, d) accumulator resident in VMEM and the
+    SwiGLU intermediate never touching HBM — the mega backend's ``moe``
+    task group (BEYOND the reference megakernel, which is dense-only:
+    ``mega_triton_kernel/models/model_builder.py``). Each expert's weight
+    tiles stream exactly once; grid order (expert, ff-tile) keeps one
+    expert's accumulator live at a time. Returns f32 (E, C, d) down-GEMM
+    partials — the caller all-reduces over tp and runs the weighted
+    unpermute, exactly ``TP_MoE``'s rounding points."""
+    from triton_dist_tpu.kernels.gemm import fit_block
+
+    e, cap, d = xe.shape
+    ff = w_gate.shape[-1]
+    if block_f is None:
+        block_f = 512
+    bf = fit_block(ff, block_f)
+    n_f = ff // bf
+
+    return pl.pallas_call(
+        functools.partial(_moe_block_kernel, n_f=n_f),
+        grid=(e, n_f),
+        in_specs=[
+            pl.BlockSpec((1, cap, d), lambda ei, fi: (ei, 0, 0)),
+            pl.BlockSpec((1, d, bf), lambda ei, fi: (ei, 0, fi)),
+            pl.BlockSpec((1, d, bf), lambda ei, fi: (ei, 0, fi)),
+            pl.BlockSpec((1, bf, d), lambda ei, fi: (ei, fi, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, cap, d), lambda ei, fi: (ei, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((e, cap, d), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((cap, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary"),
+            vmem_limit_bytes=vmem_limit_mb * 1024 * 1024 if vmem_limit_mb else None,
+        ),
+        interpret=interpret_mode_default(),
+        cost_estimate=pl.CostEstimate(
+            flops=e * (6 * cap * d * ff),
+            bytes_accessed=3 * e * d * ff * w_gate.dtype.itemsize
+            + 2 * e * cap * d * xe.dtype.itemsize,
+            transcendentals=e * cap * ff,
+        ),
+    )(xe, w_gate, w_up, w_down)
+
+
+def _attn_back_kernel(
+    lengths_ref,  # SMEM (B,)
+    q_ref,  # (1, 1, group, d)
+    kn_ref,  # (1, 1, d) — new K token for this (b, kv head)
+    vn_ref,  # (1, 1, d)
+    k_ref,  # (1, 1, bk, d) — cache block (pre-append)
+    v_ref,  # (1, 1, bk, d)
+    wo_ref,  # (group*d, n) — o-proj rows for this kv head's query group
+    o_ref,  # (B, n) f32 — o-proj partial (pre-allreduce)
+    acc_scr,  # VMEM (group, d) f32
+    m_scr,  # VMEM (group, LANES) f32
+    l_scr,  # VMEM (group, LANES) f32
+    out_acc,  # VMEM (B, n) f32
+    *,
+    scale: float,
+    block_k: int,
+    n_kv: int,
+    nb: int,
+    nh: int,
+    group: int,
+    hd: int,
+):
+    h = pl.program_id(0)
+    bi = pl.program_id(1)
+    ik = pl.program_id(2)
+    length = lengths_ref[bi]
+
+    @pl.when((h == 0) & (bi == 0) & (ik == 0))
+    def _():
+        out_acc[...] = jnp.zeros_like(out_acc)
+
+    @pl.when(ik == 0)
+    def _():
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+
+    @pl.when(ik * block_k < length + 1)  # +1: the appended token is valid
+    def _():
+        q = q_ref[0, 0]  # (group, d)
+        kblk = k_ref[0, 0]  # (bk, d)
+        vblk = v_ref[0, 0]
+        # In-kernel KV append: the new token lands in cache slot `length`;
+        # if this block covers it, splice the row into the VMEM tile. The
+        # sweep then runs the EXACT math of append-then-attend (same block
+        # order, same mask) so results are bit-identical to the standalone
+        # cache_update → flash_decode pair — while the HBM cache append
+        # happens elsewhere as a 1-row scatter that no longer gates the
+        # attention sweep. Full-cache boundary (length == S): JAX scatters
+        # DROP out-of-bounds updates, so the standalone cache_update drops
+        # the new token; here `row == S − ik·block_k` then lands outside
+        # every block and the splice likewise inserts nowhere — the two
+        # lowerings agree bit-for-bit (boundary-tested in
+        # test_fused_attn_back_matches_composition).
+        row = length - ik * block_k
+        row_ids = jax.lax.broadcasted_iota(jnp.int32, (block_k, 1), 0)
+        insert = row_ids == row
+        kblk = jnp.where(insert, kn_ref[0], kblk)
+        vblk = jnp.where(insert, vn_ref[0], vblk)
+
+        s = jax.lax.dot_general(
+            q, kblk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # (group, bk)
+        k_ids = ik * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(k_ids < length + 1, s, NEG_INF)
+
+        m_prev = m_scr[...]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, jnp.broadcast_to(m_cur, m_prev.shape))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, :1])
+        l_scr[...] = l_scr[...] * alpha + jnp.broadcast_to(
+            jnp.sum(p, axis=1, keepdims=True), m_prev.shape
+        )
+        m_scr[...] = m_new
+        acc_scr[...] = acc_scr[...] * alpha[:, :1] + jax.lax.dot_general(
+            p.astype(vblk.dtype), vblk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(ik == n_kv - 1)
+    def _():
+        l = l_scr[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        # Round to model dtype exactly where the standalone flash_decode
+        # writes its output, then feed the o-projection without an HBM trip.
+        o_tile = (acc_scr[...] / l_safe).astype(q_ref.dtype)  # (group, d)
+        part = jnp.dot(
+            o_tile.reshape(1, group * hd), wo_ref[...],
+            preferred_element_type=jnp.float32,
+        )
+        out_acc[pl.ds(bi, 1), :] = out_acc[pl.ds(bi, 1), :] + part
+
+    @pl.when((h == nh - 1) & (bi == nb - 1) & (ik == n_kv - 1))
+    def _():
+        o_ref[...] = out_acc[...]
+
+
+def fused_attn_back(
+    q: jax.Array,  # (B, Hq, D) — roped decode queries
+    k_new: jax.Array,  # (B, Hkv, D) — this step's K token (pre-append)
+    v_new: jax.Array,  # (B, Hkv, D)
+    k_cache: jax.Array,  # (B, Hkv, S, D) — cache BEFORE this step's append
+    v_cache: jax.Array,
+    lengths: jax.Array,  # (B,) int32 valid length BEFORE the append
+    wo: jax.Array,  # (Hq*D, n) — o-projection shard (TP rows)
+    *,
+    scale: float | None = None,
+    block_k: int = 256,
+    vmem_limit_mb: int | None = 100,
+) -> jax.Array:
+    """cache_update → flash_decode → o-proj partial in ONE kernel (the
+    attention back-leg task group; reference
+    ``mega_triton_kernel/tasks/flash_decode.py`` + ``core/code_generator.py``
+    :158-166 lower these as consecutive tasks of the persistent kernel).
+
+    The new token's K/V rows are spliced into the cache tile **in VMEM**
+    (bit-identical to appending first), the online-softmax sweep runs over
+    the cache, and each (batch, kv-head)'s normalized output feeds the
+    o-projection accumulation while ``wo``'s row panel for that head group
+    streams in exactly once per head. Returns the f32 o-proj PARTIAL
+    (B, n) — the caller all-reduces over tp and adds the residual; the HBM
+    cache append stays the caller's in-place 1-row scatter, now off the
+    attention critical path."""
+    b, hq, d = q.shape
+    _, hkv, s, _ = k_cache.shape
+    assert hq % hkv == 0
+    group = hq // hkv
+    n = wo.shape[1]
+    assert wo.shape[0] == hq * d, (wo.shape, hq, d)
+    scale = scale if scale is not None else d ** -0.5
+    from triton_dist_tpu.kernels.gemm import fit_block
+
+    block_k = fit_block(s, block_k)
+    n_kv = s // block_k
+
+    qr = q.reshape(b, hkv, group, d)
+
+    return pl.pallas_call(
+        functools.partial(
+            _attn_back_kernel, scale=scale, block_k=block_k, n_kv=n_kv,
+            nb=b, nh=hkv, group=group, hd=d,
+        ),
+        grid=(hkv, b, n_kv),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, group, d), lambda h, bi, ik: (bi, h, 0, 0)),
+            pl.BlockSpec((1, 1, d), lambda h, bi, ik: (bi, h, 0)),
+            pl.BlockSpec((1, 1, d), lambda h, bi, ik: (bi, h, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda h, bi, ik: (bi, h, ik, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda h, bi, ik: (bi, h, ik, 0)),
+            pl.BlockSpec((group * d, n), lambda h, bi, ik: (h, 0)),
+        ],
+        out_specs=pl.BlockSpec((b, n), lambda h, bi, ik: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, n), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((group, d), jnp.float32),
+            pltpu.VMEM((group, LANES), jnp.float32),
+            pltpu.VMEM((group, LANES), jnp.float32),
+            pltpu.VMEM((b, n), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary", "arbitrary"),
+            vmem_limit_bytes=vmem_limit_mb * 1024 * 1024 if vmem_limit_mb else None,
+        ),
+        interpret=interpret_mode_default(),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * b * hq * s * d * 2 + 2 * b * hq * d * n,
+            bytes_accessed=(
+                2 * b * hkv * s * d * k_cache.dtype.itemsize
+                + hq * d * n * wo.dtype.itemsize
+            ),
+            transcendentals=b * hq * s,
+        ),
+    )(lengths.astype(jnp.int32), qr, k_new, v_new, k_cache, v_cache, wo)
 
 
 def _norm_head_kernel(x_ref, nw_ref, w_ref, o_ref, xn, *, eps):

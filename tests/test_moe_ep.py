@@ -270,6 +270,68 @@ def test_all_to_all_2d():
     np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-6, atol=1e-6)
 
 
+def test_ep_fused_streams_compute_under_a2a(ctx4, rng):
+    """Schedule evidence (r3 verdict item 5 'Done' criterion): the fused
+    EP kernel's in-kernel trace shows expert 0 COMPUTING row-slices before
+    the LAST source's arrival — per-source waits replaced the full drain.
+    The local slice computes with zero network wait, and the traced run's
+    output is identical to the untraced run's."""
+    from triton_dist_tpu.kernels.ep_fused import fused_dispatch_mlp_combine_shard
+    from triton_dist_tpu.tools import KernelTrace
+
+    WORLD, e_local, cap, d, ff = 4, 2, 8, 32, 64
+    chunk = e_local * cap
+    send = jnp.asarray(
+        rng.standard_normal((WORLD, WORLD, chunk, d)), jnp.float32) * 0.3
+    wg = jnp.asarray(rng.standard_normal((WORLD, e_local, d, ff)), jnp.float32) * 0.1
+    wu = jnp.asarray(rng.standard_normal((WORLD, e_local, d, ff)), jnp.float32) * 0.1
+    wd = jnp.asarray(rng.standard_normal((WORLD, e_local, ff, d)), jnp.float32) * 0.1
+    kt = KernelTrace(capacity=64)
+
+    def run(trace):
+        def fn(s_, wg_, wu_, wd_):
+            out = fused_dispatch_mlp_combine_shard(
+                s_[0], wg_[0], wu_[0], wd_[0], capacity=cap, axis="tp",
+                mesh_axes=("tp",), block_f=32, trace=trace,
+            )
+            return ((out[0][None], out[1][None]) if trace is not None
+                    else out[None])
+
+        return jax.jit(
+            jax.shard_map(
+                fn, mesh=ctx4.mesh,
+                in_specs=(P("tp"), P("tp"), P("tp"), P("tp")),
+                out_specs=(P("tp"), P("tp")) if trace is not None else P("tp"),
+                check_vma=False,
+            )
+        )(send, wg, wu, wd)
+
+    comb_traced, events = run(kt)
+    comb_plain = run(None)
+    np.testing.assert_array_equal(np.asarray(comb_traced), np.asarray(comb_plain))
+
+    n_f = ff // 32
+    for r in range(WORLD):
+        dec = kt.decode(np.asarray(events)[r])
+        evs = dec["events"]
+        assert dec["n_dropped"] == 0
+        arrivals = [e for e in evs if e["tag"] == 1]
+        computes = [e for e in evs if e["tag"] == 2]
+        panels = [e for e in evs if e["tag"] == 3]
+        assert len(arrivals) == WORLD - 1, evs
+        assert len(computes) == WORLD
+        assert len(panels) == e_local * n_f - 1
+        # Zero-wait start: the first computed slice is the LOCAL source.
+        assert computes[0]["aux"] == r
+        # The streaming claim itself: compute begins BEFORE the last
+        # source's arrival (the old full-drain put every arrival first).
+        assert computes[0]["seq"] < arrivals[-1]["seq"], evs
+        # Stronger: every arrival is followed by that source's compute
+        # before the next arrival (wait→compute interleave, ring order).
+        for a, c in zip(arrivals, computes[1:]):
+            assert c["seq"] == a["seq"] + 1 and c["aux"] == a["aux"]
+
+
 @pytest.mark.parametrize(
     "variant", ["combine_in_kernel", "two_step", "fp8_wire"]
 )

@@ -56,6 +56,7 @@ def _fused_ep_kernel(
     e_local: int,
     fp8: bool,
     combine: bool,
+    trace=None,
 ):
     """ONE kernel for the mega-EP pipeline, both variants (reference
     ``mega_kernel_dispatch_token_moe_grouped_gemm`` :839 and
@@ -81,6 +82,7 @@ def _fused_ep_kernel(
     y_ref = None if combine else next(it)
     recv_ref = next(it)
     scl_recv_ref = next(it) if fp8 else None
+    ev_ref = next(it) if trace is not None else None
     xs = next(it)
     acc = next(it)
     y_stage = next(it) if combine else None
@@ -96,8 +98,51 @@ def _fused_ep_kernel(
     me = tpl.rank(axis)
     world = tpl.num_ranks(axis)
 
+    def _mark(tag, aux):
+        if trace is not None:
+            trace.mark(ev_ref, e_i * n_f + f_i, tag, aux)
+
+    def _fetch_source(s):
+        """Start + drain the VMEM gather of source s's rows for expert e_i."""
+        pltpu.make_async_copy(
+            recv_ref.at[s, pl.ds(e_i * cap, cap)],
+            xs.at[pl.ds(s * cap, cap)],
+            copy_sem,
+        ).start()
+        if fp8:
+            pltpu.make_async_copy(
+                scl_recv_ref.at[s, pl.ds(e_i * cap, cap)],
+                xs_s.at[pl.ds(s * cap, cap)],
+                copy_sem,
+            ).start()
+
+    def _drain_fetch_source(s):
+        pltpu.make_async_copy(
+            xs.at[pl.ds(s * cap, cap)], xs.at[pl.ds(s * cap, cap)], copy_sem
+        ).wait()
+        if fp8:
+            pltpu.make_async_copy(
+                xs_s.at[pl.ds(s * cap, cap)], xs_s.at[pl.ds(s * cap, cap)],
+                copy_sem,
+            ).wait()
+
+    def _slice_mlp(sl):
+        """gate/up → SwiGLU → down on a row-slice of the panel (token rows
+        are independent through the expert MLP, which is what makes
+        source-granular streaming legal)."""
+        if fp8:
+            panel = (xs[sl].astype(jnp.float32) * xs_s[sl]).astype(model_dtype)
+        else:
+            panel = xs[sl]
+        g = jnp.dot(panel, wg_ref[0], preferred_element_type=jnp.float32)
+        u = jnp.dot(panel, wu_ref[0], preferred_element_type=jnp.float32)
+        h = (jax.nn.silu(g) * u).astype(model_dtype)
+        return jnp.dot(h, wd_ref[0], preferred_element_type=jnp.float32)
+
     @pl.when(jnp.logical_and(e_i == 0, f_i == 0))
     def _():
+        if trace is not None:
+            trace.init(ev_ref)
         # Peers may still be reading recv/comb from a previous step.
         tpl.barrier_all(axis, mesh_axes=mesh_axes)
         cp = pltpu.make_async_copy(send_ref.at[me], recv_ref.at[me], copy_sem)
@@ -112,77 +157,76 @@ def _fused_ep_kernel(
 
         def send(i, _):
             peer = jax.lax.rem(me + i, world)
+            # Signal slot [me] of the PEER's recv semaphore array — the
+            # receiver can then wait each SOURCE individually instead of
+            # draining an anonymous arrival count (r3 verdict item 5; the
+            # reference's tile-granular arrival tracking,
+            # ep_all2all_fused.py:839-1020).
             tpl.putmem_signal(
-                send_ref.at[peer], recv_ref.at[me], send_sem, recv_sem, peer,
-                axis=axis, mesh_axes=mesh_axes,
+                send_ref.at[peer], recv_ref.at[me], send_sem, recv_sem.at[me],
+                peer, axis=axis, mesh_axes=mesh_axes,
             ).start()
             if fp8:
                 tpl.putmem_signal(
-                    scl_ref.at[peer], scl_recv_ref.at[me], send_sem, recv_sem,
-                    peer, axis=axis, mesh_axes=mesh_axes,
+                    scl_ref.at[peer], scl_recv_ref.at[me], send_sem,
+                    recv_sem.at[me], peer, axis=axis, mesh_axes=mesh_axes,
                 ).start()
             return 0
 
         jax.lax.fori_loop(1, world, send, 0)
 
-        def drain(i, _):
-            # Each arrival delivers one (E_local*C, d) chunk (+ scales); the
-            # weight pipeline for expert 0 streams while we sit here.
-            tpl.wait_recv(recv_sem, recv_ref.at[me])
-            pltpu.make_async_copy(send_ref.at[me], send_ref.at[me], send_sem).wait()
-            if fp8:
-                tpl.wait_recv(recv_sem, scl_recv_ref.at[me])
+        # SOURCE-GRANULAR first sweep: no full drain. Process sources in
+        # expected-arrival order (sender s reaches me at its ring step
+        # (me−s) mod world, so nearer-behind ranks land first): wait THAT
+        # source, gather its rows, and run expert 0's f=0 tile on them
+        # while later sources are still in flight. Compute on the local
+        # slice starts with ZERO network wait.
+        acc[...] = jnp.zeros_like(acc)
+        for j in range(world):  # static unroll: world is a mesh constant
+            s = jax.lax.rem(me - j + world, world)
+            if j > 0:
+                tpl.wait_recv(recv_sem.at[s], recv_ref.at[me])
+                # Retire one of our outbound sends (byte-counting).
                 pltpu.make_async_copy(
-                    scl_ref.at[me], scl_ref.at[me], send_sem
+                    send_ref.at[me], send_ref.at[me], send_sem
                 ).wait()
-            return 0
+                if fp8:
+                    tpl.wait_recv(recv_sem.at[s], scl_recv_ref.at[me])
+                    pltpu.make_async_copy(
+                        scl_ref.at[me], scl_ref.at[me], send_sem
+                    ).wait()
+                _mark(1, s)  # TAG_ARRIVE
+            _fetch_source(s)
+            _drain_fetch_source(s)
+            sl = pl.ds(s * cap, cap)
+            acc[sl] += _slice_mlp(sl)
+            _mark(2, s)  # TAG_COMPUTE_SRC
 
-        jax.lax.fori_loop(1, world, drain, 0)
-
-    @pl.when(f_i == 0)
+    @pl.when(jnp.logical_and(f_i == 0, e_i > 0))
     def _():
-        # Gather expert e_i's rows from every source chunk into one panel —
-        # start all world copies (disjoint xs slices), then drain the
-        # byte-counting semaphore, so the DMAs overlap instead of paying
-        # world sequential latencies.
+        # Later experts: every source has arrived (the first sweep waited
+        # them all) — start all world gather copies (disjoint xs slices),
+        # then drain the byte-counting semaphore, so the DMAs overlap
+        # instead of paying world sequential latencies.
         def fetch(s, _):
-            pltpu.make_async_copy(
-                recv_ref.at[s, pl.ds(e_i * cap, cap)],
-                xs.at[pl.ds(s * cap, cap)],
-                copy_sem,
-            ).start()
-            if fp8:
-                pltpu.make_async_copy(
-                    scl_recv_ref.at[s, pl.ds(e_i * cap, cap)],
-                    xs_s.at[pl.ds(s * cap, cap)],
-                    copy_sem,
-                ).start()
+            _fetch_source(s)
             return 0
 
         jax.lax.fori_loop(0, world, fetch, 0)
 
         def drain_fetch(s, _):
-            pltpu.make_async_copy(
-                xs.at[pl.ds(s * cap, cap)], xs.at[pl.ds(s * cap, cap)], copy_sem
-            ).wait()
-            if fp8:
-                pltpu.make_async_copy(
-                    xs_s.at[pl.ds(s * cap, cap)], xs_s.at[pl.ds(s * cap, cap)],
-                    copy_sem,
-                ).wait()
+            _drain_fetch_source(s)
             return 0
 
         jax.lax.fori_loop(0, world, drain_fetch, 0)
         acc[...] = jnp.zeros_like(acc)
 
-    if fp8:
-        panel = (xs[...].astype(jnp.float32) * xs_s[...]).astype(model_dtype)
-    else:
-        panel = xs[...]
-    g = jnp.dot(panel, wg_ref[0], preferred_element_type=jnp.float32)
-    u = jnp.dot(panel, wu_ref[0], preferred_element_type=jnp.float32)
-    h = (jax.nn.silu(g) * u).astype(model_dtype)
-    acc[...] += jnp.dot(h, wd_ref[0], preferred_element_type=jnp.float32)
+    @pl.when(jnp.logical_not(jnp.logical_and(e_i == 0, f_i == 0)))
+    def _():
+        # Full-panel tile for every step except (0, 0), which already ran
+        # source-granular above.
+        acc[...] += _slice_mlp(slice(None))
+        _mark(3, f_i)  # TAG_PANEL
 
     if not combine:
         @pl.when(f_i == n_f - 1)
@@ -279,8 +323,10 @@ def fused_moe_supported(world: int, cap: int, d: int, ff: int,
 
 
 def _fused_ep_call(send, w_gate, w_up, w_down, *, capacity, axis, mesh_axes,
-                   block_f, vmem_limit_mb, combine, wire_fp8):
-    """Shared launch plumbing for both variants of ``_fused_ep_kernel``."""
+                   block_f, vmem_limit_mb, combine, wire_fp8, trace=None):
+    """Shared launch plumbing for both variants of ``_fused_ep_kernel``.
+    With ``trace`` (a ``tools.KernelTrace``), the kernel also returns this
+    rank's in-kernel event buffer as a second output."""
     world = jax.lax.axis_size(axis)
     _, chunk, d = send.shape
     e_local = chunk // capacity
@@ -320,6 +366,9 @@ def _fused_ep_call(send, w_gate, w_up, w_down, *, capacity, axis, mesh_axes,
     if wire_fp8:
         out_specs.append(pl.BlockSpec(memory_space=pl.ANY))  # scale recv
         out_shape.append(jax.ShapeDtypeStruct((world, chunk, 1), jnp.float32))
+    if trace is not None:
+        out_specs.append(trace.out_spec())
+        out_shape.append(trace.out_shape)
 
     scratch = [
         pltpu.VMEM((world * capacity, d), wire_dtype),  # xs
@@ -329,13 +378,19 @@ def _fused_ep_call(send, w_gate, w_up, w_down, *, capacity, axis, mesh_axes,
         scratch.append(pltpu.VMEM((world * capacity, d), model_dtype))  # y_stage
     if wire_fp8:
         scratch.append(pltpu.VMEM((world * capacity, 1), jnp.float32))  # xs_s
-    scratch += [pltpu.SemaphoreType.DMA] * (6 if combine else 3)
+    scratch += [
+        pltpu.SemaphoreType.DMA,  # send
+        pltpu.SemaphoreType.DMA((world,)),  # recv: one slot per SOURCE rank
+        pltpu.SemaphoreType.DMA,  # local copies / gathers
+    ]
+    if combine:
+        scratch += [pltpu.SemaphoreType.DMA] * 3
 
     res = dist_pallas_call(
         functools.partial(
             _fused_ep_kernel,
             axis=axis, mesh_axes=mesh_axes, cap=capacity, n_f=n_f,
-            e_local=e_local, fp8=wire_fp8, combine=combine,
+            e_local=e_local, fp8=wire_fp8, combine=combine, trace=trace,
         ),
         grid=(e_local, n_f),
         in_specs=in_specs,
@@ -350,9 +405,12 @@ def _fused_ep_call(send, w_gate, w_up, w_down, *, capacity, axis, mesh_axes,
             # one program must not alias.
             collective_id=collective_id_for(
                 f"_fused_ep_kernel:combine={combine}:fp8={wire_fp8}"
+                f":trace={trace is not None}"
             ),
         ),
     )(*send_ops, w_gate, w_up, w_down)
+    if trace is not None:
+        return res[0], res[-1]
     return res[0]
 
 
@@ -400,12 +458,17 @@ def fused_dispatch_mlp_combine_shard(
     block_f: int = 512,
     vmem_limit_mb: int = 100,
     wire_fp8: bool = False,
-) -> jax.Array:
+    trace=None,
+):
     """a2a-dispatch + grouped MLP + return-a2a COMBINE in ONE kernel.
     Returns the combine landing buffer (world, E_local*C, d) — from peer p,
     p's experts' outputs for MY tokens, global-expert-major — ready for the
     local weighted unpermute (``moe_utils.combine``). ``wire_fp8`` moves
     e4m3 + per-token scales on the dispatch wire (half the dispatch bytes).
+    ``trace`` (a ``tools.KernelTrace``) additionally returns this rank's
+    in-kernel event buffer — tags 1=source-arrival wait done, 2=computed
+    that source's row-slice, 3=full-panel ff tile — the schedule evidence
+    that compute streams under the a2a instead of draining it first.
     Inside shard_map."""
     world = jax.lax.axis_size(axis)
     _, chunk, d = send.shape
@@ -414,6 +477,7 @@ def fused_dispatch_mlp_combine_shard(
     if world == 1:
         from triton_dist_tpu.kernels.group_gemm import group_gemm, group_gemm_swiglu
 
+        assert trace is None, "trace requires the multi-rank kernel path"
         xs = send.reshape(e_local, capacity, d)
         y = group_gemm(group_gemm_swiglu(xs, w_gate, w_up), w_down)
         return y.reshape(1, e_local * capacity, d)
@@ -421,7 +485,7 @@ def fused_dispatch_mlp_combine_shard(
     return _fused_ep_call(
         send, w_gate, w_up, w_down, capacity=capacity, axis=axis,
         mesh_axes=mesh_axes, block_f=block_f, vmem_limit_mb=vmem_limit_mb,
-        combine=True, wire_fp8=wire_fp8,
+        combine=True, wire_fp8=wire_fp8, trace=trace,
     )
 
 

@@ -31,9 +31,16 @@ from triton_dist_tpu.tools.perf_model import (
     overlap_fraction,
     overlap_efficiency,
 )
-from triton_dist_tpu.tools.profiler import ChromeTrace, annotate, profile_op, trace
+from triton_dist_tpu.tools.profiler import (
+    ChromeTrace,
+    KernelTrace,
+    annotate,
+    profile_op,
+    trace,
+)
 
 __all__ = [
+    "KernelTrace",
     "bench_device_time",
     "TuneCache",
     "autotune",

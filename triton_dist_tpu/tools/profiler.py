@@ -1,15 +1,24 @@
-"""Profiling: device op timelines (XProf/perfetto) + host span traces.
+"""Profiling: device op timelines (XProf/perfetto) + host span traces +
+in-kernel event markers.
 
-Reference twofold:
+Reference threefold:
 
 * Intra-kernel profiler (``tools/profiler/language.py:37-128``) — CUDA
   kernels write (sm_id, task, globaltimer) records to a host buffer,
-  exported to perfetto. Mosaic exposes no cycle counter to Pallas kernels,
-  and it doesn't need to: **XLA's TPU profiler already records every op —
-  including each named Pallas kernel — on the device timeline** with
-  sub-kernel DMA/compute breakdowns. ``trace()`` wraps
-  ``jax.profiler.trace`` so a run drops a perfetto-compatible XProf capture;
-  ``annotate()`` scopes regions so fused steps are findable.
+  exported to perfetto. Two TPU answers:
+
+  - **Per-kernel**: XLA's TPU profiler already records every op — including
+    each named Pallas kernel — on the device timeline with sub-kernel
+    DMA/compute breakdowns. ``trace()`` wraps ``jax.profiler.trace``.
+  - **Intra-kernel**: ``KernelTrace`` — kernels append (seq, step, tag, aux)
+    records to an SMEM event buffer via ``prof_mark``. Mosaic exposes no
+    cycle counter to Pallas, so records carry a per-core SEQUENCE number
+    instead of a wall time; because a TPU core executes its grid serially,
+    the sequence IS the schedule, which is exactly what overlap claims need
+    ("expert 0's compute ran before source 3's arrival wait" is an ordering
+    statement). The reference's (sm_id, start, end) rows answer the same
+    question with timestamps because GPU SMs run concurrently.
+
 * Host tracing (``profiler_utils.py:205-290`` ``group_profile``) — the
   reference gathers per-rank torch traces to rank0 and merges them. JAX on
   TPU is single-controller: one process drives every device, so one capture
@@ -21,6 +30,7 @@ Reference twofold:
 from __future__ import annotations
 
 import contextlib
+import dataclasses
 import json
 import time
 
@@ -94,6 +104,87 @@ def profile_op(fn, args, log_dir: str, iters: int = 3):
             out = fn(*args)
         jax.block_until_ready(out)
     return log_dir
+
+
+# --------------------------------------------------------------------------
+# In-kernel event markers (the reference intra-kernel profiler's TPU analog)
+# --------------------------------------------------------------------------
+
+TRACE_COLS = 3  # (step_id, tag, aux) per event; seq is the row index
+
+
+@dataclasses.dataclass(frozen=True)
+class KernelTrace:
+    """Static descriptor for an in-kernel event buffer.
+
+    Usage (kernel author):
+
+        kt = KernelTrace(capacity=256)
+        ... pallas_call(..., out_shape=[..., kt.out_shape],
+                        out_specs=[..., kt.out_spec()])
+        # in the kernel body, with ``ev_ref`` the matching output ref:
+        kt.init(ev_ref)                    # once, at the first grid step
+        kt.mark(ev_ref, step, TAG, aux)    # anywhere, any number of times
+
+    Events append in execution order; the row index is the core's schedule
+    sequence. ``decode()`` turns the returned array into dicts; under
+    shard_map each rank returns its own buffer (stack → merged per-rank
+    trace, the reference's per-SM rows). Overflow beyond ``capacity`` drops
+    events but keeps the count (``n_dropped`` in ``decode``)."""
+
+    capacity: int = 256
+
+    @property
+    def out_shape(self):
+        import jax
+        import jax.numpy as jnp
+
+        # Row 0 is the header [n_events, 0, 0]; events live in rows 1..cap.
+        return jax.ShapeDtypeStruct((self.capacity + 1, TRACE_COLS), jnp.int32)
+
+    def out_spec(self):
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        return pl.BlockSpec(memory_space=pltpu.SMEM)
+
+    def init(self, ev_ref):
+        """Zero the header. Call exactly once (guard with the first grid
+        step); SMEM outputs start uninitialized."""
+        for c in range(TRACE_COLS):
+            ev_ref[0, c] = 0
+
+    def mark(self, ev_ref, step, tag: int, aux=0):
+        """Append one (step, tag, aux) event at the next free row."""
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        n = ev_ref[0, 0]
+        ev_ref[0, 0] = n + 1
+
+        @pl.when(n < self.capacity)
+        def _():
+            row = n + 1
+            ev_ref[row, 0] = jnp.asarray(step, jnp.int32)
+            ev_ref[row, 1] = jnp.asarray(tag, jnp.int32)
+            ev_ref[row, 2] = jnp.asarray(aux, jnp.int32)
+
+    def decode(self, events, tags: dict[int, str] | None = None) -> dict:
+        """Host-side: events (cap+1, 3) int32 (one rank's buffer) →
+        {"events": [{seq, step, tag, aux}...], "n_dropped": int}."""
+        import numpy as np
+
+        ev = np.asarray(events)
+        n = int(ev[0, 0])
+        kept = min(n, self.capacity)
+        out = []
+        for i in range(kept):
+            step, tag, aux = (int(v) for v in ev[1 + i])
+            out.append({
+                "seq": i, "step": step,
+                "tag": tags.get(tag, tag) if tags else tag, "aux": aux,
+            })
+        return {"events": out, "n_dropped": max(0, n - self.capacity)}
 
 
 def device_memory_stats(device=None) -> dict:

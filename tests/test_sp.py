@@ -42,6 +42,111 @@ def test_ring_attention(ctx4, rng, causal):
 
 
 @pytest.mark.parametrize("causal", [True, False])
+def test_ring_attention_2d(ctx24, rng, causal):
+    """DCN-aware hierarchical ring attention on the (2,4) mesh (reference
+    sp_ag_attention_inter_node.py, r3 verdict item 8): sequence sharded
+    over BOTH axes outer-major; the two-level ring must equal single-device
+    flash over the full sequence."""
+    from triton_dist_tpu.kernels.sp import ring_attention_2d_shard
+
+    wo, wi = 2, 4
+    b, hq, hkv, s_loc, d = 1, 4, 2, 32, 32
+    s = wo * wi * s_loc
+    q = jnp.asarray(rng.standard_normal((b, hq, s, d)), jnp.float32) * 0.4
+    k = jnp.asarray(rng.standard_normal((b, hkv, s, d)), jnp.float32) * 0.4
+    v = jnp.asarray(rng.standard_normal((b, hkv, s, d)), jnp.float32) * 0.4
+
+    f = jax.jit(
+        jax.shard_map(
+            lambda q_, k_, v_: ring_attention_2d_shard(
+                q_, k_, v_, axes=("dp", "tp"), causal=causal,
+                block_q=32, block_k=32,
+            ),
+            mesh=ctx24.mesh,
+            in_specs=(P(None, None, ("dp", "tp")),) * 3,
+            out_specs=P(None, None, ("dp", "tp")),
+            check_vma=False,
+        )
+    )
+    out = np.asarray(f(q, k, v))
+    ref = np.asarray(flash_attention(q, k, v, causal=causal,
+                                     block_q=32, block_k=32))
+    np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
+
+
+def _packed_attention_ref(q, k, v, cu_seqlens):
+    """Differentiable dense oracle: causal-within-document softmax over the
+    packed (Hq, T, D) stream; rows beyond cu_seqlens[-1] are zero."""
+    hq, t, d = q.shape
+    hkv = k.shape[0]
+    group = hq // hkv
+    kx = jnp.repeat(k, group, axis=0).astype(jnp.float32)
+    vx = jnp.repeat(v, group, axis=0).astype(jnp.float32)
+    pos = jnp.arange(t)
+    seg = jnp.searchsorted(cu_seqlens[1:], pos, side="right")
+    valid = pos < cu_seqlens[-1]
+    mask = (pos[:, None] >= pos[None, :]) & (seg[:, None] == seg[None, :])
+    mask = mask & valid[:, None] & valid[None, :]
+    s = jnp.einsum("hqd,hkd->hqk", q.astype(jnp.float32), kx) * (d ** -0.5)
+    s = jnp.where(mask[None], s, -jnp.inf)
+    p = jnp.where(valid[None, :, None], jax.nn.softmax(s, axis=-1), 0.0)
+    p = jnp.nan_to_num(p)  # fully-masked (padding) rows
+    return jnp.einsum("hqk,hkd->hqd", p, vx)
+
+
+def test_ring_attention_varlen_packed(ctx4, rng):
+    """Packed 2-doc ring (r3 verdict item 9): ring_attention_shard with
+    GLOBAL cu_seqlens — documents spanning shard boundaries — matches the
+    dense packed oracle; and the differentiable ring
+    (ring_attention_varlen_fn) matches the oracle's gradients, fwd+grad."""
+    from triton_dist_tpu.function import ring_attention_varlen_fn
+
+    hq, hkv, s_loc, d = 4, 2, 32, 32
+    t = WORLD * s_loc  # 128 global; doc 0 spans ranks 0-2, doc 1 the rest
+    cu = jnp.asarray([0, 88, 120], jnp.int32)  # 8 padding rows at the tail
+    q = jnp.asarray(rng.standard_normal((hq, t, d)), jnp.float32) * 0.4
+    k = jnp.asarray(rng.standard_normal((hkv, t, d)), jnp.float32) * 0.4
+    v = jnp.asarray(rng.standard_normal((hkv, t, d)), jnp.float32) * 0.4
+
+    # Inference path: ring_attention_shard(cu_seqlens=...), B == 1.
+    f = jax.jit(
+        jax.shard_map(
+            lambda q_, k_, v_: ring_attention_shard(
+                q_[None], k_[None], v_[None], axis="tp", cu_seqlens=cu,
+                block_q=32, block_k=32,
+            )[0],
+            mesh=ctx4.mesh,
+            in_specs=(P(None, "tp"), P(None, "tp"), P(None, "tp")),
+            out_specs=P(None, "tp"),
+            check_vma=False,
+        )
+    )
+    ref = _packed_attention_ref(q, k, v, cu)
+    np.testing.assert_allclose(np.asarray(f(q, k, v)), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+    # Training path: gradients through the varlen ring == oracle gradients.
+    def ring_loss(q_, k_, v_):
+        o = jax.shard_map(
+            lambda a, b, c: ring_attention_varlen_fn(a, b, c, cu, axis="tp"),
+            mesh=ctx4.mesh,
+            in_specs=(P(None, "tp"), P(None, "tp"), P(None, "tp")),
+            out_specs=P(None, "tp"),
+            check_vma=False,
+        )(q_, k_, v_)
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    def ref_loss(q_, k_, v_):
+        return jnp.sum(_packed_attention_ref(q_, k_, v_, cu) ** 2)
+
+    g_ring = jax.jit(jax.grad(ring_loss, argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    for gr, gf, name in zip(g_ring, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(gr), np.asarray(gf),
+                                   rtol=5e-3, atol=5e-3, err_msg=name)
+
+
+@pytest.mark.parametrize("causal", [True, False])
 def test_ulysses_attention(ctx4, rng, causal):
     b, h, s_loc, d = 1, 8, 64, 32  # h divisible by world (Ulysses constraint)
     s = WORLD * s_loc

@@ -12,8 +12,10 @@ from triton_dist_tpu.function.collectives import (
     ag_gemm_fn,
     flash_attention_fn,
     flash_attention_varlen_fn,
+    flash_attention_varlen_lse_fn,
     flash_attention_lse_fn,
     ring_attention_fn,
+    ring_attention_varlen_fn,
     gemm_rs_fn,
     gemm_ar_fn,
     all_to_all_single_fn,
@@ -25,8 +27,10 @@ __all__ = [
     "ag_gemm_fn",
     "flash_attention_fn",
     "flash_attention_varlen_fn",
+    "flash_attention_varlen_lse_fn",
     "flash_attention_lse_fn",
     "ring_attention_fn",
+    "ring_attention_varlen_fn",
     "gemm_rs_fn",
     "gemm_ar_fn",
     "all_to_all_single_fn",

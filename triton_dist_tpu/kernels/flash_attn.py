@@ -331,7 +331,7 @@ def flash_attention(
 
 
 def _flash_varlen_kernel(
-    q_ref, k_ref, v_ref, qseg_ref, kseg_ref, o_ref, lse_ref,
+    offs_ref, q_ref, k_ref, v_ref, qseg_ref, kseg_ref, o_ref, lse_ref,
     acc_scr, m_scr, l_scr, *, scale, block_q, block_k, n_kv,
 ):
     ik = pl.program_id(2)
@@ -343,9 +343,14 @@ def _flash_varlen_kernel(
         l_scr[...] = jnp.zeros_like(l_scr)
 
     iq = pl.program_id(1)
+    # Ring offsets (see flash_attention's offs): the relative q−kv offset is
+    # all the mask needs; segments already carry global positions.
+    q_off = offs_ref[0] - offs_ref[1] if offs_ref is not None else 0
 
-    # Packed-causal skip: same-segment keys are never ahead of the diagonal.
-    @pl.when(ik * block_k <= iq * block_q + block_q - 1)
+    # Packed-causal skip: same-segment keys are never ahead of the (global)
+    # diagonal. With a dynamic offset this is runtime predication inside a
+    # uniform grid — all ring ranks launch identical programs.
+    @pl.when(ik * block_k <= q_off + iq * block_q + block_q - 1)
     def _():
         q = q_ref[0]
         k = k_ref[0]
@@ -356,11 +361,8 @@ def _flash_varlen_kernel(
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * (scale * LOG2E)
-        q_ids = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-        k_ids = ik * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-        seg_q = qseg_ref[0].reshape(block_q, 1)  # (bq, 1)
-        seg_k = kseg_ref[0].reshape(1, block_k)  # (1, bk)
-        mask = jnp.logical_and(q_ids >= k_ids, seg_q == seg_k)
+        mask = _varlen_mask(iq, ik, block_q, block_k, qseg_ref, kseg_ref,
+                            q_off=q_off)
         s = jnp.where(mask, s, NEG_INF)
 
         m_prev = m_scr[...]
@@ -402,12 +404,20 @@ def flash_attention_varlen(
     block_q: int = 1024,
     block_k: int = 1024,
     return_lse: bool = False,
+    q_offset: jax.Array | None = None,
+    kv_offset: jax.Array | None = None,
 ):
     """Varlen (cu_seqlens) causal flash attention over packed sequences —
     the reference's ``sp_ag_attention_intra_node.py`` varlen path. Tokens
     attend causally within their own segment only; rows in padding segments
     (beyond cu_seqlens[-1]) get zero output. Masking is data (segment-id
-    equality), so the program stays uniform across any SPMD callers."""
+    equality), so the program stays uniform across any SPMD callers.
+
+    ``q_offset``/``kv_offset`` (traced int32 scalars) place this call's Q
+    rows and KV columns in the GLOBAL packed stream — the ring-attention
+    hook, mirroring ``flash_attention``: ``cu_seqlens`` stays global, each
+    ring step passes its shard offsets, and full / diagonal / skipped steps
+    all run the same program (the mask is data)."""
     hq, t, d = q.shape
     hkv = k.shape[0]
     assert hq % hkv == 0
@@ -416,46 +426,64 @@ def flash_attention_varlen(
     block_q = fit_block(t, block_q)
     block_k = fit_block(t, block_k)
     n_kv = t // block_k
+    dynamic = q_offset is not None or kv_offset is not None
 
     # One segment-id source for fwd AND bwd: a sentinel/side drift between
     # them would silently break gradients (saved LSE vs recomputed p).
-    seg_q, seg_k = _varlen_segments(cu_seqlens, t)
+    seg_q, seg_k = _varlen_segments(cu_seqlens, t, q_offset, kv_offset)
 
-    def kv_index(bh, iq_, ik_):
+    def kv_index(bh, iq_, ik_, *_):
         return bh // group, ik_, 0
 
-    out_specs = [pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0))]
+    out_specs = [pl.BlockSpec((1, block_q, d), lambda bh, iq, ik, *_: (bh, iq, 0))]
     out_shape = [jax.ShapeDtypeStruct((hq, t, d), q.dtype)]
     if return_lse:
-        out_specs.append(pl.BlockSpec((1, 1, block_q), lambda bh, iq, ik: (bh, 0, iq)))
+        out_specs.append(
+            pl.BlockSpec((1, 1, block_q), lambda bh, iq, ik, *_: (bh, 0, iq)))
         out_shape.append(jax.ShapeDtypeStruct((hq, 1, t), jnp.float32))
 
     kernel = functools.partial(
         _flash_varlen_kernel, scale=scale, block_q=block_q,
         block_k=block_k, n_kv=n_kv,
     )
+    if dynamic:
+        kernel_fn = (kernel if return_lse else
+                     (lambda *refs: kernel(*refs[:7], None, *refs[7:])))
+    else:
+        kernel_fn = (
+            (lambda *refs: kernel(None, *refs)) if return_lse else
+            (lambda *refs: kernel(None, *refs[:6], None, *refs[6:])))
+    operands = (q, k, v, seg_q, seg_k)
+    if dynamic:
+        offs = jnp.array(
+            [0 if q_offset is None else q_offset,
+             0 if kv_offset is None else kv_offset], jnp.int32)
+        operands = (offs,) + operands
     res = pl.pallas_call(
-        kernel if return_lse else (lambda *refs: kernel(*refs[:6], None, *refs[6:])),
-        grid=(hq, t // block_q, n_kv),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0)),
-            pl.BlockSpec((1, block_k, d), kv_index),
-            pl.BlockSpec((1, block_k, d), kv_index),
-            pl.BlockSpec((1, block_q), lambda bh, iq, ik: (0, iq)),
-            pl.BlockSpec((1, block_k), lambda bh, iq, ik: (0, ik)),
-        ],
-        out_specs=out_specs if return_lse else out_specs[0],
+        kernel_fn,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1 if dynamic else 0,
+            grid=(hq, t // block_q, n_kv),
+            in_specs=[
+                pl.BlockSpec((1, block_q, d), lambda bh, iq, ik, *_: (bh, iq, 0)),
+                pl.BlockSpec((1, block_k, d), kv_index),
+                pl.BlockSpec((1, block_k, d), kv_index),
+                pl.BlockSpec((1, block_q), lambda bh, iq, ik, *_: (0, iq)),
+                pl.BlockSpec((1, block_k), lambda bh, iq, ik, *_: (0, ik)),
+            ],
+            out_specs=out_specs if return_lse else out_specs[0],
+            scratch_shapes=[
+                pltpu.VMEM((block_q, d), jnp.float32),
+                pltpu.VMEM((block_q, LANES), jnp.float32),
+                pltpu.VMEM((block_q, LANES), jnp.float32),
+            ],
+        ),
         out_shape=out_shape if return_lse else out_shape[0],
-        scratch_shapes=[
-            pltpu.VMEM((block_q, d), jnp.float32),
-            pltpu.VMEM((block_q, LANES), jnp.float32),
-            pltpu.VMEM((block_q, LANES), jnp.float32),
-        ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret_mode_default(),
-    )(q, k, v, seg_q, seg_k)
+    )(*operands)
     if return_lse:
         o, lse = res
         return o, lse.reshape(hq, t)
@@ -512,9 +540,13 @@ def _causal_mask(q_off, iq, ik, block_q, block_k):
     return q_ids >= k_ids
 
 
-def _varlen_mask(iq, ik, block_q, block_k, qseg_ref, kseg_ref):
-    """Packed-segment mask: causal within the stream AND same segment."""
-    q_ids = iq * block_q + jax.lax.broadcasted_iota(
+def _varlen_mask(iq, ik, block_q, block_k, qseg_ref, kseg_ref, q_off=0):
+    """Packed-segment mask: causal within the stream AND same segment.
+    ``q_off`` (static 0 or traced ring offset q_offset−kv_offset) places the
+    q rows relative to the visiting KV columns in the GLOBAL packed stream —
+    the segment ids are already global (computed at offset positions), so
+    the pair mask covers full/diagonal/fully-skipped ring steps uniformly."""
+    q_ids = q_off + iq * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
     k_ids = ik * block_k + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 1)
@@ -798,13 +830,22 @@ def flash_attention_bwd(
 # ------------------------------------------------------- varlen backward
 
 
-def _varlen_segments(cu_seqlens: jax.Array, t: int):
-    """Per-position segment ids; Q padding −1, K padding −2 (never match)."""
-    pos = jnp.arange(t, dtype=jnp.int32)
-    seg = jnp.searchsorted(cu_seqlens[1:], pos, side="right").astype(jnp.int32)
-    valid = pos < cu_seqlens[-1]
-    return (jnp.where(valid, seg, -1).reshape(1, t),
-            jnp.where(valid, seg, -2).reshape(1, t))
+def _varlen_segments(cu_seqlens: jax.Array, t: int,
+                     q_offset: jax.Array | None = None,
+                     kv_offset: jax.Array | None = None):
+    """Per-position segment ids; Q padding −1, K padding −2 (never match).
+    ``q_offset``/``kv_offset`` shift the positions into the global packed
+    stream (ring shards); cu_seqlens itself is always global."""
+
+    def seg_at(offset, sentinel):
+        pos = jnp.arange(t, dtype=jnp.int32)
+        if offset is not None:
+            pos = pos + jnp.asarray(offset, jnp.int32)
+        seg = jnp.searchsorted(cu_seqlens[1:], pos, side="right").astype(jnp.int32)
+        valid = pos < cu_seqlens[-1]
+        return jnp.where(valid, seg, sentinel).reshape(1, t)
+
+    return seg_at(q_offset, -1), seg_at(kv_offset, -2)
 
 
 def flash_attention_varlen_bwd(
@@ -819,12 +860,19 @@ def flash_attention_varlen_bwd(
     scale: float | None = None,
     block_q: int = 1024,
     block_k: int = 1024,
+    q_offset: jax.Array | None = None,
+    kv_offset: jax.Array | None = None,
+    dlse: jax.Array | None = None,  # (Hq, T) LSE cotangent (ring merges)
 ):
     """Varlen backward: the dense two-kernel (dq; dk/dv) structure with the
     packed-segment mask — ``(q_id ≥ k_id) ∧ (seg_q == seg_k)`` — replacing
     the causal-offset mask, p recomputed exactly from the saved LSE in the
     exp2 domain. Padding rows carry lse = NEG_INF and o = 0, so their p and
     δ vanish and they contribute nothing. Returns (dq, dk, dv).
+
+    ``q_offset``/``kv_offset``/``dlse`` mirror the dense backward: global
+    ring positions (uniform per-rank programs) and the LSE cotangent folded
+    into δ, so varlen RING training gradients flow per step.
 
     Reference scope note: the reference's varlen attention lives inside its
     SP prefill path and is inference-only; this backward extends the varlen
@@ -838,33 +886,42 @@ def flash_attention_varlen_bwd(
     block_k = fit_block(t, block_k)
     n_q = t // block_q
     n_kv = t // block_k
+    dynamic = q_offset is not None or kv_offset is not None
 
-    seg_q, seg_k = _varlen_segments(cu_seqlens, t)
+    seg_q, seg_k = _varlen_segments(cu_seqlens, t, q_offset, kv_offset)
     lse2 = (lse.astype(jnp.float32) * LOG2E).reshape(hq, 1, t)
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    if dlse is not None:
+        delta = delta - dlse.astype(jnp.float32).reshape(delta.shape)
     delta = delta.reshape(hq, 1, t)
+    offs = (jnp.array(
+        [0 if q_offset is None else q_offset,
+         0 if kv_offset is None else kv_offset], jnp.int32)
+        if dynamic else None)
 
-    def kv_index(bh, iq_, ik_):
+    def kv_index(bh, iq_, ik_, *_):
         return bh // group, ik_, 0
 
-    def dq_kernel(lse2_ref, delta_ref, q_ref, k_ref, v_ref, do_ref,
+    def dq_kernel(offs_ref, lse2_ref, delta_ref, q_ref, k_ref, v_ref, do_ref,
                   qseg_ref, kseg_ref, dq_ref, dq_scr):
         iq = pl.program_id(1)
         ik = pl.program_id(2)
+        q_off = offs_ref[0] - offs_ref[1] if offs_ref is not None else 0
 
         @pl.when(ik == 0)
         def _():
             dq_scr[...] = jnp.zeros_like(dq_scr)
 
         # Packed-causal skip: same-segment keys never lie ahead of the
-        # diagonal of the packed stream.
-        @pl.when(ik * block_k <= iq * block_q + block_q - 1)
+        # (global) diagonal of the packed stream.
+        @pl.when(ik * block_k <= q_off + iq * block_q + block_q - 1)
         def _():
             kk = k_ref[0]
             _, ds = _bwd_p_ds(
                 q_ref[0], kk, do_ref[0], v_ref[0], lse2_ref[0, 0][:, None],
                 delta_ref[0, 0][:, None], sc,
-                _varlen_mask(iq, ik, block_q, block_k, qseg_ref, kseg_ref),
+                _varlen_mask(iq, ik, block_q, block_k, qseg_ref, kseg_ref,
+                             q_off=q_off),
             )
             dq_scr[...] += jax.lax.dot_general(
                 ds.astype(q_ref.dtype), kk, (((1,), (0,)), ((), ())),
@@ -875,57 +932,67 @@ def flash_attention_varlen_bwd(
         def _():
             dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
 
+    dq_kernel_fn = dq_kernel if dynamic else (lambda *refs: dq_kernel(None, *refs))
+    dq_operands = (lse2, delta, q, k, v, do, seg_q, seg_k)
+    if dynamic:
+        dq_operands = (offs,) + dq_operands
     dq = pl.pallas_call(
-        dq_kernel,
-        grid=(hq, n_q, n_kv),
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q), lambda bh, iq, ik: (bh, 0, iq)),
-            pl.BlockSpec((1, 1, block_q), lambda bh, iq, ik: (bh, 0, iq)),
-            pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0)),
-            pl.BlockSpec((1, block_k, d), kv_index),
-            pl.BlockSpec((1, block_k, d), kv_index),
-            pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0)),
-            pl.BlockSpec((1, block_q), lambda bh, iq, ik: (0, iq)),
-            pl.BlockSpec((1, block_k), lambda bh, iq, ik: (0, ik)),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0)),
+        dq_kernel_fn,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1 if dynamic else 0,
+            grid=(hq, n_q, n_kv),
+            in_specs=[
+                pl.BlockSpec((1, 1, block_q), lambda bh, iq, ik, *_: (bh, 0, iq)),
+                pl.BlockSpec((1, 1, block_q), lambda bh, iq, ik, *_: (bh, 0, iq)),
+                pl.BlockSpec((1, block_q, d), lambda bh, iq, ik, *_: (bh, iq, 0)),
+                pl.BlockSpec((1, block_k, d), kv_index),
+                pl.BlockSpec((1, block_k, d), kv_index),
+                pl.BlockSpec((1, block_q, d), lambda bh, iq, ik, *_: (bh, iq, 0)),
+                pl.BlockSpec((1, block_q), lambda bh, iq, ik, *_: (0, iq)),
+                pl.BlockSpec((1, block_k), lambda bh, iq, ik, *_: (0, ik)),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, block_q, d), lambda bh, iq, ik, *_: (bh, iq, 0)),
+            scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        ),
         out_shape=jax.ShapeDtypeStruct((hq, t, d), q.dtype),
-        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret_mode_default(),
-    )(lse2, delta, q, k, v, do, seg_q, seg_k)
+    )(*dq_operands)
 
     n_inner = group * n_q
 
-    def q_row(bh, ik_, jj):
+    def q_row(bh, ik_, jj, *_):
         return bh * group + jj // n_q, jj % n_q, 0
 
-    def q_scalar(bh, ik_, jj):
+    def q_scalar(bh, ik_, jj, *_):
         return bh * group + jj // n_q, 0, jj % n_q
 
-    def qseg_row(bh, ik_, jj):
+    def qseg_row(bh, ik_, jj, *_):
         return 0, jj % n_q
 
-    def dkv_kernel(lse2_ref, delta_ref, q_ref, k_ref, v_ref, do_ref,
+    def dkv_kernel(offs_ref, lse2_ref, delta_ref, q_ref, k_ref, v_ref, do_ref,
                    qseg_ref, kseg_ref, dk_ref, dv_ref, dk_scr, dv_scr):
         ik = pl.program_id(1)
         jj = pl.program_id(2)
         iq = jax.lax.rem(jj, n_q)
+        q_off = offs_ref[0] - offs_ref[1] if offs_ref is not None else 0
 
         @pl.when(jj == 0)
         def _():
             dk_scr[...] = jnp.zeros_like(dk_scr)
             dv_scr[...] = jnp.zeros_like(dv_scr)
 
-        @pl.when(ik * block_k <= iq * block_q + block_q - 1)
+        @pl.when(ik * block_k <= q_off + iq * block_q + block_q - 1)
         def _():
             qq = q_ref[0]
             p, ds = _bwd_p_ds(
                 qq, k_ref[0], do_ref[0], v_ref[0], lse2_ref[0, 0][:, None],
                 delta_ref[0, 0][:, None], sc,
-                _varlen_mask(iq, ik, block_q, block_k, qseg_ref, kseg_ref),
+                _varlen_mask(iq, ik, block_q, block_k, qseg_ref, kseg_ref,
+                             q_off=q_off),
             )
             dv_scr[...] += jax.lax.dot_general(
                 p.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
@@ -941,34 +1008,41 @@ def flash_attention_varlen_bwd(
             dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
             dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
+    dkv_kernel_fn = dkv_kernel if dynamic else (lambda *refs: dkv_kernel(None, *refs))
+    dkv_operands = (lse2, delta, q, k, v, do, seg_q, seg_k)
+    if dynamic:
+        dkv_operands = (offs,) + dkv_operands
     dk, dv = pl.pallas_call(
-        dkv_kernel,
-        grid=(hkv, n_kv, n_inner),
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q), q_scalar),
-            pl.BlockSpec((1, 1, block_q), q_scalar),
-            pl.BlockSpec((1, block_q, d), q_row),
-            pl.BlockSpec((1, block_k, d), lambda bh, ik_, jj: (bh, ik_, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, ik_, jj: (bh, ik_, 0)),
-            pl.BlockSpec((1, block_q, d), q_row),
-            pl.BlockSpec((1, block_q), qseg_row),
-            pl.BlockSpec((1, block_k), lambda bh, ik_, jj: (0, ik_)),
-        ],
-        out_specs=(
-            pl.BlockSpec((1, block_k, d), lambda bh, ik_, jj: (bh, ik_, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, ik_, jj: (bh, ik_, 0)),
+        dkv_kernel_fn,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1 if dynamic else 0,
+            grid=(hkv, n_kv, n_inner),
+            in_specs=[
+                pl.BlockSpec((1, 1, block_q), q_scalar),
+                pl.BlockSpec((1, 1, block_q), q_scalar),
+                pl.BlockSpec((1, block_q, d), q_row),
+                pl.BlockSpec((1, block_k, d), lambda bh, ik_, jj, *_: (bh, ik_, 0)),
+                pl.BlockSpec((1, block_k, d), lambda bh, ik_, jj, *_: (bh, ik_, 0)),
+                pl.BlockSpec((1, block_q, d), q_row),
+                pl.BlockSpec((1, block_q), qseg_row),
+                pl.BlockSpec((1, block_k), lambda bh, ik_, jj, *_: (0, ik_)),
+            ],
+            out_specs=(
+                pl.BlockSpec((1, block_k, d), lambda bh, ik_, jj, *_: (bh, ik_, 0)),
+                pl.BlockSpec((1, block_k, d), lambda bh, ik_, jj, *_: (bh, ik_, 0)),
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((block_k, d), jnp.float32),
+                pltpu.VMEM((block_k, d), jnp.float32),
+            ],
         ),
         out_shape=(
             jax.ShapeDtypeStruct((hkv, t, d), k.dtype),
             jax.ShapeDtypeStruct((hkv, t, d), v.dtype),
         ),
-        scratch_shapes=[
-            pltpu.VMEM((block_k, d), jnp.float32),
-            pltpu.VMEM((block_k, d), jnp.float32),
-        ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret_mode_default(),
-    )(lse2, delta, q, k, v, do, seg_q, seg_k)
+    )(*dkv_operands)
     return dq, dk, dv

@@ -25,7 +25,10 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from triton_dist_tpu.kernels.flash_attn import flash_attention
+from triton_dist_tpu.kernels.flash_attn import (
+    flash_attention,
+    flash_attention_varlen,
+)
 from triton_dist_tpu.kernels.ep_a2a import all_to_all_single_shard
 
 
@@ -99,12 +102,38 @@ def ring_attention_shard(
     scale: float | None = None,
     block_q: int = 256,
     block_k: int = 256,
+    cu_seqlens: jax.Array | None = None,  # GLOBAL packed-doc offsets (B == 1)
 ) -> jax.Array:
     """Exact attention over the full (world·S_local) sequence with Q/K/V
     sequence-sharded (``ring_schedule`` over the Pallas flash kernel).
     Usable inside shard_map. Equivalent to the reference's AG-SP attention
-    where flash consumes shards as they arrive."""
+    where flash consumes shards as they arrive.
+
+    ``cu_seqlens`` switches every ring step to the VARLEN kernel (packed
+    documents, reference ``sp_ag_attention_intra_node.py`` varlen prefill):
+    offsets are GLOBAL positions in the packed stream of the whole ring
+    (length world·S_local); each step passes its shard offsets and the
+    segment mask does the rest — full, diagonal, and cross-document steps
+    all run the same program. Requires B == 1 (packing makes its own batch)
+    and implies causal."""
     world = jax.lax.axis_size(axis)
+    if cu_seqlens is not None:
+        assert q.shape[0] == 1, "packed varlen ring expects B == 1"
+
+        def attend_varlen(q_, k_, v_, q_off, kv_off, causal_step):
+            o, lse = flash_attention_varlen(
+                q_[0], k_[0], v_[0], cu_seqlens, scale=scale,
+                block_q=block_q, block_k=block_k, return_lse=True,
+                q_offset=q_off, kv_offset=kv_off,
+            )
+            return o[None], lse[None]
+
+        if world == 1:
+            zero = jnp.int32(0)
+            return attend_varlen(q, k, v, zero, zero, True)[0]
+        return ring_schedule(q, k, v, axis=axis, causal=True,
+                             attend=attend_varlen)
+
     if world == 1:
         return flash_attention(q, k, v, causal=causal, scale=scale, block_q=block_q, block_k=block_k)
 
@@ -117,6 +146,80 @@ def ring_attention_shard(
         )
 
     return ring_schedule(q, k, v, axis=axis, causal=causal, attend=attend)
+
+
+def ring_attention_2d_shard(
+    q: jax.Array,  # (B, Hq, S_local, D) — this rank's query shard
+    k: jax.Array,  # (B, Hkv, S_local, D)
+    v: jax.Array,
+    *,
+    axes: tuple[str, str],  # (outer/DCN axis, inner/ICI axis)
+    causal: bool = True,
+    scale: float | None = None,
+    block_q: int = 256,
+    block_k: int = 256,
+) -> jax.Array:
+    """DCN-aware hierarchical ring attention (reference inter-node SP
+    attention, ``sp_ag_attention_inter_node.py:1-595``): the sequence is
+    sharded over BOTH mesh axes in outer-major order (rank (d, i) holds
+    global shard ``d·wi + i``), and the ring is two-level —
+
+    * **DCN phases** (outer axis): each rank's resident KV shard moves ONE
+      hop per phase, so each shard crosses the slow axis exactly ``wo−1``
+      times as a big message. The next phase's exchange is issued BEFORE
+      this phase's compute (dataflow permits it), so XLA overlaps the DCN
+      transfer with a whole ICI ring's worth of flash work — the TPU analog
+      of the reference's inter-node AG running under intra-node attention.
+    * **ICI ring** (inner axis): within a phase the visiting superblock
+      rotates ``wi`` steps over the fast axis, one offset-masked flash call
+      per step, exactly ``ring_schedule``'s uniform-program discipline.
+
+    Partials LSE-merge across ALL wo·wi steps — numerically one global
+    softmax. Inside shard_map over both axes."""
+    outer, inner = axes
+    wo = jax.lax.axis_size(outer)
+    wi = jax.lax.axis_size(inner)
+    d_me = jax.lax.axis_index(outer)
+    i_me = jax.lax.axis_index(inner)
+    s_loc = q.shape[2]
+    q_off = ((d_me * wi + i_me) * s_loc).astype(jnp.int32)
+
+    perm_i = [(r, (r + 1) % wi) for r in range(wi)]
+    perm_o = [(r, (r + 1) % wo) for r in range(wo)]
+
+    o = None
+    lse = None
+    k_res, v_res = k, v  # resident shard of the visiting superblock
+    for t in range(wo):  # DCN phase (static unroll)
+        jd = jnp.mod(d_me - t, wo)  # owning DCN group of this superblock
+        k_cur, v_cur = k_res, v_res
+        if t + 1 < wo:
+            # Issue the NEXT superblock's DCN hop now — it rides under this
+            # whole phase's ICI ring compute.
+            k_res = jax.lax.ppermute(k_res, outer, perm_o)
+            v_res = jax.lax.ppermute(v_res, outer, perm_o)
+        for step in range(wi):  # ICI ring within the phase
+            ji = jnp.mod(i_me - step, wi)
+            kv_off = ((jd * wi + ji) * s_loc).astype(jnp.int32)
+            if causal:
+                o_step, lse_step = flash_attention(
+                    q, k_cur, v_cur, causal=True, scale=scale,
+                    block_q=block_q, block_k=block_k, return_lse=True,
+                    q_offset=q_off, kv_offset=kv_off,
+                )
+            else:
+                o_step, lse_step = flash_attention(
+                    q, k_cur, v_cur, causal=False, scale=scale,
+                    block_q=block_q, block_k=block_k, return_lse=True,
+                )
+            if o is None:
+                o, lse = o_step, lse_step
+            else:
+                o, lse = _merge_partials(o, lse, o_step, lse_step)
+            if step + 1 < wi:
+                k_cur = jax.lax.ppermute(k_cur, inner, perm_i)
+                v_cur = jax.lax.ppermute(v_cur, inner, perm_i)
+    return o
 
 
 def ulysses_a2a_qkv(

@@ -80,9 +80,13 @@ def bench_flash(on_tpu):
     else:
         b, hq, hkv, s, d = 1, 2, 1, 256, 64
         dtype = jnp.float32
-    key = jax.random.PRNGKey(1)
-    q = jax.random.normal(key, (b, hq, s, d), jnp.float32).astype(dtype)
-    kv = jax.random.normal(key, (b, hkv, s, d), jnp.float32).astype(dtype)
+    # Distinct q/k/v from split keys (r2 advisor, closed in r4): identical
+    # q==k and k==v tensors give a degenerate attention problem (diagonal
+    # dominance + a rank-deficient pv product) that can flatter either side.
+    kq, kk, kv_key = jax.random.split(jax.random.PRNGKey(1), 3)
+    q = jax.random.normal(kq, (b, hq, s, d), jnp.float32).astype(dtype)
+    k = jax.random.normal(kk, (b, hkv, s, d), jnp.float32).astype(dtype)
+    v = jax.random.normal(kv_key, (b, hkv, s, d), jnp.float32).astype(dtype)
 
     def xla_ref(q_, k_, v_):
         group = hq // hkv
@@ -95,9 +99,9 @@ def bench_flash(on_tpu):
         return jnp.einsum("bhqk,bhkd->bhqd", p, vx)
 
     t_pallas = bench_device_time(
-        lambda q_, k_, v_: flash_attention(q_, k_, v_, causal=True), (q, kv, kv)
+        lambda q_, k_, v_: flash_attention(q_, k_, v_, causal=True), (q, k, v)
     )
-    t_xla = bench_device_time(xla_ref, (q, kv, kv))
+    t_xla = bench_device_time(xla_ref, (q, k, v))
     # Causal FLOPs: ~half the s^2 matmul work, 2 matmuls
     flops = 2 * 2 * b * hq * (s * s / 2) * d
     return {"tflops": flops / t_pallas / 1e12, "vs_xla": t_xla / t_pallas}
@@ -216,6 +220,57 @@ def bench_flash_bwd(on_tpu):
     # ~3.5× the causal forward — 4.5× total is what the timed region does.
     flops = 2 * 2 * b * hq * s * s * d / 2 * 4.5
     return {"tflops": flops / t_ours / 1e12, "vs_xla": t_xla / t_ours}
+
+
+def bench_decode_collectives(on_tpu):
+    """Decode-size collective regime (r3 verdict item 4; reference
+    ``low_latency_allgather.py``/``allreduce.py:216-448``): M ∈ {8, 32, 128}
+    rows × d=4096 bf16 — the per-layer AR sizes the mega decode backend
+    issues. One chip can't measure the ICI wire, so this records the two
+    halves the routing decision needs: (a) the measured KERNEL-OVERHEAD
+    floor of the one-shot push-AR at world=1 (ring degenerate) vs XLA's
+    psum on the same 1-mesh, and (b) the perf model's world=8 ICI latency
+    for the same message. Routing conclusion lives in
+    ``get_auto_all_reduce_method`` (small messages → one-shot; XLA below
+    the crossover where kernel overhead dominates wire time)."""
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from triton_dist_tpu.kernels.allreduce import one_shot_ar_call
+    from triton_dist_tpu.tools.perf_model import allreduce_time_s, chip_spec
+    from triton_dist_tpu.tools.timing import bench_device_time
+
+    if not on_tpu:
+        return {}
+    d = 4096
+    spec = chip_spec()
+    mesh = Mesh(np.asarray(jax.devices()[:1]), ("tp",))
+    out = {}
+    for m in (8, 32, 128):
+        x = jax.random.normal(jax.random.PRNGKey(m), (m, d), jnp.float32).astype(
+            jnp.bfloat16)
+        chain = lambda o, args: (jnp.clip(o.astype(jnp.float32), -1, 1)
+                                 .astype(args[0].dtype),)
+
+        def pallas_ar(x_):
+            return jax.shard_map(
+                lambda y: one_shot_ar_call(y, axis="tp", mesh_axes=("tp",)),
+                mesh=mesh, in_specs=(P(),), out_specs=P(), check_vma=False,
+            )(x_)
+
+        def xla_ar(x_):
+            return jax.shard_map(
+                lambda y: jax.lax.psum(y, "tp"),
+                mesh=mesh, in_specs=(P(),), out_specs=P(), check_vma=False,
+            )(x_)
+
+        t_p = bench_device_time(pallas_ar, (x,), chain=chain, iters=128)
+        t_x = bench_device_time(xla_ar, (x,), chain=chain, iters=128)
+        out[f"ar_oneshot_m{m}_floor_us"] = round(t_p * 1e6, 2)
+        out[f"ar_xla_m{m}_floor_us"] = round(t_x * 1e6, 2)
+        out[f"ar_model_w8_m{m}_wire_us"] = round(
+            allreduce_time_s(m * d * 2, 8, spec) * 1e6, 2)
+    return out
 
 
 def bench_overlap_model(on_tpu, flash_tflops):
@@ -369,20 +424,85 @@ def main():
     except Exception:  # noqa: BLE001 — older jax: flag names differ; skip
         pass
 
+    # ---- streamed emission (r3 verdict item 2) ---------------------------
+    # The driver parses the LAST stdout line; every earlier line is free
+    # salvage. So: after every completed section, print a full well-formed
+    # result line carrying everything measured so far. If the tunnel dies
+    # mid-bench, the last line already printed holds the completed metrics
+    # instead of a bare 0.0.
+    extra = {}
+    primary = {"metric": "flash_attn_causal_bf16_tflops", "value": 0.0,
+               "unit": "TFLOP/s", "vs_baseline": 0.0}
+    state = {"phase": "init"}
+    emit_lock = threading.Lock()
+
+    def emit(error: str | None = None, locked: bool = True):
+        # Snapshot-with-retry: the watchdog thread calls this while the main
+        # thread may be mutating extra — dict() can raise mid-iteration.
+        ex = {}
+        for _ in range(3):
+            try:
+                ex = dict(extra)
+                break
+            except RuntimeError:
+                continue
+        if error:
+            ex["error"] = error
+            ex["phase"] = state["phase"]
+        line = json.dumps({**primary, "extra": ex})
+        if locked:
+            with emit_lock:
+                print(line, flush=True)
+        else:
+            # Watchdog death path: the main thread may be blocked INSIDE a
+            # locked print (full pipe) — bounded-wait for the lock (so a
+            # healthy concurrent print can't interleave and garble the
+            # driver's last line), but never wait unboundedly when the
+            # next step is os._exit.
+            got = emit_lock.acquire(timeout=5.0)
+            try:
+                print(line, flush=True)
+            finally:
+                if got:
+                    emit_lock.release()
+
+    # Test hook: TDT_BENCH_FAKE_HANG=<phase> makes that phase block forever,
+    # standing in for a tunnel that dies mid-bench (exercised by
+    # tests/test_bench_resilience.py; a real hang blocks in C++ the same way).
+    fake_hang = os.environ.get("TDT_BENCH_FAKE_HANG", "")
+
+    def phase(name: str):
+        state["phase"] = name
+        if fake_hang == name:
+            time.sleep(10 ** 6)
+
     # A dead/hung device tunnel blocks jax.devices() inside C++ where no
     # Python timeout can reach — without this watchdog the bench would print
     # NOTHING and the driver records a silent failure. The thread fires only
-    # if the primary JSON line hasn't been printed by 1.5× budget.
+    # if the final JSON line hasn't been printed by 1.5× budget, and dumps
+    # whatever extras have accumulated plus the phase that was in flight —
+    # "hung in phase 'device_probe'" (tunnel dead) reads very differently
+    # from "hung in phase 'flash'" (our kernel).
     printed = threading.Event()
     budget_s = float(os.environ.get("TDT_BENCH_BUDGET_S", "420"))
+    watchdog_s = float(os.environ.get("TDT_BENCH_WATCHDOG_S", budget_s * 1.5))
 
     def _watchdog():
-        if not printed.wait(budget_s * 1.5):
-            print(json.dumps({
-                "metric": "flash_attn_causal_bf16_tflops", "value": 0.0,
-                "unit": "TFLOP/s", "vs_baseline": 0.0,
-                "extra": {"error": "watchdog: device backend hung past budget"},
-            }), flush=True)
+        if not printed.wait(watchdog_s):
+            # The exit must happen even if the salvage print itself blocks
+            # (full pipe) or fails — run it in a side thread with a grace
+            # period, then _exit unconditionally. A dead/stuck watchdog
+            # would reintroduce the silent hang it exists to prevent.
+            def _salvage():
+                try:
+                    emit(error=f"watchdog: hung in phase {state['phase']!r} "
+                               f"past budget", locked=False)
+                except Exception:  # noqa: BLE001
+                    pass
+
+            t = threading.Thread(target=_salvage, daemon=True)
+            t.start()
+            t.join(10.0)
             os._exit(3)
 
     threading.Thread(target=_watchdog, daemon=True).start()
@@ -397,15 +517,51 @@ def main():
     def remaining():
         return budget_s - (time.monotonic() - t_start)
 
-    extra = {}
+    import subprocess
+    import sys
+
+    # ---- startup device probe --------------------------------------------
+    # Before ANYTHING touches the device in-process, ask a subprocess to
+    # name the platform under a hard timeout. Distinguishes "tunnel dead at
+    # startup: devices() never returned" (rc 4, not our bug) from a later
+    # in-kernel hang (rc 3, suspect our code). The probe subprocess also
+    # warms backend init for the mega child.
+    phase("device_probe")
+    probe_timeout = float(os.environ.get(
+        "TDT_BENCH_PROBE_TIMEOUT_S", max(60.0, min(150.0, budget_s * 0.35))
+    ))
+    # TDT_BENCH_PROBE_CODE: test hook standing in for a backend whose
+    # devices() blocks forever (tests/test_bench_resilience.py).
+    probe_code = os.environ.get(
+        "TDT_BENCH_PROBE_CODE", "import jax; print(jax.devices()[0].platform)"
+    )
+    try:
+        pr = subprocess.run(
+            [sys.executable, "-c", probe_code],
+            capture_output=True, text=True, timeout=probe_timeout,
+            cwd=bench_root, env=dict(os.environ),
+        )
+        probe_platform = pr.stdout.strip().splitlines()[-1] if pr.returncode == 0 and pr.stdout.strip() else None
+    except subprocess.TimeoutExpired:
+        probe_platform = None
+    except Exception:  # noqa: BLE001
+        probe_platform = None
+    if probe_platform is None:
+        emit(error=f"tunnel dead at startup: jax.devices() did not answer a "
+                   f"subprocess probe within {probe_timeout:.0f}s")
+        os._exit(4)
+    extra["probe_platform"] = probe_platform
+    # The probe already knows the platform: name the metric correctly from
+    # the first line so salvage/diagnostic lines file under the right key.
+    if probe_platform == "cpu":
+        primary["metric"] = "flash_attn_causal_f32_tflops"
+    emit()
+
     # Heaviest section FIRST, in a subprocess, BEFORE this process touches
     # the device: on an exclusively-held chip a child client couldn't
     # initialize once the parent owns it, and on a tunneled chip the child's
     # remote-compile round-trips need a HARD timeout (the in-process budget
     # can only check between sections). The child reports its own platform.
-    import subprocess
-    import sys
-
     def _mega_attempt(size: str, timeout_s: float) -> bool:
         try:
             r = subprocess.run(
@@ -448,19 +604,39 @@ def main():
     # The fallback window is capped by what the watchdog leaves (it fires
     # at budget*1.5) minus headroom for the primary metric — on tiny
     # budgets the fallback is skipped rather than starving bench_flash.
-    if not _mega_attempt("big", budget_s * 0.45):
-        fallback_window = min(remaining() * 0.5, budget_s * 1.5 - (budget_s - remaining()) - 120)
+    phase("mega_decode")
+
+    def watchdog_remaining():
+        # Time the watchdog leaves before it fires (it measures from start).
+        return watchdog_s - (time.monotonic() - t_start)
+
+    # Both windows are capped by what the WATCHDOG leaves (minus headroom
+    # for the primary metric), not by the soft budget — a shortened
+    # watchdog (TDT_BENCH_WATCHDOG_S) must never fire mid-mega.
+    big_window = min(budget_s * 0.45, watchdog_remaining() - 120)
+    if big_window < 60 or not _mega_attempt("big", big_window):
+        fallback_window = min(remaining() * 0.5, watchdog_remaining() - 120)
         if fallback_window >= 60:
             _mega_attempt("small", fallback_window)
+    emit()
 
+    phase("devices")
     on_tpu = jax.devices()[0].platform != "cpu"
+    primary["metric"] = ("flash_attn_causal_bf16_tflops" if on_tpu
+                         else "flash_attn_causal_f32_tflops")
+    phase("flash")
     f = bench_flash(on_tpu)
+    primary["value"] = round(f["tflops"], 2)
+    # ratio vs XLA's fused SDPA on the same shape/chip
+    primary["vs_baseline"] = round(f["vs_xla"], 3)
+    emit()
     for name, fn in (("gemm", bench_gemm), ("gemm_swiglu", bench_swiglu),
                      ("ag_gemm_fused_w1", bench_ag_gemm_world1),
                      ("flash_bwd", bench_flash_bwd)):
         if remaining() < 60:
             extra[f"{name}_skipped"] = "budget"
             continue
+        phase(name)
         try:
             r = fn(on_tpu)
             extra[f"{name}_tflops"] = round(r["tflops"], 2)
@@ -468,31 +644,33 @@ def main():
                 extra[f"{name}_vs_xla"] = round(r["vs_xla"], 3)
         except Exception as e:  # noqa: BLE001 — extras must not kill the primary metric
             extra[f"{name}_error"] = f"{type(e).__name__}"
+        emit()
     if remaining() > 90:
+        phase("gdn")
         try:
             extra.update(bench_gdn(on_tpu))
         except Exception as e:  # noqa: BLE001
             extra["gdn_error"] = f"{type(e).__name__}"
     else:
         extra["gdn_skipped"] = "budget"
+    emit()
+    if remaining() > 60:
+        phase("decode_collectives")
+        try:
+            extra.update(bench_decode_collectives(on_tpu))
+        except Exception as e:  # noqa: BLE001
+            extra["decode_collectives_error"] = f"{type(e).__name__}"
+        emit()
+    else:
+        extra["decode_collectives_skipped"] = "budget"
+    phase("perf_model")
     try:
         extra.update(bench_overlap_model(on_tpu, f["tflops"]))
     except Exception as e:  # noqa: BLE001
         extra["perf_model_error"] = f"{type(e).__name__}"
 
-    print(
-        json.dumps(
-            {
-                "metric": "flash_attn_causal_bf16_tflops" if on_tpu else "flash_attn_causal_f32_tflops",
-                "value": round(f["tflops"], 2),
-                "unit": "TFLOP/s",
-                # ratio vs XLA's fused SDPA on the same shape/chip
-                "vs_baseline": round(f["vs_xla"], 3),
-                "extra": extra,
-            }
-        ),
-        flush=True,
-    )
+    phase("final")
+    emit()
     printed.set()
 
 

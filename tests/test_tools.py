@@ -238,6 +238,36 @@ def test_flash_config_cache(tmp_path, monkeypatch):
     assert flash_config_for(q, k, v, False) == (1024, 1024)
 
 
+def test_flash_decode_config_cache(tmp_path, monkeypatch):
+    """The --flash-decode sweep's WRITE path and flash_decode_config_for's
+    READ path round-trip through the cache (writer/reader key drift would
+    make the sweep a silent no-op — caught in r4 review: an early reader
+    keyed on (q, kc) while autotune persists under the full timed arg
+    list). Both back-leg lowerings — standalone decode and fused_attn_back
+    — read the SAME key, so their block partitioning can't drift."""
+    import jax
+    import jax.numpy as jnp
+
+    from triton_dist_tpu.kernels.flash_decode import flash_decode_config_for
+    from triton_dist_tpu.tools import tune
+    from triton_dist_tpu.tools.tune_gemm import tune_flash_decode
+
+    monkeypatch.setenv("TDT_TUNE_CACHE", str(tmp_path / "cache.json"))
+    b, hq, hkv, s, d = 1, 2, 1, 128, 32
+    q = jax.ShapeDtypeStruct((b, hq, d), jnp.float32)
+    kc = jax.ShapeDtypeStruct((b, hkv, s, d), jnp.float32)
+    vc = jax.ShapeDtypeStruct((b, hkv, s, d), jnp.float32)
+    # Miss → default 256.
+    tune._default_cache = None
+    assert flash_decode_config_for(q, kc, vc) == 256
+    # THE REAL WRITE PATH: run the sweep (s=128 admits only block_k=128,
+    # so the winner provably differs from the 256 default).
+    best, _ = tune_flash_decode(b, hq, hkv, s, d, jnp.float32, verbose=False)
+    assert best == {"block_k": 128}
+    tune._default_cache = None
+    assert flash_decode_config_for(q, kc, vc) == 128
+
+
 def test_flash_bwd_config_cache(tmp_path, monkeypatch):
     """flash_attention_bwd consults its own tune-cache key at trace time,
     falling back to the FORWARD's tuned blocks (bwd and fwd optima track

@@ -31,6 +31,29 @@ from triton_dist_tpu.runtime.platform import interpret_mode_default
 
 LANES = 128
 NEG_INF = -1e30
+DEFAULT_BLOCK_K = 256
+
+
+def flash_decode_op_name() -> str:
+    """Tune-cache op key (single source for the kernel lookup and the
+    offline ``tools.tune_gemm --flash-decode`` sweep)."""
+    return "flash_decode"
+
+
+def flash_decode_config_for(q_sds, k_sds, v_sds) -> int:
+    """Trace-time tuned block_k lookup for the decode sweep (offline
+    ``tools.tune_gemm --flash-decode`` fills the cache). The key is the
+    FULL (q, k_cache, v_cache) signature — exactly the arg list
+    ``autotune`` times and persists under, same convention as
+    ``flash_attn.flash_config_for`` (a reader keying on fewer args than
+    the writer would silently never hit). Falls back to the 256 default —
+    ``fit_block`` shrinks it for short caches."""
+    from triton_dist_tpu.tools.tune import lookup
+
+    hit = lookup(flash_decode_op_name(), [q_sds, k_sds, v_sds])
+    if hit:
+        return int(hit["block_k"])
+    return DEFAULT_BLOCK_K
 
 
 def _decode_kernel(
@@ -103,11 +126,14 @@ def flash_decode(
     lengths: jax.Array,  # (B,) int32 — valid cache length per sequence
     *,
     scale: float | None = None,
-    block_k: int = 256,
+    block_k: int | None = None,
     return_lse: bool = False,
 ):
     """One-token GQA decode against a padded KV cache. Returns ``o``
-    (B, Hq, D) (+ ``lse`` (B, Hq) fp32 if requested)."""
+    (B, Hq, D) (+ ``lse`` (B, Hq) fp32 if requested). ``block_k=None``
+    reads the tune cache (offline ``--flash-decode`` sweep) so every
+    caller — engine backends, the fused attention back-leg — lands on the
+    same swept block."""
     b, hq, d = q.shape
     _, hkv, s, _ = k_cache.shape
     assert hq % hkv == 0
@@ -115,6 +141,12 @@ def flash_decode(
     scale = scale if scale is not None else d ** -0.5
     from triton_dist_tpu.kernels.gemm import fit_block
 
+    if block_k is None:
+        block_k = flash_decode_config_for(
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct(k_cache.shape, k_cache.dtype),
+            jax.ShapeDtypeStruct(v_cache.shape, v_cache.dtype),
+        )
     block_k = fit_block(s, block_k)
     n_kv = s // block_k
 
@@ -179,7 +211,7 @@ def dist_flash_decode_shard(
     *,
     axis: str = "sp",
     scale: float | None = None,
-    block_k: int = 256,
+    block_k: int | None = None,
 ) -> jax.Array:
     """Sequence-sharded distributed decode, usable inside shard_map.
 

@@ -256,7 +256,6 @@ class ModelBuilder:
                 b = q.shape[0]
                 partial = fused_attn_back(
                     q, k_new, v_new, ks[li], vs[li], lengths, lp[wo_p],
-                    block_k=min(256, ks.shape[3]),
                 )  # (B, d_model) f32 o-proj partial
                 # Same rounding points as gemm_ar_shard's decode (ONE_SHOT)
                 # path: cast the partial to model dtype, then all-reduce.
@@ -406,7 +405,6 @@ class ModelBuilder:
                 b = q.shape[0]
                 env[t.outputs[0]] = flash_decode(
                     q, ks[li], vs[li], lengths + 1,
-                    block_k=min(256, ks.shape[3]),
                 ).reshape(b, hq * hd)
             return standalone_flash_decode
 

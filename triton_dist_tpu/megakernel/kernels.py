@@ -415,7 +415,7 @@ def fused_attn_back(
     wo: jax.Array,  # (Hq*D, n) — o-projection shard (TP rows)
     *,
     scale: float | None = None,
-    block_k: int = 256,
+    block_k: int | None = None,
     vmem_limit_mb: int | None = 100,
 ) -> jax.Array:
     """cache_update → flash_decode → o-proj partial in ONE kernel (the
@@ -438,8 +438,18 @@ def fused_attn_back(
     n = wo.shape[1]
     assert wo.shape[0] == hq * d, (wo.shape, hq, d)
     scale = scale if scale is not None else d ** -0.5
+    from triton_dist_tpu.kernels.flash_decode import flash_decode_config_for
     from triton_dist_tpu.kernels.gemm import fit_block
 
+    if block_k is None:
+        # Same tune-cache key as the standalone flash_decode — both
+        # lowerings of the attention back-leg land on the same swept block
+        # (bit-parity requires identical partitioning).
+        block_k = flash_decode_config_for(
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct(k_cache.shape, k_cache.dtype),
+            jax.ShapeDtypeStruct(v_cache.shape, v_cache.dtype),
+        )
     block_k = fit_block(s, block_k)
     n_kv = s // block_k
 

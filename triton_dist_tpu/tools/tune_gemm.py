@@ -127,6 +127,43 @@ def tune_flash_bwd(b, hq, hkv, s, d, dtype, *, causal: bool = True,
     return best, t
 
 
+def tune_flash_decode(b, hq, hkv, s, d, dtype, *, verbose: bool = True):
+    """Sweep the decode kernel's KV block for one (B, H, S_cache, D) shape
+    and persist the winner; ``flash_decode_config_for`` reads it at trace
+    time — BOTH the standalone decode and the fused attention back-leg
+    consume the same cache entry (their partitioning must match for
+    bit-parity). Reference: the AOT flash-decode configs per (batch,
+    split) (``flash_decode.py:763-1131``)."""
+    from triton_dist_tpu.kernels.flash_decode import (
+        flash_decode,
+        flash_decode_op_name,
+    )
+
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, hq, d), jnp.float32).astype(dtype)
+    kc = jax.random.normal(kk, (b, hkv, s, d), jnp.float32).astype(dtype)
+    vc = jax.random.normal(kv, (b, hkv, s, d), jnp.float32).astype(dtype)
+    lengths = jnp.full((b,), s - 1, jnp.int32)
+    space = [{"block_k": bk} for bk in (128, 256, 512, 1024, 2048)
+             if s % bk == 0]
+    if not space:
+        space = [{"block_k": 256}]
+    best, t = autotune(
+        flash_decode_op_name(),
+        space,
+        lambda cfg: (lambda q_, kc_, vc_: flash_decode(
+            q_, kc_, vc_, lengths, **cfg)),
+        (q, kc, vc),
+        verbose=verbose,
+    )
+    if verbose:
+        gb = 2 * b * hkv * s * d * q.dtype.itemsize / 1e9
+        print(f"[tune_flash_decode] b{b} h{hq}/{hkv} s{s} d{d}: best {best} "
+              f"{gb / t:.0f} GB/s cache-stream")
+    return best, t
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--mkn", type=int, nargs="*", default=[2048, 4096, 8192])
@@ -138,6 +175,9 @@ def main():
                    help="also tune the flash backward (grad step) at this shape")
     p.add_argument("--non-causal", action="store_true",
                    help="tune the non-causal flash cache key instead")
+    p.add_argument("--flash-decode", type=int, nargs=5,
+                   metavar=("B", "HQ", "HKV", "S_CACHE", "D"),
+                   help="also tune the decode kernel's KV block at this shape")
     p.add_argument("-q", "--quiet", action="store_true")
     args = p.parse_args()
     dtype = jnp.dtype(args.dtype)
@@ -149,6 +189,8 @@ def main():
     if args.flash_bwd:
         tune_flash_bwd(*args.flash_bwd, dtype, causal=not args.non_causal,
                        verbose=not args.quiet)
+    if args.flash_decode:
+        tune_flash_decode(*args.flash_decode, dtype, verbose=not args.quiet)
     print(f"cache: {default_cache().path}")
 
 

@@ -242,6 +242,9 @@ def bench_decode_collectives(on_tpu):
 
     if not on_tpu:
         return {}
+    from triton_dist_tpu.kernels.allgather import full_mesh_ag_call
+    from triton_dist_tpu.tools.perf_model import allgather_time_s
+
     d = 4096
     spec = chip_spec()
     mesh = Mesh(np.asarray(jax.devices()[:1]), ("tp",))
@@ -264,12 +267,22 @@ def bench_decode_collectives(on_tpu):
                 mesh=mesh, in_specs=(P(),), out_specs=P(), check_vma=False,
             )(x_)
 
+        def pallas_ag(x_):
+            return jax.shard_map(
+                lambda y: full_mesh_ag_call(y, axis="tp", mesh_axes=("tp",))[0],
+                mesh=mesh, in_specs=(P(),), out_specs=P(), check_vma=False,
+            )(x_)
+
         t_p = bench_device_time(pallas_ar, (x,), chain=chain, iters=128)
         t_x = bench_device_time(xla_ar, (x,), chain=chain, iters=128)
+        t_g = bench_device_time(pallas_ag, (x,), chain=chain, iters=128)
         out[f"ar_oneshot_m{m}_floor_us"] = round(t_p * 1e6, 2)
         out[f"ar_xla_m{m}_floor_us"] = round(t_x * 1e6, 2)
+        out[f"ag_fullmesh_m{m}_floor_us"] = round(t_g * 1e6, 2)
         out[f"ar_model_w8_m{m}_wire_us"] = round(
             allreduce_time_s(m * d * 2, 8, spec) * 1e6, 2)
+        out[f"ag_model_w8_m{m}_wire_us"] = round(
+            allgather_time_s(8 * m * d * 2, 8, spec) * 1e6, 2)
     return out
 
 

@@ -192,6 +192,17 @@ def _ag_pallas(shard, *, axis, mesh_axes, method, straggler=None):
     return out
 
 
+def full_mesh_ag_call(shard, *, axis, mesh_axes=None):
+    """Direct entry to the full-mesh push-AG kernel, bypassing the AUTO
+    routing and ``all_gather_shard``'s world==1 XLA fallback — the
+    decode-size bench's kernel-overhead-floor probe (symmetric with
+    ``allreduce.one_shot_ar_call``). Returns ``(world, *shard)``."""
+    return _ag_pallas(
+        shard, axis=axis, mesh_axes=mesh_axes,
+        method=AllGatherMethod.FULL_MESH_PUSH,
+    )
+
+
 def all_gather_shard(
     shard: jax.Array,
     *,

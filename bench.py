@@ -701,6 +701,36 @@ def main():
     # ratio vs XLA's fused SDPA on the same shape/chip
     primary["vs_baseline"] = round(f["vs_xla"], 3)
     emit()
+    # In-bench flash block sweep: only when the tune cache shipped without
+    # a flash entry (the offline sweep needs a chip session) AND budget
+    # allows — the driver's chip is the one place the measurement can land.
+    if on_tpu:
+        try:
+            from triton_dist_tpu.kernels.flash_attn import (
+                DEFAULT_BLOCK_K, DEFAULT_BLOCK_Q, flash_config_for,
+            )
+
+            bq, hqq, hkvq, sq, dq = FLASH_SHAPE
+            cache_cold = flash_config_for(
+                jax.ShapeDtypeStruct((bq, hqq, sq, dq), jnp.bfloat16),
+                jax.ShapeDtypeStruct((bq, hkvq, sq, dq), jnp.bfloat16),
+                jax.ShapeDtypeStruct((bq, hkvq, sq, dq), jnp.bfloat16),
+                True,
+            ) == (DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K)
+        except Exception:  # noqa: BLE001 — a corrupt cache must not kill the bench
+            cache_cold = False
+        if not cache_cold:
+            extra["flash_sweep_skipped"] = "cache already tuned"
+        elif remaining() <= 180:
+            extra["flash_sweep_skipped"] = "budget"
+        else:
+            phase("flash_mini_sweep")
+            try:
+                extra.update(bench_flash_mini_sweep(on_tpu, f["tflops"],
+                                                    remaining))
+            except Exception as e:  # noqa: BLE001
+                extra["flash_sweep_error"] = f"{type(e).__name__}"
+            emit()
     for name, fn in (("gemm", bench_gemm), ("gemm_swiglu", bench_swiglu),
                      ("ag_gemm_fused_w1", bench_ag_gemm_world1),
                      ("flash_bwd", bench_flash_bwd)):
@@ -734,36 +764,6 @@ def main():
         emit()
     else:
         extra["decode_collectives_skipped"] = "budget"
-    # In-bench flash block sweep: only when the tune cache shipped without
-    # a flash entry (the offline sweep needs a chip session) AND budget
-    # allows — the driver's chip is the one place the measurement can land.
-    if on_tpu:
-        try:
-            from triton_dist_tpu.kernels.flash_attn import (
-                DEFAULT_BLOCK_K, DEFAULT_BLOCK_Q, flash_config_for,
-            )
-
-            bq, hqq, hkvq, sq, dq = FLASH_SHAPE
-            cache_cold = flash_config_for(
-                jax.ShapeDtypeStruct((bq, hqq, sq, dq), jnp.bfloat16),
-                jax.ShapeDtypeStruct((bq, hkvq, sq, dq), jnp.bfloat16),
-                jax.ShapeDtypeStruct((bq, hkvq, sq, dq), jnp.bfloat16),
-                True,
-            ) == (DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K)
-        except Exception:  # noqa: BLE001 — a corrupt cache must not kill the bench
-            cache_cold = False
-        if not cache_cold:
-            extra["flash_sweep_skipped"] = "cache already tuned"
-        elif remaining() <= 180:
-            extra["flash_sweep_skipped"] = "budget"
-        else:
-            phase("flash_mini_sweep")
-            try:
-                extra.update(bench_flash_mini_sweep(on_tpu, f["tflops"],
-                                                    remaining))
-            except Exception as e:  # noqa: BLE001
-                extra["flash_sweep_error"] = f"{type(e).__name__}"
-            emit()
     phase("perf_model")
     try:
         extra.update(bench_overlap_model(on_tpu, f["tflops"]))

@@ -322,3 +322,46 @@ def test_ep_moe_fused_kernel_layer(ctx8, rng):
             )(x, wr, wg, wu, wd)
         )
     np.testing.assert_allclose(outs[True], outs[False], rtol=2e-4, atol=2e-4)
+
+
+def test_sp_attention_layers(ctx24, rng):
+    """The SP layer wrappers (RingSPAttn incl. the r4 varlen path,
+    Ring2DSPAttn) produce the same attention as the single-device flash
+    kernel — the layer-level surface over the tested kernels."""
+    from triton_dist_tpu.kernels.flash_attn import (
+        flash_attention,
+        flash_attention_varlen,
+    )
+    from triton_dist_tpu.layers import Ring2DSPAttn, RingSPAttn
+
+    wo, wi = 2, 4
+    hq, hkv, s_loc, d = 4, 2, 16, 32
+    s = wo * wi * s_loc
+    q = jnp.asarray(rng.standard_normal((1, hq, s, d)), jnp.float32) * 0.4
+    k = jnp.asarray(rng.standard_normal((1, hkv, s, d)), jnp.float32) * 0.4
+    v = jnp.asarray(rng.standard_normal((1, hkv, s, d)), jnp.float32) * 0.4
+
+    # 2D ring layer on the (dp, tp) mesh.
+    layer2d = Ring2DSPAttn(axes=("dp", "tp"), block_q=16, block_k=16)
+    out2d = jax.jit(jax.shard_map(
+        layer2d, mesh=ctx24.mesh,
+        in_specs=(P(None, None, ("dp", "tp")),) * 3,
+        out_specs=P(None, None, ("dp", "tp")), check_vma=False,
+    ))(q, k, v)
+    ref = flash_attention(q, k, v, causal=True, block_q=16, block_k=16)
+    np.testing.assert_allclose(np.asarray(out2d), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+    # Varlen ring layer: a 4-rank ring over the tp axis (dp replicated).
+    cu = jnp.asarray([0, (s * 3) // 4, s - 8], jnp.int32)
+    layer_vl = RingSPAttn(axis="tp", block_q=16, block_k=16)
+    out_vl = jax.jit(jax.shard_map(
+        lambda q_, k_, v_: layer_vl(q_, k_, v_, cu_seqlens=cu),
+        mesh=ctx24.mesh,
+        in_specs=(P(None, None, "tp"),) * 3,
+        out_specs=P(None, None, "tp"), check_vma=False,
+    ))(q, k, v)
+    ref_vl = flash_attention_varlen(q[0], k[0], v[0], cu,
+                                    block_q=16, block_k=16)
+    np.testing.assert_allclose(np.asarray(out_vl[0]), np.asarray(ref_vl),
+                               rtol=2e-4, atol=2e-4)

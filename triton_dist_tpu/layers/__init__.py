@@ -12,7 +12,7 @@ from triton_dist_tpu.layers.tp import TP_MLP, TP_Attn, TP_MoE, RMSNorm
 from triton_dist_tpu.layers.pp import PPCommLayer
 from triton_dist_tpu.layers.pp_schedule import gpipe_forward, gpipe_stage_params
 from triton_dist_tpu.layers.ep import EP_MoE
-from triton_dist_tpu.layers.sp import UlyssesSPAttn, RingSPAttn
+from triton_dist_tpu.layers.sp import Ring2DSPAttn, RingSPAttn, UlyssesSPAttn
 
 __all__ = [
     "TP_MLP",
@@ -25,4 +25,5 @@ __all__ = [
     "EP_MoE",
     "UlyssesSPAttn",
     "RingSPAttn",
+    "Ring2DSPAttn",
 ]

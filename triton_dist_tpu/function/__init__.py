@@ -15,6 +15,7 @@ from triton_dist_tpu.function.collectives import (
     flash_attention_varlen_lse_fn,
     flash_attention_lse_fn,
     ring_attention_fn,
+    ring_attention_2d_fn,
     ring_attention_varlen_fn,
     gemm_rs_fn,
     gemm_ar_fn,
@@ -30,6 +31,7 @@ __all__ = [
     "flash_attention_varlen_lse_fn",
     "flash_attention_lse_fn",
     "ring_attention_fn",
+    "ring_attention_2d_fn",
     "ring_attention_varlen_fn",
     "gemm_rs_fn",
     "gemm_ar_fn",

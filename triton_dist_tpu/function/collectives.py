@@ -287,6 +287,18 @@ def _flash_lse_bwd(causal, scale, block_q, block_k, res, cots):
 flash_attention_lse_fn.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
+def _flash_lse_attend(scale, block_q, block_k):
+    """The differentiable ring-step attend closure, ONE copy shared by the
+    1D and 2D training rings (``flash_attention_lse_fn`` per step)."""
+
+    def attend(q_, k_, v_, q_off, kv_off, causal_step):
+        return flash_attention_lse_fn(
+            q_, k_, v_, q_off, kv_off, causal_step, scale, block_q, block_k
+        )
+
+    return attend
+
+
 def ring_attention_fn(
     q, k, v, *, axis: str = "sp", causal: bool = True, scale=None,
     block_q: int = 256, block_k: int = 256,
@@ -308,12 +320,25 @@ def ring_attention_fn(
             q, k, v, zero, zero, causal, scale, block_q, block_k
         )[0]
 
-    def attend(q_, k_, v_, q_off, kv_off, causal_step):
-        return flash_attention_lse_fn(
-            q_, k_, v_, q_off, kv_off, causal_step, scale, block_q, block_k
-        )
+    return ring_schedule(q, k, v, axis=axis, causal=causal,
+                         attend=_flash_lse_attend(scale, block_q, block_k))
 
-    return ring_schedule(q, k, v, axis=axis, causal=causal, attend=attend)
+
+def ring_attention_2d_fn(
+    q, k, v, *, axes, causal: bool = True, scale=None,
+    block_q: int = 256, block_k: int = 256,
+):
+    """DIFFERENTIABLE two-level (DCN × ICI) ring attention — long-context
+    TRAINING at the scale ``kernels.sp.ring_attention_2d_shard`` serves
+    for inference: same ``ring_2d_schedule`` (superblock DCN hops issued a
+    phase early, ICI rings inside), each step a ``flash_attention_lse_fn``
+    whose backward is the Pallas kernel pair; ppermutes transpose to the
+    reverse rotations under ``jax.grad``. Inside shard_map over both
+    axes."""
+    from triton_dist_tpu.kernels.sp import ring_2d_schedule
+
+    return ring_2d_schedule(q, k, v, axes=axes, causal=causal,
+                            attend=_flash_lse_attend(scale, block_q, block_k))
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(6,))

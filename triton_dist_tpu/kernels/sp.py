@@ -92,6 +92,21 @@ def ring_schedule(q, k, v, *, axis: str, causal: bool, attend) -> jax.Array:
     return o
 
 
+def _flash_attend(scale, block_q, block_k):
+    """The ring-step attend closure (``ring_schedule`` contract), ONE copy
+    shared by the 1D and 2D inference rings."""
+
+    def attend(q_, k_, v_, q_off, kv_off, causal_step):
+        return flash_attention(
+            q_, k_, v_, causal=causal_step, scale=scale,
+            block_q=block_q, block_k=block_k, return_lse=True,
+            q_offset=q_off if causal_step else None,
+            kv_offset=kv_off if causal_step else None,
+        )
+
+    return attend
+
+
 def ring_attention_shard(
     q: jax.Array,  # (B, Hq, S_local, D) — this rank's query shard
     k: jax.Array,  # (B, Hkv, S_local, D) — this rank's KV shard
@@ -137,15 +152,8 @@ def ring_attention_shard(
     if world == 1:
         return flash_attention(q, k, v, causal=causal, scale=scale, block_q=block_q, block_k=block_k)
 
-    def attend(q_, k_, v_, q_off, kv_off, causal_step):
-        return flash_attention(
-            q_, k_, v_, causal=causal_step, scale=scale,
-            block_q=block_q, block_k=block_k, return_lse=True,
-            q_offset=q_off if causal_step else None,
-            kv_offset=kv_off if causal_step else None,
-        )
-
-    return ring_schedule(q, k, v, axis=axis, causal=causal, attend=attend)
+    return ring_schedule(q, k, v, axis=axis, causal=causal,
+                         attend=_flash_attend(scale, block_q, block_k))
 
 
 def ring_attention_2d_shard(
@@ -176,6 +184,17 @@ def ring_attention_2d_shard(
 
     Partials LSE-merge across ALL wo·wi steps — numerically one global
     softmax. Inside shard_map over both axes."""
+
+    return ring_2d_schedule(q, k, v, axes=axes, causal=causal,
+                            attend=_flash_attend(scale, block_q, block_k))
+
+
+def ring_2d_schedule(q, k, v, *, axes, causal: bool, attend) -> jax.Array:
+    """THE two-level ring driver, shared by the inference 2D ring and the
+    differentiable ``function.ring_attention_2d_fn`` (same one-copy
+    discipline as ``ring_schedule``). ``attend`` has the
+    ``ring_schedule`` contract: uniform per-rank programs, offsets as
+    data."""
     outer, inner = axes
     wo = jax.lax.axis_size(outer)
     wi = jax.lax.axis_size(inner)
@@ -183,6 +202,7 @@ def ring_attention_2d_shard(
     i_me = jax.lax.axis_index(inner)
     s_loc = q.shape[2]
     q_off = ((d_me * wi + i_me) * s_loc).astype(jnp.int32)
+    zero = jnp.int32(0)
 
     perm_i = [(r, (r + 1) % wi) for r in range(wi)]
     perm_o = [(r, (r + 1) % wo) for r in range(wo)]
@@ -202,16 +222,9 @@ def ring_attention_2d_shard(
             ji = jnp.mod(i_me - step, wi)
             kv_off = ((jd * wi + ji) * s_loc).astype(jnp.int32)
             if causal:
-                o_step, lse_step = flash_attention(
-                    q, k_cur, v_cur, causal=True, scale=scale,
-                    block_q=block_q, block_k=block_k, return_lse=True,
-                    q_offset=q_off, kv_offset=kv_off,
-                )
+                o_step, lse_step = attend(q, k_cur, v_cur, q_off, kv_off, True)
             else:
-                o_step, lse_step = flash_attention(
-                    q, k_cur, v_cur, causal=False, scale=scale,
-                    block_q=block_q, block_k=block_k, return_lse=True,
-                )
+                o_step, lse_step = attend(q, k_cur, v_cur, zero, zero, False)
             if o is None:
                 o, lse = o_step, lse_step
             else:

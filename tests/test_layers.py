@@ -348,9 +348,9 @@ def test_sp_attention_layers(ctx24, rng):
         in_specs=(P(None, None, ("dp", "tp")),) * 3,
         out_specs=P(None, None, ("dp", "tp")), check_vma=False,
     ))(q, k, v)
+    out2d = np.asarray(out2d)  # materialize before dispatching the oracle
     ref = flash_attention(q, k, v, causal=True, block_q=16, block_k=16)
-    np.testing.assert_allclose(np.asarray(out2d), np.asarray(ref),
-                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(out2d, np.asarray(ref), rtol=2e-4, atol=2e-4)
 
     # Varlen ring layer: a 4-rank ring over the tp axis (dp replicated).
     cu = jnp.asarray([0, (s * 3) // 4, s - 8], jnp.int32)
@@ -361,6 +361,7 @@ def test_sp_attention_layers(ctx24, rng):
         in_specs=(P(None, None, "tp"),) * 3,
         out_specs=P(None, None, "tp"), check_vma=False,
     ))(q, k, v)
+    out_vl = np.asarray(out_vl)  # materialize before dispatching the oracle
     ref_vl = flash_attention_varlen(q[0], k[0], v[0], cu,
                                     block_q=16, block_k=16)
     np.testing.assert_allclose(np.asarray(out_vl[0]), np.asarray(ref_vl),

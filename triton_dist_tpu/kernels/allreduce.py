@@ -44,10 +44,35 @@ class AllReduceMethod(enum.Enum):
     XLA = "xla"
 
 
+#: Static fallback crossover (bytes): used when no measured entry exists in
+#: the tune cache for this chip. 256 KiB is the analytic guess — below it the
+#: (world-1)× egress of one-shot costs less than two-shot's extra latency.
+DEFAULT_AR_CROSSOVER_BYTES = 256 * 1024
+
+
+def ar_crossover_bytes(world: int) -> int:
+    """One-shot↔two-shot routing threshold, fed from DATA when available:
+    the bench's decode-collective section measures per-method floors and
+    emits a cache-ready ``ar_crossover|world=<w>`` entry (see
+    ``bench.py`` decode collectives); this looks it up on the current chip's
+    tune cache and falls back to the static guess otherwise."""
+    from triton_dist_tpu.tools.tune import default_cache
+
+    hit = default_cache().get(f"ar_crossover|world={world}")
+    if hit:
+        try:
+            return int(hit["cfg"]["crossover_bytes"])
+        except (KeyError, TypeError, ValueError):
+            pass
+    return DEFAULT_AR_CROSSOVER_BYTES
+
+
 def get_auto_all_reduce_method(nbytes: int, world: int) -> AllReduceMethod:
     """Reference ``get_auto_all_reduce_method`` (``kernels/allreduce.py:75``):
-    latency-bound small messages → one-shot; bandwidth-bound → two-shot."""
-    if nbytes <= 256 * 1024:
+    latency-bound small messages → one-shot; bandwidth-bound → two-shot.
+    The threshold is a tune-cache lookup (measured crossover) with the
+    static ``DEFAULT_AR_CROSSOVER_BYTES`` as fallback."""
+    if nbytes <= ar_crossover_bytes(world):
         return AllReduceMethod.ONE_SHOT
     return AllReduceMethod.TWO_SHOT
 

@@ -51,6 +51,10 @@ class TuneCache:
     def get(self, key: str) -> dict | None:
         return self._data.get(key)
 
+    def has_op(self, op_name: str) -> bool:
+        """True if ANY entry exists for ``op_name`` (any arg signature)."""
+        return any(k.startswith(op_name + "|") for k in self._data)
+
     def put(self, key: str, value: dict) -> None:
         self._data[key] = value
 
@@ -88,6 +92,43 @@ def lookup(op_name: str, args: Sequence, cache: TuneCache | None = None) -> dict
     cache = cache or default_cache()
     hit = cache.get(f"{op_name}|{arg_signature(args)}")
     return dict(hit["cfg"]) if hit else None
+
+
+def make_entry(op_name: str, args: Sequence, cfg: dict, time_s: float) -> tuple[str, dict]:
+    """Build one cache-ready ``(key, value)`` pair in EXACTLY the format
+    ``autotune`` persists and ``lookup`` reads. Single source for the key
+    format so an unattended producer (the driver bench's mini-sweeps emit
+    ``tune_entries`` in their JSON extras) can never drift from the reader
+    — ``tests/test_tools.py`` round-trips emitted entries through
+    :func:`merge_entries` into a live lookup."""
+    return (
+        f"{op_name}|{arg_signature(args)}",
+        {"cfg": _as_dict(cfg), "time_s": float(time_s), "version": __version__},
+    )
+
+
+def merge_entries(entries: dict, cache: TuneCache | None = None) -> TuneCache:
+    """Merge ``{key: {"cfg": ..., "time_s": ..., "version": ...}}`` (the
+    bench's ``tune_entries`` extras, or any hand-built dict in the same
+    format) into the cache file and save. Returns the cache for chaining.
+    This is the offline half of the unattended-tuning loop: copy the
+    driver's emitted ``tune_entries`` JSON into this and the next trace
+    picks the measured configs up."""
+    cache = cache or default_cache()
+    # Validate EVERYTHING before the first put(): a malformed entry midway
+    # must not leave the shared in-memory cache half-merged (and later
+    # unrelated save() calls would silently persist the half-merge).
+    normalized = {}
+    for key, value in entries.items():
+        if not isinstance(value, dict) or "cfg" not in value:
+            raise ValueError(f"malformed tune entry for {key!r}: {value!r}")
+        normalized[key] = {"cfg": dict(value["cfg"]),
+                           "time_s": float(value.get("time_s", 0.0)),
+                           "version": value.get("version", __version__)}
+    for key, value in normalized.items():
+        cache.put(key, value)
+    cache.save()
+    return cache
 
 
 def _cache_hit_all_ranks_agree(usable) -> bool:

@@ -150,6 +150,93 @@ def test_ring_attention_varlen_packed(ctx4, rng):
                                    rtol=5e-3, atol=5e-3, err_msg=name)
 
 
+def test_ring_attention_varlen_2d(ctx24, rng):
+    """Packed 2-doc attention through the TWO-LEVEL (DCN × ICI) ring (r4
+    verdict item 5 — the r4 features composed): ring_attention_2d_shard
+    with GLOBAL cu_seqlens on the (2,4) mesh matches the dense packed
+    oracle, and the differentiable ring_attention_2d_varlen_fn matches the
+    oracle's gradients, fwd+grad. Doc 0 spans both DCN superblocks."""
+    from triton_dist_tpu.function import ring_attention_2d_varlen_fn
+    from triton_dist_tpu.kernels.sp import ring_attention_2d_shard
+
+    wo, wi = 2, 4
+    hq, hkv, s_loc, d = 4, 2, 32, 32
+    t = wo * wi * s_loc  # 256 global; doc 0 crosses the DCN boundary at 128
+    cu = jnp.asarray([0, 168, 240], jnp.int32)  # 16 padding rows at the tail
+    q = jnp.asarray(rng.standard_normal((hq, t, d)), jnp.float32) * 0.4
+    k = jnp.asarray(rng.standard_normal((hkv, t, d)), jnp.float32) * 0.4
+    v = jnp.asarray(rng.standard_normal((hkv, t, d)), jnp.float32) * 0.4
+
+    f = jax.jit(
+        jax.shard_map(
+            lambda q_, k_, v_: ring_attention_2d_shard(
+                q_[None], k_[None], v_[None], axes=("dp", "tp"),
+                cu_seqlens=cu, block_q=32, block_k=32,
+            )[0],
+            mesh=ctx24.mesh,
+            in_specs=(P(None, ("dp", "tp")),) * 3,
+            out_specs=P(None, ("dp", "tp")),
+            check_vma=False,
+        )
+    )
+    # Serialize before the oracle (conftest substrate note).
+    got = np.asarray(f(q, k, v))
+    ref = _packed_attention_ref(q, k, v, cu)
+    np.testing.assert_allclose(got, np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+    def ring_loss(q_, k_, v_):
+        o = jax.shard_map(
+            lambda a, b, c: ring_attention_2d_varlen_fn(
+                a[None], b[None], c[None], cu, axes=("dp", "tp"))[0],
+            mesh=ctx24.mesh,
+            in_specs=(P(None, ("dp", "tp")),) * 3,
+            out_specs=P(None, ("dp", "tp")),
+            check_vma=False,
+        )(q_, k_, v_)
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    def ref_loss(q_, k_, v_):
+        return jnp.sum(_packed_attention_ref(q_, k_, v_, cu) ** 2)
+
+    g_ring = jax.block_until_ready(
+        jax.jit(jax.grad(ring_loss, argnums=(0, 1, 2)))(q, k, v))
+    g_ref = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    for gr, gf, name in zip(g_ring, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(gr), np.asarray(gf),
+                                   rtol=5e-3, atol=5e-3, err_msg=name)
+
+
+def test_ring_attention_varlen_batched(ctx4, rng):
+    """The B > 1 lift (r4 weak #6: varlen required B == 1): batch folds
+    into heads — exact because the fold preserves GQA grouping
+    ((b·Hq+h)//group == b·Hkv + h//group). B=2 packed streams with shared
+    cu_seqlens through the 1D ring match the per-batch dense oracle."""
+    b, hq, hkv, s_loc, d = 2, 4, 2, 32, 32
+    t = WORLD * s_loc
+    cu = jnp.asarray([0, 88, 120], jnp.int32)
+    q = jnp.asarray(rng.standard_normal((b, hq, t, d)), jnp.float32) * 0.4
+    k = jnp.asarray(rng.standard_normal((b, hkv, t, d)), jnp.float32) * 0.4
+    v = jnp.asarray(rng.standard_normal((b, hkv, t, d)), jnp.float32) * 0.4
+
+    f = jax.jit(
+        jax.shard_map(
+            lambda q_, k_, v_: ring_attention_shard(
+                q_, k_, v_, axis="tp", cu_seqlens=cu,
+                block_q=32, block_k=32,
+            ),
+            mesh=ctx4.mesh,
+            in_specs=(P(None, None, "tp"),) * 3,
+            out_specs=P(None, None, "tp"),
+            check_vma=False,
+        )
+    )
+    got = np.asarray(f(q, k, v))
+    for bi in range(b):
+        ref = _packed_attention_ref(q[bi], k[bi], v[bi], cu)
+        np.testing.assert_allclose(got[bi], np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4, err_msg=f"batch {bi}")
+
+
 @pytest.mark.parametrize("causal", [True, False])
 def test_ulysses_attention(ctx4, rng, causal):
     b, h, s_loc, d = 1, 8, 64, 32  # h divisible by world (Ulysses constraint)

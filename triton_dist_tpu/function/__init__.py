@@ -16,6 +16,7 @@ from triton_dist_tpu.function.collectives import (
     flash_attention_lse_fn,
     ring_attention_fn,
     ring_attention_2d_fn,
+    ring_attention_2d_varlen_fn,
     ring_attention_varlen_fn,
     gemm_rs_fn,
     gemm_ar_fn,
@@ -32,6 +33,7 @@ __all__ = [
     "flash_attention_lse_fn",
     "ring_attention_fn",
     "ring_attention_2d_fn",
+    "ring_attention_2d_varlen_fn",
     "ring_attention_varlen_fn",
     "gemm_rs_fn",
     "gemm_ar_fn",

@@ -384,6 +384,24 @@ def _flash_varlen_lse_bwd(scale, res, cots):
 flash_attention_varlen_lse_fn.defvjp(_flash_varlen_lse_fwd, _flash_varlen_lse_bwd)
 
 
+def _varlen_lse_attend(cu_seqlens, scale):
+    """The DIFFERENTIABLE varlen ring-step attend closure, ONE copy shared
+    by the 1D and 2D training rings (``flash_attention_varlen_lse_fn`` per
+    step, batch folded into heads — ``kernels.sp.fold_batch_into_heads``;
+    the fold preserves GQA grouping exactly)."""
+    from triton_dist_tpu.kernels.sp import fold_batch_into_heads
+
+    def attend(q_, k_, v_, q_off, kv_off, causal_step):
+        b, hq, s_loc, d = q_.shape
+        o, lse = flash_attention_varlen_lse_fn(
+            fold_batch_into_heads(q_), fold_batch_into_heads(k_),
+            fold_batch_into_heads(v_), cu_seqlens, q_off, kv_off, scale
+        )
+        return o.reshape(b, hq, s_loc, d), lse.reshape(b, hq, s_loc)
+
+    return attend
+
+
 def ring_attention_varlen_fn(
     q, k, v, cu_seqlens, *, axis: str = "sp", scale=None,
 ):
@@ -396,12 +414,7 @@ def ring_attention_varlen_fn(
     from triton_dist_tpu.kernels.sp import ring_schedule
 
     world = jax.lax.axis_size(axis)
-
-    def attend(q_, k_, v_, q_off, kv_off, causal_step):
-        o, lse = flash_attention_varlen_lse_fn(
-            q_[0], k_[0], v_[0], cu_seqlens, q_off, kv_off, scale
-        )
-        return o[None], lse[None]
+    attend = _varlen_lse_attend(cu_seqlens, scale)
 
     if world == 1:
         zero = jnp.int32(0)
@@ -409,6 +422,24 @@ def ring_attention_varlen_fn(
     out = ring_schedule(q[None], k[None], v[None], axis=axis, causal=True,
                         attend=attend)
     return out[0]
+
+
+def ring_attention_2d_varlen_fn(
+    q, k, v, cu_seqlens, *, axes, scale=None,
+):
+    """DIFFERENTIABLE varlen attention on the TWO-LEVEL (DCN × ICI) ring —
+    packed-SFT long-context training past one pod's ICI domain (VERDICT r4
+    item 5: the r4 features composed; reference inter-node varlen prefill,
+    ``sp_ag_attention_inter_node.py:1-595``). q/k/v are (B, Hq|Hkv,
+    S_local, D) shards in outer-major order over both axes; ``cu_seqlens``
+    holds GLOBAL offsets over the whole wo·wi·S_local packed stream. Same
+    ``ring_2d_schedule`` (superblock DCN hops issued a phase early); each
+    step's backward is the segment-masked Pallas kernel pair; B > 1 folds
+    into heads. Inside shard_map over both axes."""
+    from triton_dist_tpu.kernels.sp import ring_2d_schedule
+
+    return ring_2d_schedule(q, k, v, axes=axes, causal=True,
+                            attend=_varlen_lse_attend(cu_seqlens, scale))
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(4,))

@@ -33,7 +33,16 @@ from triton_dist_tpu.kernels.ep_a2a import all_to_all_single_shard
 
 
 def _merge_partials(o1, lse1, o2, lse2):
-    """Merge two normalised attention partials by their LSEs (fp32)."""
+    """Merge two normalised attention partials by their LSEs (fp32).
+
+    Finite-sentinel contract: a fully-masked step must emit the finite
+    ``NEG_INF`` sentinel (−1e30, what the flash kernel uses), never IEEE
+    −inf — ``m`` would then be −inf and ``lse − m`` produce NaN (inf−inf).
+    The clamp below enforces the contract for any ``attend`` implementation
+    the 1D/2D ring drivers are handed."""
+    neg_inf = jnp.float32(-1e30)
+    lse1 = jnp.maximum(lse1, neg_inf)
+    lse2 = jnp.maximum(lse2, neg_inf)
     m = jnp.maximum(lse1, lse2)
     w1 = jnp.exp(lse1 - m)
     w2 = jnp.exp(lse2 - m)
@@ -107,6 +116,40 @@ def _flash_attend(scale, block_q, block_k):
     return attend
 
 
+def fold_batch_into_heads(x: jax.Array) -> jax.Array:
+    """(B, H, S, D) → (B·H, S, D): the exact batch lift for the varlen
+    kernel (which takes heads-first, no batch — packing makes its own
+    batch). GQA grouping is PRESERVED by the fold: with group = Hq/Hkv,
+    folded q-head ``b·Hq + h`` integer-divides by group to
+    ``b·Hkv + h//group`` — precisely the folded index of its kv head. One
+    shared ``cu_seqlens`` applies to every batch element (one packed
+    stream per call; B>1 means B independent streams with the SAME doc
+    boundaries)."""
+    b, h, s, d = x.shape
+    return x.reshape(b * h, s, d)
+
+
+def _varlen_attend(cu_seqlens, scale, block_q, block_k):
+    """The VARLEN ring-step attend closure (``ring_schedule`` contract),
+    ONE copy shared by the 1D and 2D inference rings: each step runs the
+    varlen kernel at that step's global offsets — the segment mask makes
+    full, diagonal, and cross-document steps the same program. Batch is
+    folded into heads (see ``fold_batch_into_heads``)."""
+
+    def attend(q_, k_, v_, q_off, kv_off, causal_step):
+        b, hq = q_.shape[:2]
+        o, lse = flash_attention_varlen(
+            fold_batch_into_heads(q_), fold_batch_into_heads(k_),
+            fold_batch_into_heads(v_), cu_seqlens, scale=scale,
+            block_q=block_q, block_k=block_k, return_lse=True,
+            q_offset=q_off, kv_offset=kv_off,
+        )
+        s_loc, d = q_.shape[2:]
+        return o.reshape(b, hq, s_loc, d), lse.reshape(b, hq, s_loc)
+
+    return attend
+
+
 def ring_attention_shard(
     q: jax.Array,  # (B, Hq, S_local, D) — this rank's query shard
     k: jax.Array,  # (B, Hkv, S_local, D) — this rank's KV shard
@@ -117,7 +160,7 @@ def ring_attention_shard(
     scale: float | None = None,
     block_q: int = 256,
     block_k: int = 256,
-    cu_seqlens: jax.Array | None = None,  # GLOBAL packed-doc offsets (B == 1)
+    cu_seqlens: jax.Array | None = None,  # GLOBAL packed-doc offsets
 ) -> jax.Array:
     """Exact attention over the full (world·S_local) sequence with Q/K/V
     sequence-sharded (``ring_schedule`` over the Pallas flash kernel).
@@ -129,20 +172,17 @@ def ring_attention_shard(
     offsets are GLOBAL positions in the packed stream of the whole ring
     (length world·S_local); each step passes its shard offsets and the
     segment mask does the rest — full, diagonal, and cross-document steps
-    all run the same program. Requires B == 1 (packing makes its own batch)
-    and implies causal."""
+    all run the same program. B > 1 folds into heads (B independent packed
+    streams sharing one ``cu_seqlens`` — ``fold_batch_into_heads``) and
+    implies causal."""
     world = jax.lax.axis_size(axis)
     if cu_seqlens is not None:
-        assert q.shape[0] == 1, "packed varlen ring expects B == 1"
-
-        def attend_varlen(q_, k_, v_, q_off, kv_off, causal_step):
-            o, lse = flash_attention_varlen(
-                q_[0], k_[0], v_[0], cu_seqlens, scale=scale,
-                block_q=block_q, block_k=block_k, return_lse=True,
-                q_offset=q_off, kv_offset=kv_off,
+        if not causal:
+            raise ValueError(
+                "cu_seqlens implies causal packed attention; "
+                "causal=False is not supported on the varlen ring"
             )
-            return o[None], lse[None]
-
+        attend_varlen = _varlen_attend(cu_seqlens, scale, block_q, block_k)
         if world == 1:
             zero = jnp.int32(0)
             return attend_varlen(q, k, v, zero, zero, True)[0]
@@ -166,6 +206,7 @@ def ring_attention_2d_shard(
     scale: float | None = None,
     block_q: int = 256,
     block_k: int = 256,
+    cu_seqlens: jax.Array | None = None,  # GLOBAL packed-doc offsets
 ) -> jax.Array:
     """DCN-aware hierarchical ring attention (reference inter-node SP
     attention, ``sp_ag_attention_inter_node.py:1-595``): the sequence is
@@ -183,8 +224,22 @@ def ring_attention_2d_shard(
       per step, exactly ``ring_schedule``'s uniform-program discipline.
 
     Partials LSE-merge across ALL wo·wi steps — numerically one global
-    softmax. Inside shard_map over both axes."""
+    softmax. Inside shard_map over both axes.
 
+    ``cu_seqlens`` (GLOBAL packed-doc offsets over the whole wo·wi·S_local
+    stream) switches every step to the VARLEN kernel — packed documents
+    riding the two-level ring (reference inter-node varlen prefill,
+    ``sp_ag_attention_inter_node.py``); implies causal; B > 1 folds into
+    heads (``fold_batch_into_heads``)."""
+    if cu_seqlens is not None:
+        if not causal:
+            raise ValueError(
+                "cu_seqlens implies causal packed attention; "
+                "causal=False is not supported on the varlen 2D ring"
+            )
+        return ring_2d_schedule(
+            q, k, v, axes=axes, causal=True,
+            attend=_varlen_attend(cu_seqlens, scale, block_q, block_k))
     return ring_2d_schedule(q, k, v, axes=axes, causal=causal,
                             attend=_flash_attend(scale, block_q, block_k))
 

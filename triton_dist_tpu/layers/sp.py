@@ -23,8 +23,8 @@ from triton_dist_tpu.kernels.sp import (
 class RingSPAttn:
     """AG/ring sequence-parallel attention: Q/K/V sequence-sharded over
     ``axis``; exact global attention via rotating KV. ``cu_seqlens``
-    (GLOBAL packed-document offsets, B == 1) switches every ring step to
-    the varlen kernel — packed docs spanning shard boundaries (r4). The
+    (GLOBAL packed-document offsets; B > 1 folds into heads) switches
+    every ring step to the varlen kernel — packed docs spanning shard boundaries (r4). The
     varlen path is packed-CAUSAL by construction (causal-within-document
     is the mask's definition); ``causal=False`` with ``cu_seqlens`` is
     rejected rather than silently ignored."""
@@ -51,17 +51,26 @@ class RingSPAttn:
 class Ring2DSPAttn:
     """DCN-aware two-level ring attention (r4): sequence sharded over
     BOTH mesh axes outer-major; superblock hops over the slow axis ride
-    under whole fast-axis rings (``ring_attention_2d_shard``)."""
+    under whole fast-axis rings (``ring_attention_2d_shard``).
+    ``cu_seqlens`` (GLOBAL packed-document offsets over the full
+    wo·wi·S_local stream; B > 1 folds into heads) runs packed documents
+    through the two-level ring (r5 — the r4 features composed)."""
 
     axes: tuple = ("dcn", "ici")
     causal: bool = True
     block_q: int = 256
     block_k: int = 256
 
-    def __call__(self, q, k, v):
+    def __call__(self, q, k, v, cu_seqlens=None):
+        if cu_seqlens is not None and not self.causal:
+            raise ValueError(
+                "Ring2DSPAttn(causal=False) cannot take cu_seqlens: the "
+                "packed-document mask is causal-within-document by "
+                "definition")
         return ring_attention_2d_shard(
             q, k, v, axes=self.axes, causal=self.causal,
             block_q=self.block_q, block_k=self.block_k,
+            cu_seqlens=cu_seqlens,
         )
 
 

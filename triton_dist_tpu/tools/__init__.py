@@ -38,6 +38,12 @@ from triton_dist_tpu.tools.profiler import (
     profile_op,
     trace,
 )
+from triton_dist_tpu.tools.xplane import (
+    overlap_ps,
+    overlap_report,
+    parse_xspace,
+    select_events,
+)
 
 __all__ = [
     "KernelTrace",
@@ -60,4 +66,8 @@ __all__ = [
     "annotate",
     "profile_op",
     "trace",
+    "parse_xspace",
+    "select_events",
+    "overlap_ps",
+    "overlap_report",
 ]

@@ -155,7 +155,13 @@ class KernelTrace:
             ev_ref[0, c] = 0
 
     def mark(self, ev_ref, step, tag: int, aux=0):
-        """Append one (step, tag, aux) event at the next free row."""
+        """Append one (step, tag, aux) event at the next free row.
+
+        The header count increments unconditionally; events past
+        ``capacity`` are DROPPED (the row write is predicated) and are
+        visible only as ``decode()['n_dropped']`` — summing tag counts from
+        ``decode()['events']`` alone undercounts on overflow, so check
+        ``n_dropped == 0`` before treating the event list as complete."""
         import jax.numpy as jnp
         from jax.experimental import pallas as pl
 

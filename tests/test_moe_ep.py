@@ -330,6 +330,19 @@ def test_ep_fused_streams_compute_under_a2a(ctx4, rng):
         # before the next arrival (wait→compute interleave, ring order).
         for a, c in zip(arrivals, computes[1:]):
             assert c["seq"] == a["seq"] + 1 and c["aux"] == a["aux"]
+        # Experts e>0 never wait on the WIRE (r4 verdict item 8, measured):
+        # every source-arrival wait retires inside grid step (0,0) — before
+        # the first full-panel tile — so later experts' gathers are pure
+        # local HBM→VMEM copies; a source's put carries rows for ALL my
+        # local experts in one message, so source granularity IS the wire
+        # granularity and there is nothing left for e>0 to wait on. (The
+        # reference's per-tile arrival gating maps onto a persistent-kernel
+        # work queue; on this grid the same property is delivered by the
+        # first sweep draining every source.) PARITY row 31 documents this.
+        first_panel = panels[0]["seq"] if panels else len(evs)
+        assert all(a["seq"] < first_panel for a in arrivals), evs
+        assert all(e["step"] == 0 for e in arrivals), (
+            "an arrival wait escaped grid step (0,0)", evs)
 
 
 @pytest.mark.parametrize(

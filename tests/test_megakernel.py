@@ -445,3 +445,92 @@ def test_graph_mutation_changes_lowering():
     out_p = run(pinned_fn)
     for a, b in zip(out_f, out_p):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-4)
+
+
+def test_mega_decode_agrees_on_multi_axis_mesh(ctx24):
+    """Regression (r5, found by the dp×tp dryrun): the mega backend's
+    standalone ARs must pass mesh_axes into the one-shot push kernel — on
+    a MULTI-axis mesh an axis-local peer index is not a global device id,
+    and without the translation another dp group's puts land on group 0
+    (leftover semaphore counts, rendezvous hang). mega must bit-match xla
+    under (dp=2, tp=4) exactly as it does on single-axis meshes."""
+    from triton_dist_tpu.models import DenseLLM, Engine, ModelConfig
+
+    tp = ctx24.num_ranks("tp")
+    cfg = ModelConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=4 * tp,
+        num_layers=2, num_q_heads=2 * tp, num_kv_heads=tp, head_dim=16,
+        dtype="float32",
+    )
+    model = DenseLLM(cfg, ctx24, key=jax.random.PRNGKey(0))
+    ids = jnp.asarray([[3, 17, 42, 7], [9, 1, 88, 64]], jnp.int32)
+    out_x = np.asarray(
+        Engine(model, backend="xla", max_len=16).serve(ids, gen_len=3))
+    out_m = np.asarray(
+        Engine(model, backend="mega", max_len=16).serve(ids, gen_len=3))
+    np.testing.assert_array_equal(out_m, out_x)
+
+
+def test_mega_pinned_standalone_ar_on_multi_axis_mesh(ctx24):
+    """Third sibling of the multi-axis addressing bug:
+    pin_standalone('flash_decode') breaks the attn_back group, so o_proj
+    lowers via standalone_linear_ar → gemm_ar_shard, whose AUTO route
+    picks the same one-shot push kernel at decode sizes and needs the
+    same mesh_axes translation. Fused and pinned lowerings must agree on
+    the (dp=2, tp=4) mesh (with the bug, the pinned path's puts cross dp
+    groups and hang)."""
+    from jax.sharding import PartitionSpec as P
+    from triton_dist_tpu.models.config import PRESETS
+
+    cfg = PRESETS["test-dense"]
+    tp = ctx24.num_ranks("tp")
+    mk = lambda: ModelBuilder(cfg, axis="tp", world=tp,
+                              mesh_axes=ctx24.axis_names)
+    fused_fn = mk().build_layer_fn()
+    pinned_mb = mk()
+    pinned_mb.make_attn_front()
+    pinned_mb.make_attn_back()
+    pinned_mb.make_mlp_block()
+    pinned_mb.graph.pin_standalone("flash_decode")
+    pinned_fn = pinned_mb.build_layer_fn()
+    assert any("standalone_flash_decode" in p for p in pinned_fn.plan)
+
+    rng = np.random.default_rng(11)
+    d, hq, hkv, hd = (cfg.hidden_size, cfg.num_q_heads, cfg.num_kv_heads,
+                      cfg.head_dim)
+    hq_l, hkv_l, ff_l = hq // tp, hkv // tp, cfg.intermediate_size // tp
+    arr = lambda *shape: jnp.asarray(
+        rng.standard_normal(shape), jnp.float32) * 0.1
+    # TP-sharded weights as (tp, ...) stacks; norms replicated. The AR
+    # equality under test is purely about peer ADDRESSING within each dp
+    # group, so the dp axis sees replicated operands.
+    lp = {
+        "ln1": arr(d), "q_norm": arr(hd), "k_norm": arr(hd), "ln2": arr(d),
+        "wqkv": arr(tp, d, (hq_l + 2 * hkv_l) * hd),
+        "wo": arr(tp, hq_l * hd, d),
+        "mlp_gate": arr(tp, d, ff_l), "mlp_up": arr(tp, d, ff_l),
+        "mlp_down": arr(tp, ff_l, d),
+    }
+    stacked = {"wqkv", "wo", "mlp_gate", "mlp_up", "mlp_down"}
+    lp_specs = {k: (P("tp") if k in stacked else P()) for k in lp}
+    b, s = 2, 16
+    x = jnp.asarray(rng.standard_normal((b, d)), jnp.float32) * 0.5
+    ks = arr(tp, 1, b, hkv_l, s, hd)
+    vs = arr(tp, 1, b, hkv_l, s, hd)
+    lengths = jnp.asarray([3, 7], jnp.int32)
+
+    run = lambda fn: jax.shard_map(
+        lambda lp_, x_, ks_, vs_, len_: fn(
+            {k: (v[0] if k in stacked else v) for k, v in lp_.items()},
+            x_, ks_[0], vs_[0], 0, len_),
+        mesh=ctx24.mesh,
+        in_specs=(lp_specs, P(), P("tp"), P("tp"), P()),
+        out_specs=(P(), P("tp"), P("tp")), check_vma=False,
+    )(lp, x, ks, vs, lengths)
+
+    out_f = jax.block_until_ready(run(fused_fn))
+    out_p = jax.block_until_ready(run(pinned_fn))
+    for a, bb in zip(out_f, out_p):
+        assert np.isfinite(np.asarray(a)).all()
+        np.testing.assert_allclose(np.asarray(a), np.asarray(bb),
+                                   rtol=1e-4, atol=1e-6)

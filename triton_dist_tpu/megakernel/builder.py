@@ -261,8 +261,14 @@ class ModelBuilder:
                 # path: cast the partial to model dtype, then all-reduce.
                 attn_out = partial.astype(q.dtype).reshape(b, -1)
                 if world > 1:
+                    # mesh_axes is LOAD-BEARING on multi-axis meshes: without
+                    # it the one-shot kernel addresses peers by tp index as a
+                    # GLOBAL device id and another dp group's puts land here
+                    # (found by the dp x tp dryrun: leftover semaphore counts
+                    # + rendezvous hang).
                     attn_out = all_reduce_shard(
-                        attn_out, axis=axis, method=AllReduceMethod.ONE_SHOT
+                        attn_out, axis=axis, mesh_axes=self.mesh_axes,
+                        method=AllReduceMethod.ONE_SHOT,
                     )
                 env[out_v] = env[resid_in] + attn_out
                 # The cache_update task's semantic outputs: one-row in-place
@@ -410,8 +416,12 @@ class ModelBuilder:
 
         if op == "linear_allreduce":
             def standalone_linear_ar(env, lp, t=task):
+                # mesh_axes as in the fused-path ARs: at decode sizes
+                # the AUTO route picks the one-shot push kernel, whose peer
+                # addressing needs the full axis list on multi-axis meshes.
                 env[t.outputs[0]] = gemm_ar_shard(
-                    env[t.inputs[0]], lp[param(t.inputs[1])], axis=axis
+                    env[t.inputs[0]], lp[param(t.inputs[1])], axis=axis,
+                    mesh_axes=self.mesh_axes,
                 )
             return standalone_linear_ar
 
@@ -431,9 +441,12 @@ class ModelBuilder:
             def standalone_allreduce(env, lp, t=task):
                 # Output dtype follows the task's own input value, not a
                 # hardcoded env key — a graph with renamed inputs lowers fine.
+                # mesh_axes as in the attention AR: multi-axis peer
+                # addressing needs the full axis list.
                 x = env[t.inputs[0]]
                 env[t.outputs[0]] = all_reduce_shard(
-                    x.astype(jnp.float32), axis=axis, method=AllReduceMethod.AUTO
+                    x.astype(jnp.float32), axis=axis,
+                    mesh_axes=self.mesh_axes, method=AllReduceMethod.AUTO,
                 ).astype(x.dtype)
             return standalone_allreduce
 

@@ -97,3 +97,75 @@ def test_barrier_all_on_device(ctx8):
 
     out = shard(ctx8, lambda: fn()[None], (), P("tp"))()
     assert np.asarray(out).shape == (8, 1)
+
+
+@pytest.mark.parametrize("kind", ["ag_ring", "ag_fullmesh", "rs",
+                                  "ar_oneshot", "ar_twoshot", "a2a"])
+def test_collectives_on_multi_axis_mesh(ctx24, rng, kind):
+    """Multi-axis addressing sweep (r5, after the mega-backend bug): every
+    one-sided collective kernel runs over the tp SUB-axis of the (dp=2,
+    tp=4) mesh with per-device-distinct values — each dp group must reduce/
+    gather ONLY its own shards. A peer index mistaken for a global device
+    id (the bug class fixed in megakernel/builder.py) crosses dp groups
+    and fails the per-group references here."""
+    from triton_dist_tpu.kernels.ep_a2a import all_to_all_single_shard
+
+    dp, tp = 2, 4
+    # Distinct value per (dp, tp) coordinate.
+    per_dev = jnp.asarray(
+        rng.standard_normal((dp, tp, 8, 128)), jnp.float32)
+
+    def run(fn, out_specs=P("dp", "tp")):
+        return jax.jit(jax.shard_map(
+            fn, mesh=ctx24.mesh, in_specs=(P("dp", "tp"),),
+            out_specs=out_specs, check_vma=False))(per_dev)
+
+    x_np = np.asarray(per_dev)
+    if kind in ("ag_ring", "ag_fullmesh"):
+        method = (AllGatherMethod.RING_1D if kind == "ag_ring"
+                  else AllGatherMethod.FULL_MESH_PUSH)
+        out = run(lambda xs: all_gather_shard(
+            xs[0, 0], axis="tp", mesh_axes=("dp", "tp"), method=method
+        ).reshape(1, 1, tp * 8, 128))
+        for g in range(dp):
+            expect = x_np[g].reshape(tp * 8, 128)
+            for r in range(tp):
+                np.testing.assert_array_equal(
+                    np.asarray(out)[g, r], expect, err_msg=f"dp{g} tp{r}")
+    elif kind == "rs":
+        # Each rank contributes its full buffer; rank r of group g owns the
+        # summed row-block r of GROUP g only.
+        out = run(lambda xs: reduce_scatter_shard(
+            xs[0, 0], axis="tp", mesh_axes=("dp", "tp"))[None, None])
+        for g in range(dp):
+            expect = x_np[g].sum(axis=0).reshape(tp, 2, 128)
+            for r in range(tp):
+                np.testing.assert_allclose(
+                    np.asarray(out)[g, r], expect[r],
+                    rtol=1e-4, atol=1e-5, err_msg=f"dp{g} tp{r}")
+    elif kind in ("ar_oneshot", "ar_twoshot"):
+        method = (AllReduceMethod.ONE_SHOT if kind == "ar_oneshot"
+                  else AllReduceMethod.TWO_SHOT)
+        out = run(lambda xs: all_reduce_shard(
+            xs[0, 0], axis="tp", mesh_axes=("dp", "tp"), method=method
+        )[None, None])
+        for g in range(dp):
+            expect = x_np[g].sum(axis=0)
+            for r in range(tp):
+                np.testing.assert_allclose(
+                    np.asarray(out)[g, r], expect,
+                    rtol=1e-4, atol=1e-5, err_msg=f"dp{g} tp{r}")
+    else:  # a2a over the tp sub-axis
+        per_dev4 = per_dev.reshape(dp, tp, tp, 2, 128)  # row p → peer p
+        out = jax.jit(jax.shard_map(
+            lambda xs: all_to_all_single_shard(
+                xs[0, 0], axis="tp", mesh_axes=("dp", "tp"))[None, None],
+            mesh=ctx24.mesh, in_specs=(P("dp", "tp"),),
+            out_specs=P("dp", "tp"), check_vma=False))(per_dev4)
+        x4 = np.asarray(per_dev4)
+        for g in range(dp):
+            for r in range(tp):
+                for s in range(tp):
+                    np.testing.assert_array_equal(
+                        np.asarray(out)[g, r, s], x4[g, s, r],
+                        err_msg=f"dp{g} tp{r} src{s}")

@@ -196,6 +196,9 @@ class ModelBuilder:
         standalone task). Executors read/write the value environment."""
         c = self.config
         axis = self.axis
+        # Snapshot like `axis`/`world`: executors must not pin the whole
+        # builder in their closure chain nor track post-build mutation.
+        mesh_axes = self.mesh_axes
         eps = c.rms_eps
 
         from triton_dist_tpu.kernels.flash_decode import flash_decode
@@ -267,7 +270,7 @@ class ModelBuilder:
                     # (found by the dp x tp dryrun: leftover semaphore counts
                     # + rendezvous hang).
                     attn_out = all_reduce_shard(
-                        attn_out, axis=axis, mesh_axes=self.mesh_axes,
+                        attn_out, axis=axis, mesh_axes=mesh_axes,
                         method=AllReduceMethod.ONE_SHOT,
                     )
                 env[out_v] = env[resid_in] + attn_out
@@ -421,7 +424,7 @@ class ModelBuilder:
                 # addressing needs the full axis list on multi-axis meshes.
                 env[t.outputs[0]] = gemm_ar_shard(
                     env[t.inputs[0]], lp[param(t.inputs[1])], axis=axis,
-                    mesh_axes=self.mesh_axes,
+                    mesh_axes=mesh_axes,
                 )
             return standalone_linear_ar
 
@@ -446,7 +449,7 @@ class ModelBuilder:
                 x = env[t.inputs[0]]
                 env[t.outputs[0]] = all_reduce_shard(
                     x.astype(jnp.float32), axis=axis,
-                    mesh_axes=self.mesh_axes, method=AllReduceMethod.AUTO,
+                    mesh_axes=mesh_axes, method=AllReduceMethod.AUTO,
                 ).astype(x.dtype)
             return standalone_allreduce
 

@@ -1,0 +1,155 @@
+"""Fused AG-SP attention kernel (reference sp_ag_attention_intra_node —
+one-sided KV gather consumed inside the flash kernel with per-source
+arrival waits). Parity vs the full-sequence flash kernel + in-kernel
+schedule evidence, the same standard as the fused EP kernel."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from triton_dist_tpu.kernels.ag_attention import (
+    ag_attention_supported,
+    ag_flash_attention_shard,
+)
+from triton_dist_tpu.kernels.flash_attn import flash_attention
+
+WORLD = 4
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ag_attention_parity(ctx4, rng, causal):
+    b, hq, hkv, s_loc, d = 1, 4, 2, 16, 32
+    s = WORLD * s_loc
+    assert ag_attention_supported(WORLD, b, hq, hkv, s_loc, d, 4)
+    q = jnp.asarray(rng.standard_normal((b, hq, s, d)), jnp.float32) * 0.4
+    k = jnp.asarray(rng.standard_normal((b, hkv, s, d)), jnp.float32) * 0.4
+    v = jnp.asarray(rng.standard_normal((b, hkv, s, d)), jnp.float32) * 0.4
+
+    f = jax.jit(jax.shard_map(
+        lambda q_, k_, v_: ag_flash_attention_shard(
+            q_, k_, v_, axis="tp", mesh_axes=("tp",), causal=causal),
+        mesh=ctx4.mesh, in_specs=(P(None, None, "tp"),) * 3,
+        out_specs=P(None, None, "tp"), check_vma=False))
+    out = np.asarray(f(q, k, v))
+    ref = np.asarray(flash_attention(q, k, v, causal=causal,
+                                     block_q=16, block_k=16))
+    np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_ag_attention_batched_gqa(ctx4, rng):
+    """B>1 and group>1 exercise the GQA-preserving folds."""
+    b, hq, hkv, s_loc, d = 2, 8, 2, 8, 32
+    s = WORLD * s_loc
+    q = jnp.asarray(rng.standard_normal((b, hq, s, d)), jnp.float32) * 0.4
+    k = jnp.asarray(rng.standard_normal((b, hkv, s, d)), jnp.float32) * 0.4
+    v = jnp.asarray(rng.standard_normal((b, hkv, s, d)), jnp.float32) * 0.4
+    f = jax.jit(jax.shard_map(
+        lambda q_, k_, v_: ag_flash_attention_shard(
+            q_, k_, v_, axis="tp", mesh_axes=("tp",), causal=True),
+        mesh=ctx4.mesh, in_specs=(P(None, None, "tp"),) * 3,
+        out_specs=P(None, None, "tp"), check_vma=False))
+    out = np.asarray(f(q, k, v))
+    ref = np.asarray(flash_attention(q, k, v, causal=True,
+                                     block_q=8, block_k=8))
+    np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_ag_attention_streams_compute_under_gather(ctx4, rng):
+    """Schedule evidence from in-kernel trace data: the LOCAL shard
+    computes first (zero network wait) and compute starts BEFORE the last
+    source's arrival — per-source waits, not a full drain. Traced output
+    is identical to the untraced run's."""
+    from triton_dist_tpu.tools import KernelTrace
+
+    b, hq, hkv, s_loc, d = 1, 4, 2, 16, 32
+    q = jnp.asarray(
+        rng.standard_normal((b, hq, WORLD * s_loc, d)), jnp.float32) * 0.4
+    k = jnp.asarray(
+        rng.standard_normal((b, hkv, WORLD * s_loc, d)), jnp.float32) * 0.4
+    v = jnp.asarray(
+        rng.standard_normal((b, hkv, WORLD * s_loc, d)), jnp.float32) * 0.4
+    kt = KernelTrace(capacity=32)
+
+    def run(trace):
+        def fn(q_, k_, v_):
+            if trace is None:
+                return ag_flash_attention_shard(
+                    q_, k_, v_, axis="tp", mesh_axes=("tp",), causal=True)
+            o, ev = ag_flash_attention_shard(
+                q_, k_, v_, axis="tp", mesh_axes=("tp",), causal=True,
+                trace=trace)
+            return o, ev[None]  # leading rank dim for the stacked trace
+        return jax.jit(jax.shard_map(
+            fn, mesh=ctx4.mesh, in_specs=(P(None, None, "tp"),) * 3,
+            out_specs=((P(None, None, "tp"), P("tp"))
+                       if trace is not None else P(None, None, "tp")),
+            check_vma=False))(q, k, v)
+
+    out_traced, events = run(kt)
+    out_plain = run(None)
+    np.testing.assert_array_equal(np.asarray(out_traced), np.asarray(out_plain))
+
+    for r in range(WORLD):
+        dec = kt.decode(np.asarray(events)[r])
+        evs = dec["events"]
+        assert dec["n_dropped"] == 0
+        arrivals = [e for e in evs if e["tag"] == 1]
+        computes = [e for e in evs if e["tag"] == 2]
+        assert len(arrivals) == WORLD - 1, evs
+        assert len(computes) == WORLD, evs
+        # Zero-wait start: the first computed shard is the LOCAL one.
+        assert computes[0]["aux"] == r, evs
+        assert computes[0]["seq"] < arrivals[-1]["seq"], evs
+        # wait -> compute interleave in expected-arrival order.
+        for a, c in zip(arrivals, computes[1:]):
+            assert c["seq"] == a["seq"] + 1 and c["aux"] == a["aux"], evs
+
+
+def test_ag_attention_multi_axis_mesh(ctx24, rng):
+    """The fused kernel over the tp SUB-axis of the (dp=2, tp=4) mesh:
+    each dp group attends over ITS OWN sequence only (per-group parity —
+    the multi-axis addressing sweep for this kernel)."""
+    dp, tp = 2, 4
+    b, hq, hkv, s_loc, d = 1, 4, 2, 8, 32
+    s = tp * s_loc
+    q = jnp.asarray(rng.standard_normal((dp, b, hq, s, d)), jnp.float32) * 0.4
+    k = jnp.asarray(rng.standard_normal((dp, b, hkv, s, d)), jnp.float32) * 0.4
+    v = jnp.asarray(rng.standard_normal((dp, b, hkv, s, d)), jnp.float32) * 0.4
+    f = jax.jit(jax.shard_map(
+        lambda q_, k_, v_: ag_flash_attention_shard(
+            q_[0], k_[0], v_[0], axis="tp", mesh_axes=("dp", "tp"),
+            causal=True)[None],
+        mesh=ctx24.mesh, in_specs=(P("dp", None, None, "tp"),) * 3,
+        out_specs=P("dp", None, None, "tp"), check_vma=False))
+    out = np.asarray(f(q, k, v))
+    for g in range(dp):
+        ref = np.asarray(flash_attention(q[g], k[g], v[g], causal=True,
+                                         block_q=8, block_k=8))
+        np.testing.assert_allclose(out[g], ref, rtol=2e-4, atol=2e-4,
+                                   err_msg=f"dp{g}")
+
+
+def test_ag_sp_attn_layer_fallback(ctx4, rng):
+    """AGSPAttn runs the fused kernel when the VMEM plan fits and falls
+    back to ring_attention_shard when it doesn't — both match the dense
+    reference."""
+    from triton_dist_tpu.layers import AGSPAttn
+
+    b, hq, hkv, s_loc, d = 1, 4, 2, 16, 32
+    s = WORLD * s_loc
+    q = jnp.asarray(rng.standard_normal((b, hq, s, d)), jnp.float32) * 0.4
+    k = jnp.asarray(rng.standard_normal((b, hkv, s, d)), jnp.float32) * 0.4
+    v = jnp.asarray(rng.standard_normal((b, hkv, s, d)), jnp.float32) * 0.4
+    ref = np.asarray(flash_attention(q, k, v, causal=True,
+                                     block_q=16, block_k=16))
+    for limit in (100, 0):  # 0 MB forces the ring fallback
+        layer = AGSPAttn(axis="tp", mesh_axes=("tp",), vmem_limit_mb=limit,
+                         block_q=16, block_k=16)
+        f = jax.jit(jax.shard_map(
+            layer, mesh=ctx4.mesh, in_specs=(P(None, None, "tp"),) * 3,
+            out_specs=P(None, None, "tp"), check_vma=False))
+        np.testing.assert_allclose(np.asarray(f(q, k, v)), ref,
+                                   rtol=2e-4, atol=2e-4,
+                                   err_msg=f"vmem_limit={limit}")

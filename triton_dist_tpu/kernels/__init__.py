@@ -98,6 +98,10 @@ from triton_dist_tpu.kernels.low_latency_a2a import (
     ll_dispatch_shard,
     quantize_fp8,
 )
+from triton_dist_tpu.kernels.ag_attention import (
+    ag_attention_supported,
+    ag_flash_attention_shard,
+)
 from triton_dist_tpu.kernels.sp import (
     a2a_gemm_shard,
     gemm_a2a_shard,
@@ -174,6 +178,8 @@ __all__ = [
     "ep_moe_ll_shard",
     "a2a_gemm_shard",
     "gemm_a2a_shard",
+    "ag_attention_supported",
+    "ag_flash_attention_shard",
     "ring_attention_shard",
     "ulysses_attention_shard",
     "ulysses_qkv_gemm_a2a_shard",

@@ -1,0 +1,295 @@
+"""Fused AG-SP attention: one-sided KV all-gather consumed INSIDE the flash
+kernel, per-source arrival waits — ONE Pallas kernel.
+
+Reference: ``python/triton_dist/kernels/nvidia/sp_ag_attention_intra_node.py``
+(:106-433) — the producer pushes KV shards with per-shard signals and the
+flash consumer waits each shard individually, so attention compute on
+arrived shards hides the gather of in-flight ones. This is the LITERAL
+TPU analog (the repo's `kernels.sp` rings are the jit-level ppermute
+redesign; this kernel is the in-kernel design for the regimes where the
+gather must hide under compute *within one kernel launch*):
+
+* grid step ``s`` processes KV shard ``(me - s) % world`` — the LOCAL shard
+  first (zero network wait), then shards in expected-arrival order;
+* step 0 issues all ``world-1`` one-sided puts (k and v) with per-SOURCE
+  recv-semaphore slots (the ep_fused r4 discipline), so step ``s`` waits
+  exactly its source's arrival — compute on shard ``s-1`` runs while shard
+  ``s`` is still in flight;
+* shards merge by streaming online softmax in VMEM scratch (m/l/acc), one
+  global softmax numerically — the in-kernel form of the ring's LSE merge;
+* blockwise-causal semantics match ``ring_schedule``: shard j < me
+  unmasked, j == me diagonal-causal, j > me fully masked (p zeroed, so the
+  wait/put schedule stays uniform across ranks — no divergent collective).
+
+``trace`` (a ``tools.KernelTrace``) records (arrive, compute) events — the
+same schedule evidence the fused EP kernel carries.
+
+VMEM plan: whole-shard q (BHkv, g*S_loc, D) + one visiting KV shard + f32
+accumulators must fit; ``ag_attention_supported`` checks, callers fall back
+to ``kernels.sp.ring_attention_shard`` (same math, jit-level overlap).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+import triton_dist_tpu.language as tpl
+from triton_dist_tpu.shmem.kernel import collective_id_for, dist_pallas_call
+
+NEG_INF = -1e30
+LANES = 128
+
+
+def _ag_attn_kernel(
+    q_ref,  # ANY (BHkv, gS, D)
+    k_ref,  # ANY (BHkv, S_loc, D) local shard
+    v_ref,  # ANY (BHkv, S_loc, D)
+    o_ref,  # VMEM (BHkv, gS, D)
+    krecv_ref,  # ANY (world, BHkv, S_loc, D) landing zone
+    vrecv_ref,  # ANY (world, BHkv, S_loc, D)
+    *rest,
+    axis,
+    mesh_axes,
+    causal: bool,
+    scale: float,
+    s_loc: int,
+    group: int,
+    trace=None,
+):
+    it = iter(rest)
+    ev_ref = next(it) if trace is not None else None
+    q_vmem = next(it)
+    k_vmem = next(it)
+    v_vmem = next(it)
+    acc = next(it)  # (BHkv, gS, D) f32
+    m_scr = next(it)  # (BHkv, gS, LANES) f32
+    l_scr = next(it)  # (BHkv, gS, LANES) f32
+    send_sem, recv_sem, copy_sem = next(it), next(it), next(it)
+    assert next(it, None) is None, "ref list mismatch"
+
+    s = pl.program_id(0)
+    me = tpl.rank(axis)
+    world = tpl.num_ranks(axis)
+    src = jax.lax.rem(me - s + world, world)
+
+    def _mark(tag, aux):
+        if trace is not None:
+            trace.mark(ev_ref, s, tag, aux)
+
+    @pl.when(s == 0)
+    def _():
+        if trace is not None:
+            trace.init(ev_ref)
+        # q resident for the whole sweep; local KV into its landing slot.
+        # All three copies in flight together, then one drain.
+        copies = [pltpu.make_async_copy(q_ref, q_vmem, copy_sem),
+                  pltpu.make_async_copy(k_ref, krecv_ref.at[me], copy_sem),
+                  pltpu.make_async_copy(v_ref, vrecv_ref.at[me], copy_sem)]
+        for cp in copies:
+            cp.start()
+        for cp in copies:
+            cp.wait()
+        # Peers may still read their landing zones from a previous step.
+        tpl.barrier_all(axis, mesh_axes=mesh_axes)
+
+        def send(i, _):
+            peer = jax.lax.rem(me + i, world)
+            # Per-SOURCE signal slot [me] on the peer: the consumer waits
+            # each source individually (reference per-shard signals,
+            # sp_ag_attention_intra_node.py:257).
+            tpl.putmem_signal(
+                k_ref, krecv_ref.at[me], send_sem, recv_sem.at[me], peer,
+                axis=axis, mesh_axes=mesh_axes,
+            ).start()
+            tpl.putmem_signal(
+                v_ref, vrecv_ref.at[me], send_sem, recv_sem.at[me], peer,
+                axis=axis, mesh_axes=mesh_axes,
+            ).start()
+            return 0
+
+        jax.lax.fori_loop(1, world, send, 0)
+        acc[...] = jnp.zeros_like(acc)
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+
+    @pl.when(s > 0)
+    def _():
+        # Wait THIS source's two arrivals (k + v bytes on its slot), and
+        # retire two of our outbound sends (byte-counting semaphores).
+        tpl.wait_recv(recv_sem.at[src], krecv_ref.at[src])
+        tpl.wait_recv(recv_sem.at[src], vrecv_ref.at[src])
+        pltpu.make_async_copy(k_ref, k_ref, send_sem).wait()
+        pltpu.make_async_copy(v_ref, v_ref, send_sem).wait()
+        _mark(1, src)  # TAG_ARRIVE
+
+    # Visiting shard HBM→VMEM — k and v copies in flight together. NOT
+    # double-buffered across steps on purpose: prefetching shard s+1
+    # during shard s's compute would require waiting s+1's ARRIVAL before
+    # computing s, stalling on a late source — the straggler tolerance the
+    # per-source waits exist to provide. The local fill is linear in the
+    # shard size while the dot is quadratic; the network put is the leg
+    # that must hide, and it does.
+    copies = [pltpu.make_async_copy(krecv_ref.at[src], k_vmem, copy_sem),
+              pltpu.make_async_copy(vrecv_ref.at[src], v_vmem, copy_sem)]
+    for cp in copies:
+        cp.start()
+    for cp in copies:
+        cp.wait()
+
+    # Online-softmax merge of this shard (one global softmax across the
+    # world sweep). Global positions make the mask uniform across ranks:
+    # q row r sits at me*S_loc + (r % S_loc); kv col c at src*S_loc + c.
+    scores = jax.lax.dot_general(
+        q_vmem[...], k_vmem[...], (((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    ) * scale  # (BHkv, gS, S_loc)
+    if causal:
+        gs = group * s_loc
+        pos_q = me * s_loc + jax.lax.broadcasted_iota(
+            jnp.int32, (1, gs, s_loc), 1) % s_loc
+        pos_k = src * s_loc + jax.lax.broadcasted_iota(
+            jnp.int32, (1, gs, s_loc), 2)
+        mask = pos_k <= pos_q
+        scores = jnp.where(mask, scores, NEG_INF)
+    else:
+        mask = None
+
+    m_prev = m_scr[:, :, :1]  # (BHkv, gS, 1)
+    m_new = jnp.maximum(m_prev, jnp.max(scores, axis=2, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(scores - m_new)
+    if mask is not None:
+        # A fully-masked row has m_new == NEG_INF and exp(0) == 1 per
+        # entry — zero p explicitly so masked shards contribute nothing.
+        p = jnp.where(mask, p, 0.0)
+    l_new = l_scr[:, :, :1] * alpha + jnp.sum(p, axis=2, keepdims=True)
+    acc[...] = acc[...] * alpha + jax.lax.dot_general(
+        p.astype(v_vmem.dtype), v_vmem[...], (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )
+    m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+    l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+    _mark(2, src)  # TAG_COMPUTE
+
+    @pl.when(s == world - 1)
+    def _():
+        l = l_scr[:, :, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[...] = (acc[...] / l_safe).astype(o_ref.dtype)
+
+
+def ag_attention_supported(world: int, b: int, hq: int, hkv: int,
+                           s_loc: int, d: int, itemsize: int,
+                           vmem_limit_mb: int = 100) -> bool:
+    """Static VMEM-plan check: resident q + o + one visiting KV shard +
+    f32 accumulators + m/l lanes + the per-step (gS, S_loc) f32
+    score/p/mask temporaries of the unblocked whole-shard dot — the term
+    that grows quadratically in S_loc and dominates at long sequences
+    (omitting it would pass shapes the kernel can't compile and the ring
+    fallback would never trigger)."""
+    bhkv = b * hkv
+    gs = (hq // hkv) * s_loc
+    q_o = 2 * bhkv * gs * d * itemsize
+    kv = 2 * bhkv * s_loc * d * itemsize
+    accs = bhkv * gs * d * 4
+    ml = 2 * bhkv * gs * LANES * 4
+    tmps = 3 * bhkv * gs * s_loc * 4  # scores + p + where/mask temp, f32
+    return q_o + kv + accs + ml + tmps <= vmem_limit_mb * 1024 * 1024
+
+
+def ag_flash_attention_shard(
+    q: jax.Array,  # (B, Hq, S_local, D)
+    k: jax.Array,  # (B, Hkv, S_local, D)
+    v: jax.Array,
+    *,
+    axis: str = "sp",
+    mesh_axes=None,
+    causal: bool = True,
+    scale: float | None = None,
+    vmem_limit_mb: int = 100,
+    trace=None,
+):
+    """Exact attention over the full world*S_local sequence with ONE fused
+    kernel per rank: one-sided KV gather + per-source waits + streaming
+    online-softmax (module docstring). Returns (B, Hq, S_local, D) (+ this
+    rank's trace events when ``trace`` is given). Inside shard_map.
+
+    Falls back to nothing here — callers should check
+    ``ag_attention_supported`` and use ``ring_attention_shard`` when the
+    VMEM plan doesn't fit (``layers.AGSPAttn`` does exactly that)."""
+    world = jax.lax.axis_size(axis)
+    b, hq, s_loc, d = q.shape
+    hkv = k.shape[1]
+    assert hq % hkv == 0
+    group = hq // hkv
+    sc = scale if scale is not None else d ** -0.5
+
+    if world == 1:
+        from triton_dist_tpu.kernels.flash_attn import flash_attention
+
+        assert trace is None, "trace requires the multi-rank kernel path"
+        return flash_attention(q, k, v, causal=causal, scale=sc,
+                               block_q=min(1024, s_loc),
+                               block_k=min(1024, s_loc))
+
+    bhkv = b * hkv
+    gs = group * s_loc
+    # GQA-preserving folds: (B,Hq,S,D) -> (BHkv, group*S, D); row g*S+t of
+    # kv-head bh is q-head (bh%hkv)*group+g at seq t.
+    qf = (q.reshape(b, hkv, group, s_loc, d)
+          .reshape(bhkv, group, s_loc, d).reshape(bhkv, gs, d))
+    kf = k.reshape(bhkv, s_loc, d)
+    vf = v.reshape(bhkv, s_loc, d)
+
+    out_specs = [
+        pl.BlockSpec((bhkv, gs, d), lambda s: (0, 0, 0)),  # o (VMEM)
+        pl.BlockSpec(memory_space=pl.ANY),  # krecv
+        pl.BlockSpec(memory_space=pl.ANY),  # vrecv
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((bhkv, gs, d), q.dtype),
+        jax.ShapeDtypeStruct((world, bhkv, s_loc, d), k.dtype),
+        jax.ShapeDtypeStruct((world, bhkv, s_loc, d), v.dtype),
+    ]
+    if trace is not None:
+        out_specs.append(trace.out_spec())
+        out_shape.append(trace.out_shape)
+
+    res = dist_pallas_call(
+        functools.partial(
+            _ag_attn_kernel, axis=axis, mesh_axes=mesh_axes, causal=causal,
+            scale=sc, s_loc=s_loc, group=group, trace=trace,
+        ),
+        grid=(world,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 3,
+        out_specs=tuple(out_specs),
+        out_shape=tuple(out_shape),
+        scratch_shapes=[
+            pltpu.VMEM((bhkv, gs, d), q.dtype),  # q
+            pltpu.VMEM((bhkv, s_loc, d), k.dtype),  # visiting k
+            pltpu.VMEM((bhkv, s_loc, d), v.dtype),  # visiting v
+            pltpu.VMEM((bhkv, gs, d), jnp.float32),  # acc
+            pltpu.VMEM((bhkv, gs, LANES), jnp.float32),  # m
+            pltpu.VMEM((bhkv, gs, LANES), jnp.float32),  # l
+            pltpu.SemaphoreType.DMA,  # send
+            pltpu.SemaphoreType.DMA((world,)),  # recv: one slot per SOURCE
+            pltpu.SemaphoreType.DMA,  # local copies
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+            has_side_effects=True,
+            vmem_limit_bytes=vmem_limit_mb * 1024 * 1024,
+            collective_id=collective_id_for(
+                f"_ag_attn_kernel:causal={causal}:trace={trace is not None}"
+            ),
+        ),
+    )(qf, kf, vf)
+    o = res[0].reshape(b, hkv, group, s_loc, d).reshape(b, hq, s_loc, d)
+    if trace is not None:
+        return o, res[3]
+    return o

@@ -12,7 +12,12 @@ from triton_dist_tpu.layers.tp import TP_MLP, TP_Attn, TP_MoE, RMSNorm
 from triton_dist_tpu.layers.pp import PPCommLayer
 from triton_dist_tpu.layers.pp_schedule import gpipe_forward, gpipe_stage_params
 from triton_dist_tpu.layers.ep import EP_MoE
-from triton_dist_tpu.layers.sp import Ring2DSPAttn, RingSPAttn, UlyssesSPAttn
+from triton_dist_tpu.layers.sp import (
+    AGSPAttn,
+    Ring2DSPAttn,
+    RingSPAttn,
+    UlyssesSPAttn,
+)
 
 __all__ = [
     "TP_MLP",
@@ -24,6 +29,7 @@ __all__ = [
     "gpipe_stage_params",
     "EP_MoE",
     "UlyssesSPAttn",
+    "AGSPAttn",
     "RingSPAttn",
     "Ring2DSPAttn",
 ]

@@ -88,3 +88,38 @@ class UlyssesSPAttn:
             q, k, v, axis=self.axis, causal=self.causal,
             use_pallas_a2a=self.use_pallas_a2a,
         )
+
+
+@dataclasses.dataclass(frozen=True)
+class AGSPAttn:
+    """Fused AG-SP attention layer (reference ``sp_ag_attention_intra_node``
+    as ONE kernel): one-sided KV gather consumed inside the flash kernel
+    with per-source arrival waits (``kernels.ag_attention``). Falls back to
+    the jit-level ``ring_attention_shard`` (same math, XLA-scheduled
+    overlap) when the fused kernel's VMEM plan doesn't fit — callers get
+    the best available overlap mechanism either way."""
+
+    axis: str = "sp"
+    mesh_axes: tuple | None = None
+    causal: bool = True
+    vmem_limit_mb: int = 100
+    block_q: int = 256  # fallback path's flash blocks
+    block_k: int = 256
+
+    def __call__(self, q, k, v):
+        from triton_dist_tpu.kernels.ag_attention import (
+            ag_attention_supported,
+            ag_flash_attention_shard,
+        )
+
+        world = jax.lax.axis_size(self.axis)
+        b, hq, s_loc, d = q.shape
+        hkv = k.shape[1]
+        if ag_attention_supported(world, b, hq, hkv, s_loc, d,
+                                  q.dtype.itemsize, self.vmem_limit_mb):
+            return ag_flash_attention_shard(
+                q, k, v, axis=self.axis, mesh_axes=self.mesh_axes,
+                causal=self.causal, vmem_limit_mb=self.vmem_limit_mb)
+        return ring_attention_shard(
+            q, k, v, axis=self.axis, causal=self.causal,
+            block_q=self.block_q, block_k=self.block_k)

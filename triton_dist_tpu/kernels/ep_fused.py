@@ -131,7 +131,11 @@ def _fused_ep_kernel(
         are independent through the expert MLP, which is what makes
         source-granular streaming legal)."""
         if fp8:
-            panel = (xs[sl].astype(jnp.float32) * xs_s[sl]).astype(model_dtype)
+            # Scales live lane-replicated (rows, LANES); read the flash-
+            # kernel way ([:, :1]) — a (rows, 1) buffer can't be DMA-sliced
+            # on Mosaic's lane-padded memrefs (r5 Mosaic lowering find).
+            panel = (xs[sl].astype(jnp.float32)
+                     * xs_s[sl][:, :1]).astype(model_dtype)
         else:
             panel = xs[sl]
         g = jnp.dot(panel, wg_ref[0], preferred_element_type=jnp.float32)
@@ -317,6 +321,8 @@ def fused_moe_supported(world: int, cap: int, d: int, ff: int,
     bf = fit_block(ff, block_f)
     xs_item = 1 if wire_fp8 else itemsize
     panel = world * cap * d * (xs_item + 4 + (itemsize if combine else 0))
+    if wire_fp8:  # lane-replicated f32 scales (rows, 128) in VMEM
+        panel += world * cap * 128 * 4
     tiles = 2 * (2 * d * bf + bf * d) * itemsize  # double-buffered g/u/d tiles
     out_blocks = 0 if combine else 2 * world * cap * d * itemsize
     return panel + tiles + out_blocks <= vmem_limit_mb * 1024 * 1024
@@ -339,7 +345,18 @@ def _fused_ep_call(send, w_gate, w_up, w_down, *, capacity, axis, mesh_axes,
         from triton_dist_tpu.kernels.low_latency_a2a import quantize_fp8
 
         q, scl = quantize_fp8(send.reshape(world * chunk, d))
-        send_ops = (q.reshape(world, chunk, d), scl.reshape(world, chunk, 1))
+        # Lane-replicated scale payload: (world, chunk, LANES=128) — a
+        # (chunk, 1) slice of a lane-padded memref is not DMA-able under
+        # Mosaic (alignment 128 on the minor dim). Wire cost: 512 B/token
+        # of scales vs d bytes of fp8 payload — 12.5 % overhead at d=4096,
+        # so the in-kernel fp8 wire still saves ~44 % vs bf16 (documented
+        # honestly; the jit-level LL a2a keeps exact (chunk, 1) scales).
+        lanes = 128
+        send_ops = (
+            q.reshape(world, chunk, d),
+            jnp.broadcast_to(scl.reshape(world, chunk, 1),
+                             (world, chunk, lanes)),
+        )
     else:
         send_ops = (send,)
     wire_dtype = send_ops[0].dtype
@@ -365,7 +382,8 @@ def _fused_ep_call(send, w_gate, w_up, w_down, *, capacity, axis, mesh_axes,
     out_shape.append(jax.ShapeDtypeStruct((world, chunk, d), wire_dtype))
     if wire_fp8:
         out_specs.append(pl.BlockSpec(memory_space=pl.ANY))  # scale recv
-        out_shape.append(jax.ShapeDtypeStruct((world, chunk, 1), jnp.float32))
+        out_shape.append(
+            jax.ShapeDtypeStruct((world, chunk, 128), jnp.float32))
     if trace is not None:
         out_specs.append(trace.out_spec())
         out_shape.append(trace.out_shape)
@@ -377,7 +395,8 @@ def _fused_ep_call(send, w_gate, w_up, w_down, *, capacity, axis, mesh_axes,
     if combine:
         scratch.append(pltpu.VMEM((world * capacity, d), model_dtype))  # y_stage
     if wire_fp8:
-        scratch.append(pltpu.VMEM((world * capacity, 1), jnp.float32))  # xs_s
+        scratch.append(
+            pltpu.VMEM((world * capacity, 128), jnp.float32))  # xs_s (lanes)
     scratch += [
         pltpu.SemaphoreType.DMA,  # send
         pltpu.SemaphoreType.DMA((world,)),  # recv: one slot per SOURCE rank

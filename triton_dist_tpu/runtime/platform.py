@@ -85,12 +85,40 @@ def race_detection(enable: bool = True):
     return _ctx()
 
 
+_FORCE_MOSAIC = False
+
+
+def force_mosaic():
+    """Context manager forcing ``interpret_mode_default`` to False even on a
+    CPU host — for deviceless TPU-topology compiles (tests/test_tpu_lowering):
+    without it, tracing on a CPU default backend picks InterpretParams and
+    the topology compile silently exercises the pure-HLO interpret EMULATION
+    instead of Mosaic (found r5: the lowered module had zero
+    ``tpu_custom_call``s — the compile proved nothing about Mosaic)."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def _cm():
+        global _FORCE_MOSAIC
+        prev = _FORCE_MOSAIC
+        _FORCE_MOSAIC = True
+        try:
+            yield
+        finally:
+            _FORCE_MOSAIC = prev
+
+    return _cm()
+
+
 def interpret_mode_default(detect_races: bool = False):
     """Return the value for ``pallas_call(interpret=...)`` on this platform.
 
     On CPU returns ``pltpu.InterpretParams`` (full TPU simulation, incl. remote
     DMA + semaphores); on real TPU returns ``False`` (compile via Mosaic).
+    Under ``force_mosaic()`` always returns False (deviceless TPU compiles).
     """
+    if _FORCE_MOSAIC:
+        return False
     if is_cpu_platform():
         from jax.experimental.pallas import tpu as pltpu
 

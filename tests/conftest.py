@@ -73,6 +73,15 @@ def _module_cache_drain(request):
         import gc
 
         jax.clear_caches()
+        # Collective-id registry: ids need uniqueness only WITHIN one
+        # compiled program; clear_caches just dropped every compiled
+        # program, so the registry restarts too — without this, a
+        # suite-wide accumulation of distinct collective kernels (32-id
+        # Mosaic cap) fails whichever module compiles one past the cap
+        # (bit test_stress at 204 collected tests, r5).
+        from triton_dist_tpu.shmem.kernel import reset_collective_ids
+
+        reset_collective_ids()
         gc.collect()
     _last_module[0] = mod
     yield

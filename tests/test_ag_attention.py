@@ -153,3 +153,43 @@ def test_ag_sp_attn_layer_fallback(ctx4, rng):
         np.testing.assert_allclose(np.asarray(f(q, k, v)), ref,
                                    rtol=2e-4, atol=2e-4,
                                    err_msg=f"vmem_limit={limit}")
+
+
+def test_ag_attention_fn_grads(ctx4, rng):
+    """The DIFFERENTIABLE fused AG attention (r5): forward is the
+    one-kernel gather+flash; backward is one dense flash-bwd over the
+    kernel's already-gathered KV + psum_scatter (AG↔RS duality). Grads
+    must match the dense oracle's."""
+    from triton_dist_tpu.function import ag_attention_fn
+
+    b, hq, hkv, s_loc, d = 1, 4, 2, 16, 32
+    s = WORLD * s_loc
+    q = jnp.asarray(rng.standard_normal((b, hq, s, d)), jnp.float32) * 0.4
+    k = jnp.asarray(rng.standard_normal((b, hkv, s, d)), jnp.float32) * 0.4
+    v = jnp.asarray(rng.standard_normal((b, hkv, s, d)), jnp.float32) * 0.4
+
+    def ag_loss(q_, k_, v_):
+        o = jax.shard_map(
+            lambda a, bb, c: ag_attention_fn(a, bb, c, "tp", ("tp",)),
+            mesh=ctx4.mesh, in_specs=(P(None, None, "tp"),) * 3,
+            out_specs=P(None, None, "tp"), check_vma=False,
+        )(q_, k_, v_)
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    def ref_loss(q_, k_, v_):
+        g = hq // hkv
+        kf = jnp.repeat(k_, g, axis=1).astype(jnp.float32)
+        vf = jnp.repeat(v_, g, axis=1).astype(jnp.float32)
+        sc = jnp.einsum("bhqd,bhkd->bhqk", q_.astype(jnp.float32), kf)
+        sc = sc * (d ** -0.5)
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        sc = jnp.where(mask, sc, -jnp.inf)
+        p = jax.nn.softmax(sc, -1)
+        return jnp.sum(jnp.einsum("bhqk,bhkd->bhqd", p, vf) ** 2)
+
+    g_ag = jax.block_until_ready(
+        jax.jit(jax.grad(ag_loss, argnums=(0, 1, 2)))(q, k, v))
+    g_ref = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    for ga, gr, name in zip(g_ag, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(ga), np.asarray(gr),
+                                   rtol=5e-3, atol=5e-3, err_msg=name)

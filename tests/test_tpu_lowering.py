@@ -342,7 +342,8 @@ def test_ring_2d_schedule_hops_under_flash(tpu_mesh):
 def test_lowering_ag_attention(tpu_mesh):
     """The fused AG-SP attention kernel (one-sided KV gather + per-source
     waits + streaming online softmax in ONE kernel) compiles via Mosaic
-    for the 8-chip topology."""
+    for the 8-chip topology — both the inference variant and the training
+    forward (LSE + gathered-KV residuals for ``ag_attention_fn``)."""
     from triton_dist_tpu.kernels.ag_attention import ag_flash_attention_shard
 
     b, hq, hkv, s_loc, d = 1, 8, 2, 512, 128
@@ -355,6 +356,16 @@ def test_lowering_ag_attention(tpu_mesh):
         lambda q_, k_, v_: ag_flash_attention_shard(
             q_, k_, v_, axis="tp", mesh_axes=("tp",), causal=True
         ),
+        (q, k, v),
+        (P(None, None, "tp"),) * 3,
+        P(None, None, "tp"),
+    )
+    compile_sharded(
+        tpu_mesh,
+        lambda q_, k_, v_: ag_flash_attention_shard(
+            q_, k_, v_, axis="tp", mesh_axes=("tp",), causal=True,
+            return_residuals=True,
+        )[0],
         (q, k, v),
         (P(None, None, "tp"),) * 3,
         P(None, None, "tp"),

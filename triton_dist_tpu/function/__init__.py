@@ -9,6 +9,7 @@ compiler-default collectives.
 """
 
 from triton_dist_tpu.function.collectives import (
+    ag_attention_fn,
     ag_gemm_fn,
     flash_attention_fn,
     flash_attention_varlen_fn,
@@ -26,6 +27,7 @@ from triton_dist_tpu.function.collectives import (
 from triton_dist_tpu.function.ep_moe import ep_moe_fused_fn
 
 __all__ = [
+    "ag_attention_fn",
     "ag_gemm_fn",
     "flash_attention_fn",
     "flash_attention_varlen_fn",

@@ -442,6 +442,87 @@ def ring_attention_2d_varlen_fn(
                             attend=_varlen_lse_attend(cu_seqlens, scale))
 
 
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def ag_attention_fn(q, k, v, axis: str = "sp", mesh_axes=None,
+                    scale=None, vmem_limit_mb: int = 100):
+    """DIFFERENTIABLE fused AG-SP attention (causal): the forward is the
+    ONE-kernel gather+flash (``kernels.ag_attention``), whose landing
+    zones already hold the full gathered KV — so the backward pays zero
+    extra gather: one dense Pallas flash-bwd over the gathered KV at this
+    rank's global offset, then ``psum_scatter`` returns dk/dv shards to
+    their owners (the AG↔RS duality, same as ``ag_gemm_fn``'s backward).
+
+    Memory note: the residuals keep the FULL gathered KV per rank
+    (O(world·S_local)) — the price of the fused forward; for long-context
+    training beyond that budget use ``ring_attention_fn`` (O(S_local)
+    residency, recompute-per-step). Raises when the VMEM plan (including
+    the LSE output) doesn't fit rather than failing in Mosaic. Inside
+    shard_map."""
+    from triton_dist_tpu.kernels.ag_attention import ag_flash_attention_shard
+
+    _ag_attn_check(q, k, axis, vmem_limit_mb)
+    return ag_flash_attention_shard(
+        q, k, v, axis=axis, mesh_axes=mesh_axes, causal=True, scale=scale,
+        vmem_limit_mb=vmem_limit_mb)
+
+
+def _ag_attn_check(q, k, axis, vmem_limit_mb):
+    from triton_dist_tpu.kernels.ag_attention import ag_attention_supported
+
+    world = jax.lax.axis_size(axis)
+    b, hq, s_loc, d = q.shape
+    if not ag_attention_supported(world, b, hq, k.shape[1], s_loc, d,
+                                  q.dtype.itemsize, vmem_limit_mb,
+                                  with_residuals=True):
+        raise ValueError(
+            "ag_attention_fn: the fused kernel's VMEM plan (with LSE "
+            "residuals) does not fit this shape — use ring_attention_fn "
+            "(O(S_local) residency) for long-context training")
+
+
+def _ag_attn_fwd(q, k, v, axis, mesh_axes, scale, vmem_limit_mb):
+    from triton_dist_tpu.kernels.ag_attention import ag_flash_attention_shard
+
+    _ag_attn_check(q, k, axis, vmem_limit_mb)
+    o, (lse, k_full, v_full) = ag_flash_attention_shard(
+        q, k, v, axis=axis, mesh_axes=mesh_axes, causal=True, scale=scale,
+        vmem_limit_mb=vmem_limit_mb, return_residuals=True)
+    return o, (q, k_full, v_full, o, lse)
+
+
+def _ag_attn_bwd(axis, mesh_axes, scale, vmem_limit_mb, res, do):
+    from triton_dist_tpu.kernels.flash_attn import flash_attention_bwd
+
+    q, k_full, v_full, o, lse = res
+    world = jax.lax.axis_size(axis)
+    s_loc = q.shape[2]
+    me = jax.lax.axis_index(axis)
+    # block=None -> the tuned flash-bwd cache decides (fit_block shrinks
+    # for short sequences), same as every other flash-bwd call site.
+    dq, dk_full, dv_full = flash_attention_bwd(
+        q, k_full, v_full, o, lse, do, causal=True, scale=scale,
+        q_offset=(me * s_loc).astype(jnp.int32),
+        kv_offset=jnp.int32(0),
+    )
+    if world > 1:
+        # Each rank computed ITS queries' contribution to every KV shard;
+        # shard j's gradient is the cross-rank sum of block j — summed in
+        # f32 (the bwd kernel rounds its outputs to k.dtype; summing
+        # world bf16 partials would lose ~log2(world) bits), cast once.
+        dk = jax.lax.psum_scatter(
+            dk_full.astype(jnp.float32), axis, scatter_dimension=2,
+            tiled=True).astype(k_full.dtype)
+        dv = jax.lax.psum_scatter(
+            dv_full.astype(jnp.float32), axis, scatter_dimension=2,
+            tiled=True).astype(v_full.dtype)
+    else:
+        dk, dv = dk_full, dv_full
+    return dq, dk, dv
+
+
+ag_attention_fn.defvjp(_ag_attn_fwd, _ag_attn_bwd)
+
+
 @partial(jax.custom_vjp, nondiff_argnums=(4,))
 def flash_attention_varlen_fn(q, k, v, cu_seqlens, scale: float | None = None):
     """Differentiable varlen (packed-sequence) flash attention: the Pallas

@@ -59,9 +59,11 @@ def _ag_attn_kernel(
     scale: float,
     s_loc: int,
     group: int,
+    with_lse: bool = False,
     trace=None,
 ):
     it = iter(rest)
+    lse_ref = next(it) if with_lse else None  # VMEM (BHkv, gS, LANES) f32
     ev_ref = next(it) if trace is not None else None
     q_vmem = next(it)
     k_vmem = next(it)
@@ -181,11 +183,20 @@ def _ag_attn_kernel(
         l = l_scr[:, :, :1]
         l_safe = jnp.where(l == 0.0, 1.0, l)
         o_ref[...] = (acc[...] / l_safe).astype(o_ref.dtype)
+        if with_lse:
+            # Full-lane math (every lane holds the same m/l value), NATS —
+            # the contract flash_attention_bwd's delta correction expects.
+            lse_ref[...] = jnp.where(
+                l_scr[...] == 0.0,
+                NEG_INF,
+                m_scr[...] + jnp.log(jnp.maximum(l_scr[...], 1e-30)),
+            )
 
 
 def ag_attention_supported(world: int, b: int, hq: int, hkv: int,
                            s_loc: int, d: int, itemsize: int,
-                           vmem_limit_mb: int = 100) -> bool:
+                           vmem_limit_mb: int = 100,
+                           with_residuals: bool = False) -> bool:
     """Static VMEM-plan check: resident q + o + one visiting KV shard +
     f32 accumulators + m/l lanes + the per-step (gS, S_loc) f32
     score/p/mask temporaries of the unblocked whole-shard dot — the term
@@ -199,7 +210,9 @@ def ag_attention_supported(world: int, b: int, hq: int, hkv: int,
     accs = bhkv * gs * d * 4
     ml = 2 * bhkv * gs * LANES * 4
     tmps = 3 * bhkv * gs * s_loc * 4  # scores + p + where/mask temp, f32
-    return q_o + kv + accs + ml + tmps <= vmem_limit_mb * 1024 * 1024
+    lse_out = bhkv * gs * LANES * 4 if with_residuals else 0
+    return (q_o + kv + accs + ml + tmps + lse_out
+            <= vmem_limit_mb * 1024 * 1024)
 
 
 def ag_flash_attention_shard(
@@ -212,12 +225,21 @@ def ag_flash_attention_shard(
     causal: bool = True,
     scale: float | None = None,
     vmem_limit_mb: int = 100,
+    return_residuals: bool = False,
     trace=None,
 ):
     """Exact attention over the full world*S_local sequence with ONE fused
     kernel per rank: one-sided KV gather + per-source waits + streaming
     online-softmax (module docstring). Returns (B, Hq, S_local, D) (+ this
     rank's trace events when ``trace`` is given). Inside shard_map.
+
+    ``return_residuals`` additionally returns ``(lse, k_full, v_full)`` —
+    the per-row log-sum-exp (NATS, (B, Hq, S_local) f32) and the
+    ALREADY-GATHERED full-sequence KV (B, Hkv, world·S_local, D) that the
+    kernel's landing zones hold anyway. These are exactly the residuals
+    ``function.ag_attention_fn``'s backward needs (one dense flash-bwd over
+    the gathered KV + a psum_scatter — the AG↔RS duality), so the training
+    path pays ZERO extra forward work for them.
 
     Falls back to nothing here — callers should check
     ``ag_attention_supported`` and use ``ring_attention_shard`` when the
@@ -233,6 +255,12 @@ def ag_flash_attention_shard(
         from triton_dist_tpu.kernels.flash_attn import flash_attention
 
         assert trace is None, "trace requires the multi-rank kernel path"
+        if return_residuals:
+            o1, lse1 = flash_attention(
+                q, k, v, causal=causal, scale=sc,
+                block_q=min(1024, s_loc), block_k=min(1024, s_loc),
+                return_lse=True)
+            return o1, (lse1, k, v)
         return flash_attention(q, k, v, causal=causal, scale=sc,
                                block_q=min(1024, s_loc),
                                block_k=min(1024, s_loc))
@@ -256,6 +284,9 @@ def ag_flash_attention_shard(
         jax.ShapeDtypeStruct((world, bhkv, s_loc, d), k.dtype),
         jax.ShapeDtypeStruct((world, bhkv, s_loc, d), v.dtype),
     ]
+    if return_residuals:
+        out_specs.append(pl.BlockSpec((bhkv, gs, LANES), lambda s: (0, 0, 0)))
+        out_shape.append(jax.ShapeDtypeStruct((bhkv, gs, LANES), jnp.float32))
     if trace is not None:
         out_specs.append(trace.out_spec())
         out_shape.append(trace.out_shape)
@@ -263,7 +294,8 @@ def ag_flash_attention_shard(
     res = dist_pallas_call(
         functools.partial(
             _ag_attn_kernel, axis=axis, mesh_axes=mesh_axes, causal=causal,
-            scale=sc, s_loc=s_loc, group=group, trace=trace,
+            scale=sc, s_loc=s_loc, group=group,
+            with_lse=return_residuals, trace=trace,
         ),
         grid=(world,),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 3,
@@ -285,11 +317,26 @@ def ag_flash_attention_shard(
             has_side_effects=True,
             vmem_limit_bytes=vmem_limit_mb * 1024 * 1024,
             collective_id=collective_id_for(
-                f"_ag_attn_kernel:causal={causal}:trace={trace is not None}"
+                f"_ag_attn_kernel:causal={causal}"
+                f":lse={return_residuals}:trace={trace is not None}"
             ),
         ),
     )(qf, kf, vf)
     o = res[0].reshape(b, hkv, group, s_loc, d).reshape(b, hq, s_loc, d)
+    if return_residuals:
+        # Unfold: lanes are replicated, take lane 0; shard-major landing
+        # zones concatenate in rank order = global sequence order.
+        lse = (res[3][..., 0].reshape(b, hkv, group, s_loc)
+               .reshape(b, hq, s_loc))
+        k_full = (res[1].transpose(1, 0, 2, 3)
+                  .reshape(bhkv, world * s_loc, d)
+                  .reshape(b, hkv, world * s_loc, d))
+        v_full = (res[2].transpose(1, 0, 2, 3)
+                  .reshape(bhkv, world * s_loc, d)
+                  .reshape(b, hkv, world * s_loc, d))
+        if trace is not None:
+            return o, (lse, k_full, v_full), res[4]
+        return o, (lse, k_full, v_full)
     if trace is not None:
         return o, res[3]
     return o

@@ -470,6 +470,11 @@ def _ag_attn_check(q, k, axis, vmem_limit_mb):
     from triton_dist_tpu.kernels.ag_attention import ag_attention_supported
 
     world = jax.lax.axis_size(axis)
+    if world == 1:
+        # Degenerate dispatch goes to BLOCKED flash_attention (O(block)
+        # VMEM), not the fused whole-shard kernel — the plan check would
+        # spuriously reject long single-rank sequences.
+        return
     b, hq, s_loc, d = q.shape
     if not ag_attention_supported(world, b, hq, k.shape[1], s_loc, d,
                                   q.dtype.itemsize, vmem_limit_mb,

@@ -39,10 +39,8 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 import triton_dist_tpu.language as tpl
+from triton_dist_tpu.kernels.flash_attn import LANES, NEG_INF
 from triton_dist_tpu.shmem.kernel import collective_id_for, dist_pallas_call
-
-NEG_INF = -1e30
-LANES = 128
 
 
 def _ag_attn_kernel(

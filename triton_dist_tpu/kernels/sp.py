@@ -40,7 +40,9 @@ def _merge_partials(o1, lse1, o2, lse2):
     −inf — ``m`` would then be −inf and ``lse − m`` produce NaN (inf−inf).
     The clamp below enforces the contract for any ``attend`` implementation
     the 1D/2D ring drivers are handed."""
-    neg_inf = jnp.float32(-1e30)
+    from triton_dist_tpu.kernels.flash_attn import NEG_INF
+
+    neg_inf = jnp.float32(NEG_INF)
     lse1 = jnp.maximum(lse1, neg_inf)
     lse2 = jnp.maximum(lse2, neg_inf)
     m = jnp.maximum(lse1, lse2)

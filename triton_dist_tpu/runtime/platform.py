@@ -13,6 +13,7 @@ DMAs and semaphores on CPU — including optional race detection
 from __future__ import annotations
 
 import os
+from contextlib import contextmanager
 from functools import lru_cache
 
 _CPU_DEVICE_ENV = "--xla_force_host_platform_device_count"
@@ -88,6 +89,7 @@ def race_detection(enable: bool = True):
 _FORCE_MOSAIC = False
 
 
+@contextmanager
 def force_mosaic():
     """Context manager forcing ``interpret_mode_default`` to False even on a
     CPU host — for deviceless TPU-topology compiles (tests/test_tpu_lowering):
@@ -95,19 +97,13 @@ def force_mosaic():
     the topology compile silently exercises the pure-HLO interpret EMULATION
     instead of Mosaic (found r5: the lowered module had zero
     ``tpu_custom_call``s — the compile proved nothing about Mosaic)."""
-    import contextlib
-
-    @contextlib.contextmanager
-    def _cm():
-        global _FORCE_MOSAIC
-        prev = _FORCE_MOSAIC
-        _FORCE_MOSAIC = True
-        try:
-            yield
-        finally:
-            _FORCE_MOSAIC = prev
-
-    return _cm()
+    global _FORCE_MOSAIC
+    prev = _FORCE_MOSAIC
+    _FORCE_MOSAIC = True
+    try:
+        yield
+    finally:
+        _FORCE_MOSAIC = prev
 
 
 def interpret_mode_default(detect_races: bool = False):

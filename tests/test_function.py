@@ -382,49 +382,62 @@ def test_varlen_flash_grads(rng):
 
 
 @pytest.mark.timeout(900)
-def test_ring_attention_2d_grad(ctx24, rng):
+def test_ring_attention_2d_grad():
     """DIFFERENTIABLE two-level ring attention on the (2,4) mesh: grads
     through the DCN superblock hops + ICI ring ppermutes + per-step Pallas
     flash VJPs match dense autodiff of global attention (r4 — long-context
     training at the 2D scale the inference ring serves).
 
-    Tiny shapes on purpose: the backward runs 8 ranks x 8 steps of
-    interpret-mode kernel pairs between collective rendezvous points, and
-    XLA's CPU rendezvous hard-aborts a rank that stays busy in callbacks
-    past its timeout (the conftest-documented sim limitation) — protocol
-    correctness is shape-independent."""
-    from triton_dist_tpu.function import ring_attention_2d_fn
-    from triton_dist_tpu.kernels.flash_attn import attention_reference
+    Runs ISOLATED (tests/_isolation.py): the backward runs 8 ranks x 8
+    steps of interpret-mode kernel pairs between collective rendezvous
+    points, and XLA's CPU rendezvous hard-aborts a rank that stays busy in
+    callbacks past its fixed 40 s deadline — a nondeterministic substrate
+    race this test empirically lost ~1 in 5 full-suite runs (r5), taking
+    the whole pytest process down with it. In its own interpreter the race
+    window shrinks (no accumulated prefix state) and the two substrate-race
+    outcomes (abort, or a zero-progress wedge) retry with fresh
+    interpreters; assertions never retry."""
+    from _isolation import run_isolated
 
-    b, h, s_loc, d = 1, 1, 8, 16
-    s = 8 * s_loc
-    q = jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.float32) * 0.3
-    k = jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.float32) * 0.3
-    v = jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.float32) * 0.3
-    c = jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.float32)
+    run_isolated("""
+import jax, jax.numpy as jnp, numpy as np
+from jax.sharding import PartitionSpec as P
+from triton_dist_tpu.runtime.mesh import initialize_distributed
+from triton_dist_tpu.function import ring_attention_2d_fn
+from triton_dist_tpu.kernels.flash_attn import attention_reference
 
-    def loss_ring(q_, k_, v_, c_):
-        out = ring_attention_2d_fn(q_, k_, v_, axes=("dp", "tp"),
-                                   block_q=8, block_k=8)
-        return jax.lax.psum(jax.lax.psum(jnp.sum(out * c_), "tp"),
-                            "dp").reshape(())
+ctx = initialize_distributed(axis_names=("dp", "tp"), axis_sizes=(2, 4))
+rng = np.random.default_rng(5)
+b, h, s_loc, d = 1, 1, 8, 16
+s = 8 * s_loc
+q = jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.float32) * 0.3
+k = jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.float32) * 0.3
+v = jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.float32) * 0.3
+c = jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.float32)
 
-    grads = jax.jit(
-        jax.grad(
-            lambda *a: jax.shard_map(
-                loss_ring, mesh=ctx24.mesh,
-                in_specs=(P(None, None, ("dp", "tp")),) * 4, out_specs=P(),
-                check_vma=False,
-            )(*a)[()],
-            argnums=(0, 1, 2),
-        )
-    )(q, k, v, c)
+def loss_ring(q_, k_, v_, c_):
+    out = ring_attention_2d_fn(q_, k_, v_, axes=("dp", "tp"),
+                               block_q=8, block_k=8)
+    return jax.lax.psum(jax.lax.psum(jnp.sum(out * c_), "tp"),
+                        "dp").reshape(())
 
-    def loss_dense(q_, k_, v_):
-        return jnp.sum(attention_reference(q_, k_, v_, causal=True) * c)
+grads = jax.jit(
+    jax.grad(
+        lambda *a: jax.shard_map(
+            loss_ring, mesh=ctx.mesh,
+            in_specs=(P(None, None, ("dp", "tp")),) * 4, out_specs=P(),
+            check_vma=False,
+        )(*a)[()],
+        argnums=(0, 1, 2),
+    )
+)(q, k, v, c)
 
-    ref = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
-    for g_, r_, name in zip(grads, ref, "qkv"):
-        np.testing.assert_allclose(
-            np.asarray(g_), np.asarray(r_), rtol=3e-4, atol=3e-4, err_msg=name
-        )
+def loss_dense(q_, k_, v_):
+    return jnp.sum(attention_reference(q_, k_, v_, causal=True) * c)
+
+ref = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+for g_, r_, name in zip(grads, ref, "qkv"):
+    np.testing.assert_allclose(
+        np.asarray(g_), np.asarray(r_), rtol=3e-4, atol=3e-4, err_msg=name)
+print("ISOLATED_OK")
+""")

@@ -5,8 +5,11 @@ metric: Pallas flash attention (causal prefill, GQA) vs XLA's fused SDPA on
 the same shape — the framework's headline single-chip custom kernel (the
 reference benches its kernels against torch/cuBLAS equivalents the same way,
 SURVEY §6). ``extra`` reports the tuned plain GEMM and fused gemm+swiglu
-ratios vs the XLA dot, and the fused AG-GEMM kernel in degenerate world=1
-mode (VERDICT r1 item 2).
+ratios vs the XLA dot, the fused AG-GEMM kernel in degenerate world=1
+mode (VERDICT r1 item 2), and the ``gemm_ar_decode`` section — the fused
+low-latency GEMM-AR kernel vs its unfused compositions and ``dot + psum``
+at decode-sized M (world=1 degenerate; runs on CPU smoke too), emitting
+``gemm_ar_crossover|world=N`` tune entries on hardware.
 
 Measured finding (r2, v5e): XLA's native matmul emitter saturates the chip
 (~192-198 TFLOP/s bf16 on 4096³) and Mosaic-compiled plain GEMMs plateau at
@@ -492,6 +495,125 @@ def bench_decode_collectives(on_tpu):
             "time_s": floor_oneshot_s, "version": __version__,
         }
     out["tune_entries"] = entries
+    return out
+
+
+def bench_gemm_ar_decode(on_tpu):
+    """Decode-regime GEMM+AR routing data (PR 1 tentpole): times the fused
+    low-latency kernel (``gemm_ar_ll_call``, world=1 ring-degenerate — the
+    kernel-overhead floor) against the unfused compositions it replaces
+    (dot + one-shot push-AR kernel; the rs_ag path's dispatch) and the
+    ``dot + psum`` XLA baseline, at the tiny-M shapes the mega decode
+    backend issues. Unlike ``bench_decode_collectives`` this section runs
+    on CPU smoke too (world=1 degenerate, small f32 shape) so the
+    ``gemm_ar_decode`` extras are exercised on every bench invocation, not
+    only on hardware. On TPU it additionally solves the ll↔fused M
+    crossover from the measured floor + the perf model's ring bandwidth
+    and emits cache-ready ``gemm_ar_crossover|world=<w>`` entries feeding
+    ``get_auto_gemm_ar_method`` (consumed through
+    ``tune.agreed_cfg_value`` — cross-rank agreed, never a plain local
+    cache read)."""
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from triton_dist_tpu.kernels.allreduce import one_shot_ar_call
+    from triton_dist_tpu.kernels.gemm_allreduce import (
+        DEFAULT_GEMM_AR_CROSSOVER_M,
+        GemmARMethod,
+        gemm_ar_ll_call,
+        gemm_ar_shard,
+    )
+    from triton_dist_tpu.tools.timing import bench_device_time
+
+    if on_tpu:
+        m, k, n = 32, 4096, 4096
+        dtype = jnp.bfloat16
+    else:
+        m, k, n = 8, 128, 128
+        dtype = jnp.float32
+
+    a = jax.random.normal(jax.random.PRNGKey(1), (m, k), jnp.float32).astype(dtype)
+    b = jax.random.normal(jax.random.PRNGKey(2), (k, n), jnp.float32).astype(dtype)
+    out = {"gemm_ar_decode_shape": f"{m}x{k}x{n}"}
+    chain = lambda o, args: (jnp.clip(o.astype(jnp.float32), -1, 1)
+                             .astype(args[0].dtype),) + tuple(args[1:])
+    mesh = Mesh(np.asarray(jax.devices()[:1]), ("tp",))
+
+    def shard1(fn):
+        return jax.shard_map(fn, mesh=mesh, in_specs=(P(), P()),
+                             out_specs=P(), check_vma=False)
+
+    # Thunks, not callables: even BUILDING the shard_map wrapper can raise
+    # on a backend without it — construction must happen inside the
+    # per-candidate isolation below.
+    candidates = {
+        "ll_fused": lambda: shard1(
+            lambda x, w: gemm_ar_ll_call(x, w, axis="tp", mesh_axes=("tp",))),
+        "oneshot_compose": lambda: shard1(
+            lambda x, w: one_shot_ar_call(
+                jnp.dot(x, w, preferred_element_type=jnp.float32).astype(x.dtype),
+                axis="tp", mesh_axes=("tp",))),
+        "rs_ag_compose": lambda: shard1(
+            lambda x, w: gemm_ar_shard(
+                x, w, axis="tp", mesh_axes=("tp",),
+                method=GemmARMethod.RS_AG)),
+        # psum over a 1-mesh is the identity, so the world=1 degenerate of
+        # dot+psum is the plain dot — timed without shard_map so CPU smoke
+        # always lands at least one number even on a backend whose
+        # shard_map/pallas path can't run.
+        "dot_psum": lambda: lambda x, w: jnp.dot(
+            x, w, preferred_element_type=jnp.float32).astype(x.dtype),
+    }
+    times = {}
+    for name, build in candidates.items():
+        # Per-candidate isolation: a kernel-path failure (e.g. no interpret
+        # support on this backend) must not blank the baseline columns.
+        try:
+            t = bench_device_time(build(), (a, b), chain=chain, iters=64)
+            times[name] = t
+            out[f"gemm_ar_decode_{name}_us"] = round(t * 1e6, 2)
+        except Exception as e:  # noqa: BLE001
+            out[f"gemm_ar_decode_{name}_error"] = f"{type(e).__name__}"
+    if "ll_fused" in times and "dot_psum" in times:
+        out["gemm_ar_decode_ll_vs_xla"] = round(
+            times["dot_psum"] / times["ll_fused"], 3)
+    if "ll_fused" in times and "oneshot_compose" in times:
+        out["gemm_ar_decode_ll_vs_oneshot"] = round(
+            times["oneshot_compose"] / times["ll_fused"], 3)
+
+    if on_tpu and "ll_fused" in times:
+        # ll↔pallas_fused M crossover (same honesty scheme as the
+        # ar_crossover entry above): ll ships (w−1)·m·n fp32 partials per
+        # chip; the fused RS+AG ring ships 2·(w−1)/w·m·n output-dtype
+        # elements but pays ~2 ring phases of kernel floor (F_fused≈2·F_ll).
+        # Crossover at  F_ll = m·(ll_wire_per_m − fused_wire_per_m)  — the
+        # extra floor bought back by ll's heavier per-row egress. Clamped
+        # to [8, 512] so one noisy floor can't route every decode GEMM to
+        # a single method.
+        from triton_dist_tpu.tools.perf_model import _ring_bw, chip_spec
+        from triton_dist_tpu.version import __version__
+
+        f_ll = times["ll_fused"]
+        bw = _ring_bw(chip_spec())
+        wire_bytes = 2  # bf16 output elements on the fused ring
+        entries = {}
+        for w in (4, 8):
+            # Cost difference per unit m: ll pays fp32 partial egress, the
+            # fused ring pays 2·(w−1)/w output-dtype egress + one extra
+            # kernel floor. Crossover where the floors' gap equals the
+            # per-m wire gap.
+            ll_per_m = (w - 1) * n * 4 / bw
+            fused_per_m = 2 * (w - 1) / w * n * wire_bytes / bw
+            gap = ll_per_m - fused_per_m
+            m_star = int(f_ll / gap) if gap > 0 else DEFAULT_GEMM_AR_CROSSOVER_M
+            m_star = int(min(max(m_star, 8), 512))
+            out[f"gemm_ar_crossover_w{w}_m"] = m_star
+            entries[f"gemm_ar_crossover|world={w}"] = {
+                "cfg": {"crossover_m": m_star,
+                        "default_was": DEFAULT_GEMM_AR_CROSSOVER_M},
+                "time_s": f_ll, "version": __version__,
+            }
+        out["tune_entries"] = entries
     return out
 
 
@@ -986,6 +1108,15 @@ def main():
         emit()
     else:
         extra["decode_collectives_skipped"] = "budget"
+    if remaining() > 45:
+        phase("gemm_ar_decode")
+        try:
+            absorb(bench_gemm_ar_decode(on_tpu))
+        except Exception as e:  # noqa: BLE001
+            extra["gemm_ar_decode_error"] = f"{type(e).__name__}"
+        emit()
+    else:
+        extra["gemm_ar_decode_skipped"] = "budget"
     if remaining() > 60:
         phase("dma_overlap")
         try:

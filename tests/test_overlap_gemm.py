@@ -113,7 +113,9 @@ def test_gemm_rs_fused_tiled(ctx8, rng):
 
 
 @pytest.mark.parametrize(
-    "method", [GemmARMethod.RS_AG, GemmARMethod.ONE_SHOT, GemmARMethod.XLA]
+    "method",
+    [GemmARMethod.RS_AG, GemmARMethod.ONE_SHOT, GemmARMethod.XLA,
+     GemmARMethod.PALLAS_FUSED, GemmARMethod.LL_ONE_SHOT],
 )
 def test_gemm_ar_shard(ctx8, rng, method):
     m, k, n = 16, 8 * 32, 128
@@ -130,6 +132,124 @@ def test_gemm_ar_shard(ctx8, rng, method):
     expect = np.asarray(a) @ np.asarray(b)
     for r in range(WORLD):
         np.testing.assert_allclose(out[r], expect, rtol=1e-4, atol=1e-4, err_msg=f"rank {r}")
+
+
+@pytest.mark.parametrize("ctx_name,world", [("ctx8", 8), ("ctx4", 4)])
+@pytest.mark.parametrize("shape", ["square", "tiny_m"])
+@pytest.mark.parametrize(
+    "method", [GemmARMethod.PALLAS_FUSED, GemmARMethod.LL_ONE_SHOT]
+)
+def test_gemm_ar_matches_dot_psum(request, rng, ctx_name, world, shape, method):
+    """fp32-accum parity vs ``dot + psum`` computed INSIDE the same
+    shard_map, at world 4 and 8, square and tiny-M shapes. ll_one_shot
+    keeps fp32 partials on the wire and reduces in rank order 0..w-1 —
+    the same order the psum reference uses — so it must be EXACT. The
+    fused ring starts each chunk's accumulation at a rotated rank
+    (chunk c sums c+1, c+2, ..., c), so its fp32 sum can differ from the
+    reference in the last ulp — last-ulp tolerance, nothing looser."""
+    ctx = request.getfixturevalue(ctx_name)
+    m, n = (32, 32) if shape == "square" else (8, 64)
+    k = world * 16
+    a = jnp.asarray(rng.standard_normal((m, k)), jnp.float32)
+    b = jnp.asarray(rng.standard_normal((k, n)), jnp.float32)
+
+    def fn(a_s, b_s):
+        ref = jax.lax.psum(
+            jax.lax.dot_general(
+                a_s, b_s, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ),
+            "tp",
+        ).astype(a_s.dtype)
+        out = gemm_ar_shard(a_s, b_s, axis="tp", method=method)
+        return out[None], ref[None]
+
+    f = shard(ctx, fn, (P(None, "tp"), P("tp")), (P("tp"), P("tp")))
+    out, ref = f(a, b)
+    out, ref = np.asarray(out), np.asarray(ref)
+    for r in range(world):
+        if method is GemmARMethod.LL_ONE_SHOT:
+            np.testing.assert_array_equal(out[r], ref[r], err_msg=f"rank {r}")
+        else:
+            np.testing.assert_allclose(out[r], ref[r], rtol=2e-7, atol=1e-6,
+                                       err_msg=f"rank {r}")
+
+
+@pytest.mark.parametrize("ctx_name,world,m", [("ctx8", 8, 12), ("ctx4", 4, 6)])
+def test_gemm_ar_ll_ragged_m(request, rng, ctx_name, world, m):
+    """Ragged decode M (not divisible by world — the shape that forces AUTO
+    off the fused ring): the ll kernel carries full-M panels so any row
+    count works, and stays exact vs the fp32-accum dot+psum reference."""
+    ctx = request.getfixturevalue(ctx_name)
+    k, n = world * 16, 64
+    a = jnp.asarray(rng.standard_normal((m, k)), jnp.float32)
+    b = jnp.asarray(rng.standard_normal((k, n)), jnp.float32)
+
+    def fn(a_s, b_s):
+        ref = jax.lax.psum(
+            jax.lax.dot_general(
+                a_s, b_s, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ),
+            "tp",
+        ).astype(a_s.dtype)
+        # AUTO must route the ragged shape here (ll_one_shot) by itself.
+        out = gemm_ar_shard(a_s, b_s, axis="tp", method=GemmARMethod.AUTO)
+        return out[None], ref[None]
+
+    f = shard(ctx, fn, (P(None, "tp"), P("tp")), (P("tp"), P("tp")))
+    out, ref = f(a, b)
+    out, ref = np.asarray(out), np.asarray(ref)
+    for r in range(world):
+        np.testing.assert_array_equal(out[r], ref[r], err_msg=f"rank {r}")
+
+
+def test_gemm_ar_fused_tiled(ctx8, rng):
+    """Multi-tile fused GEMM-AR: Mt=2, Nt=2, Kt=2 per ring step so the
+    tile→send-buffer DMAs, output-tile staging, RS slot reuse + credit
+    backpressure, AND the AG broadcast ring all engage (the GEMM-AR analog
+    of test_gemm_rs_fused_tiled)."""
+    from triton_dist_tpu.kernels.gemm import GemmConfig
+
+    m, k, n = 8 * 16, 8 * 16, 32  # chunk = 16 rows/rank
+    a = jnp.asarray(rng.standard_normal((m, k)), jnp.float32)
+    b = jnp.asarray(rng.standard_normal((k, n)), jnp.float32)
+
+    f = shard(
+        ctx8,
+        lambda a_s, b_s: gemm_ar_shard(
+            a_s, b_s, axis="tp", method=GemmARMethod.PALLAS_FUSED,
+            gemm_config=GemmConfig(block_m=8, block_n=16, block_k=8),
+        )[None],
+        (P(None, "tp"), P("tp")),
+        P("tp"),
+    )
+    out = np.asarray(f(a, b))
+    expect = np.asarray(a) @ np.asarray(b)
+    for r in range(WORLD):
+        np.testing.assert_allclose(out[r], expect, rtol=1e-4, atol=1e-4,
+                                   err_msg=f"rank {r}")
+
+
+def test_gemm_ar_auto_routing():
+    """AUTO's M/world crossover (pure trace-time routing, no devices):
+    decode-sized and ragged M take the low-latency one-shot kernel, large
+    divisible M takes the fused RS+AG ring. Uses the static default
+    crossover (cold tune cache)."""
+    from triton_dist_tpu.kernels.gemm_allreduce import (
+        DEFAULT_GEMM_AR_CROSSOVER_M,
+        get_auto_gemm_ar_method,
+    )
+
+    for world in (4, 8):
+        # Decode shapes: tiny M, at/below the crossover.
+        assert get_auto_gemm_ar_method(8, world) is GemmARMethod.LL_ONE_SHOT
+        assert (get_auto_gemm_ar_method(DEFAULT_GEMM_AR_CROSSOVER_M, world)
+                is GemmARMethod.LL_ONE_SHOT)
+        # Prefill-sized M above the crossover: the fused ring.
+        assert get_auto_gemm_ar_method(4096, world) is GemmARMethod.PALLAS_FUSED
+        # Ragged M can't chunk over ranks — ll regardless of size.
+        assert get_auto_gemm_ar_method(4096 + 1, world) is GemmARMethod.LL_ONE_SHOT
 
 
 def test_ag_gemm_pallas_tiled(ctx8, rng):

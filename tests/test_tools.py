@@ -382,6 +382,128 @@ def test_bench_tune_entries_round_trip(tmp_path, monkeypatch):
         tune.merge_entries({"bad": {"time_s": 1.0}})
 
 
+def test_tune_cache_schema_version(tmp_path, monkeypatch):
+    """Cache files from another schema load EMPTY — stale pre-PR files are
+    ignored wholesale, never half-read (their entries may predate
+    routing-relevant fields like the crossover values), and ``save()``
+    stamps the current schema so the next load round-trips."""
+    from triton_dist_tpu.tools import tune
+
+    path = tmp_path / "cache.json"
+    monkeypatch.setenv("TDT_TUNE_CACHE", str(path))
+    tune._default_cache = None
+    key = "gemm|8x8:float32,8x8:float32"
+    entry = {"cfg": {"block_m": 8}, "time_s": 1.0, "version": "x"}
+
+    # A pre-schema (v1-era) file: valid entries, no __schema__ marker.
+    path.write_text(json.dumps({key: entry}))
+    cache = tune.TuneCache()
+    assert cache.get(key) is None
+    assert not cache.has_op("gemm")
+
+    # save() stamps the CURRENT schema; a fresh load round-trips entries
+    # and never surfaces the marker as an entry.
+    cache.put(key, entry)
+    cache.save()
+    raw = json.loads(path.read_text())
+    assert raw["__schema__"] == {"version": tune.SCHEMA_VERSION}
+    cache2 = tune.TuneCache()
+    assert cache2.get(key)["cfg"] == {"block_m": 8}
+    assert cache2.has_op("gemm")
+    assert cache2.get("__schema__") is None
+
+    # A FUTURE schema is ignored the same way (no forward half-read).
+    raw["__schema__"] = {"version": tune.SCHEMA_VERSION + 1}
+    path.write_text(json.dumps(raw))
+    assert tune.TuneCache().get(key) is None
+
+    # The committed v5e cache ships with the current schema marker — a
+    # version bump without migrating it would silently dead the file.
+    shipped = json.loads(
+        (tune._DEFAULT_DIR / "tpu_v5_lite.json").read_text())
+    assert shipped["__schema__"] == {"version": tune.SCHEMA_VERSION}
+
+
+def test_overlap_report_dual_matched_lines(tmp_path, monkeypatch):
+    """``overlap_report`` classifies each timeline line ONCE, with DMA
+    precedence: a TPU ``"Stream #1 queue"`` row matches BOTH default line
+    patterns, and counting it on both sides would overlap it with itself
+    (overlap_frac_of_dma spuriously → 1.0). Synthetic planes: the dual
+    row must land on the DMA side only, be reported in
+    ``dual_matched_lines``, and contribute zero self-overlap."""
+    from triton_dist_tpu.tools import xplane
+    from triton_dist_tpu.tools.xplane import Event
+
+    planes = {
+        "/device:TPU:0": {
+            # Compute-only row: one fusion op [0, 100).
+            "XLA Ops": [Event("fusion.1", 0, 100)],
+            # Dual-matched row ("stream" + "queue"): one DMA [200, 300) —
+            # disjoint from compute, so any nonzero overlap here could only
+            # come from double-counting the row on both sides.
+            "Stream #1 queue": [Event("dma.copy", 200, 100)],
+        },
+        "/host:CPU": {"threads": [Event("noise", 0, 1000)]},
+    }
+    monkeypatch.setattr(xplane, "latest_capture", lambda d: "fake.xplane.pb")
+    monkeypatch.setattr(xplane, "parse_xspace", lambda p: planes)
+    rep = xplane.overlap_report(str(tmp_path))
+    assert rep["dual_matched_lines"] == ["Stream #1 queue"]
+    assert rep["dma_lines_seen"] == ["Stream #1 queue"]
+    assert rep["compute_ps"] == 100
+    assert rep["dma_ps"] == 100
+    assert rep["overlap_ps"] == 0 and rep["overlap_frac_of_dma"] == 0.0
+    # Genuine overlap still accounts: shift the DMA under the compute row.
+    planes["/device:TPU:0"]["Stream #1 queue"] = [Event("dma.copy", 50, 100)]
+    rep2 = xplane.overlap_report(str(tmp_path))
+    assert rep2["overlap_ps"] == 50 and rep2["overlap_frac_of_dma"] == 0.5
+
+
+def test_gemm_ar_crossover_agreed(tmp_path, monkeypatch):
+    """GEMM-AR AUTO routing reads its M crossover only through
+    ``agreed_cfg_value`` (cross-rank agreed; single-process degenerate =
+    plain hit) and falls back to the static default on miss or malformed
+    entries — same contract as the ar_crossover satellite fix."""
+    from triton_dist_tpu.kernels.gemm_allreduce import (
+        DEFAULT_GEMM_AR_CROSSOVER_M,
+        GemmARMethod,
+        gemm_ar_crossover_m,
+        get_auto_gemm_ar_method,
+    )
+    from triton_dist_tpu.tools import tune
+
+    monkeypatch.setenv("TDT_TUNE_CACHE", str(tmp_path / "cache.json"))
+    tune._default_cache = None
+
+    # Cold cache → static default, routing obeys it.
+    assert gemm_ar_crossover_m(8) == DEFAULT_GEMM_AR_CROSSOVER_M
+    assert (get_auto_gemm_ar_method(DEFAULT_GEMM_AR_CROSSOVER_M, 8)
+            is GemmARMethod.LL_ONE_SHOT)
+    assert (get_auto_gemm_ar_method(DEFAULT_GEMM_AR_CROSSOVER_M + 8, 8)
+            is GemmARMethod.PALLAS_FUSED)
+
+    # The bench's emitted entry merges in and moves the routing point.
+    tune.merge_entries({
+        "gemm_ar_crossover|world=8": {
+            "cfg": {"crossover_m": 256, "default_was": DEFAULT_GEMM_AR_CROSSOVER_M},
+            "time_s": 1e-5, "version": "x"},
+    })
+    tune._default_cache = None  # drop the memoized miss
+    assert gemm_ar_crossover_m(8) == 256
+    assert get_auto_gemm_ar_method(256, 8) is GemmARMethod.LL_ONE_SHOT
+    assert get_auto_gemm_ar_method(264, 8) is GemmARMethod.PALLAS_FUSED
+    # Other world sizes are untouched by the world=8 entry.
+    assert gemm_ar_crossover_m(4) == DEFAULT_GEMM_AR_CROSSOVER_M
+
+    # A malformed entry (missing the field) falls back, never raises.
+    tune.merge_entries({
+        "gemm_ar_crossover|world=4": {
+            "cfg": {"wrong_field": 1}, "time_s": 1e-5, "version": "x"},
+    })
+    tune._default_cache = None
+    assert gemm_ar_crossover_m(4) == DEFAULT_GEMM_AR_CROSSOVER_M
+
+
 def test_xplane_parse_and_overlap(tmp_path):
     """The dependency-free .xplane.pb parser (r4 verdict missing #4's
     unexplored alternative — XProf duration rows wired into an overlap

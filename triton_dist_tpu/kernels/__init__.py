@@ -68,6 +68,8 @@ from triton_dist_tpu.kernels.gemm_allreduce import (
     GemmARMethod,
     GemmARContext,
     create_gemm_ar_context,
+    get_auto_gemm_ar_method,
+    gemm_ar_ll_call,
     gemm_ar_shard,
     gemm_ar,
 )
@@ -161,6 +163,8 @@ __all__ = [
     "GemmARMethod",
     "GemmARContext",
     "create_gemm_ar_context",
+    "get_auto_gemm_ar_method",
+    "gemm_ar_ll_call",
     "gemm_ar_shard",
     "gemm_ar",
     "all_gather_2d_shard",

@@ -246,6 +246,10 @@ def ag_flash_attention_shard(
     b, hq, s_loc, d = q.shape
     hkv = k.shape[1]
     assert hq % hkv == 0
+    # The K and V landing zones are typed independently (vrecv uses v.dtype)
+    # but the kernel streams both through one flash inner loop — a mixed
+    # K/V dtype pair would silently up/down-cast mid-attention. Reject it.
+    assert k.dtype == v.dtype, (k.dtype, v.dtype)
     group = hq // hkv
     sc = scale if scale is not None else d ** -0.5
 

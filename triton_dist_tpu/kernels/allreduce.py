@@ -55,16 +55,20 @@ def ar_crossover_bytes(world: int) -> int:
     the bench's decode-collective section measures per-method floors and
     emits a cache-ready ``ar_crossover|world=<w>`` entry (see
     ``bench.py`` decode collectives); this looks it up on the current chip's
-    tune cache and falls back to the static guess otherwise."""
-    from triton_dist_tpu.tools.tune import default_cache
+    tune cache and falls back to the static guess otherwise.
 
-    hit = default_cache().get(f"ar_crossover|world={world}")
-    if hit:
-        try:
-            return int(hit["cfg"]["crossover_bytes"])
-        except (KeyError, TypeError, ValueError):
-            pass
-    return DEFAULT_AR_CROSSOVER_BYTES
+    The lookup goes through :func:`~triton_dist_tpu.tools.tune.agreed_cfg_value`
+    — NEVER a plain rank-local cache read: the threshold picks between two
+    different collective kernels, so a stale cache file on one host would
+    send the same message down one-shot there and two-shot everywhere else
+    and deadlock. All ranks agree on the cached value (digest allgather,
+    resolved once per process) or all fall back to the default together."""
+    from triton_dist_tpu.tools.tune import agreed_cfg_value
+
+    return agreed_cfg_value(
+        f"ar_crossover|world={world}", "crossover_bytes",
+        DEFAULT_AR_CROSSOVER_BYTES,
+    )
 
 
 def get_auto_all_reduce_method(nbytes: int, world: int) -> AllReduceMethod:

@@ -5,33 +5,92 @@ persistent GEMM with per-tile notify + consumer AR kernel (multimem / ring),
 low-latency double-buffer phase contexts (:44-831); headline 1.26-1.44×
 decode-path wins (``e2e_dense.md:34-38``). TPU redesign:
 
-* **rs_ag** — ring reduce-scatter matmul followed by ring all-gather: the
-  bandwidth-optimal composition for larger M.
-* **one_shot** — local partial GEMM, then the one-shot push AR kernel: one
-  hop of latency, the multimem-analog for tiny M (decode).
+* **pallas_fused** — ONE grid-tiled kernel (grid ``(world, Mt, Nt, Kt)``):
+  the fp32 accumulator chunk rides the ICI ring during the K-loop (the
+  reduce-scatter phase, with credit-semaphore backpressure on slot reuse —
+  same tile-granular overlap as ``gemm_reduce_scatter.py``'s fused path),
+  then the finished chunk is ring-broadcast back out of the SAME kernel
+  (the all-gather phase, per-step semaphore slots so ranks may drift).
+  Bandwidth-optimal for larger M; requires ``m % world == 0``.
+* **ll_one_shot** — fused low-latency kernel for tiny/ragged M (decode):
+  the local partial GEMM's epilogue DMAs each finished output tile directly
+  into ALL peers' symmetric landing zones (one-shot push, the multimem
+  analog) and the reducer waits per-SOURCE on byte-counting semaphore
+  slots. One ICI hop; fp32 partials on the wire, so the result matches the
+  fp32-accum ``dot + psum`` reference exactly.
+* **rs_ag** — ring reduce-scatter matmul followed by a separate ring
+  all-gather kernel: the unfused composition baseline for larger M.
+* **one_shot** — local full dot, then the one-shot push AR kernel: the
+  unfused composition baseline for tiny M.
 * **xla** — ``dot + psum`` baseline.
+
+AUTO picks ``ll_one_shot`` for ragged or small M (latency-bound decode) and
+``pallas_fused`` above the crossover; the crossover row count is a tune-cache
+entry (``gemm_ar_crossover|world=N``) read through
+``tools.tune.agreed_cfg_value`` — cross-rank agreement from day one, since a
+rank-local read of a stale cache would route the same call into two
+different collective kernels and deadlock.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import enum
+import functools
 
 import jax
 import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import PartitionSpec as P
 
+import triton_dist_tpu.language as tpl
 from triton_dist_tpu.runtime.mesh import DistContext
 from triton_dist_tpu.kernels.allgather import all_gather_shard, AllGatherMethod
 from triton_dist_tpu.kernels.allreduce import all_reduce_shard, AllReduceMethod
+from triton_dist_tpu.kernels.gemm import GemmConfig, fit_block
 from triton_dist_tpu.kernels.gemm_reduce_scatter import _gemm_rs_xla_ring
+from triton_dist_tpu.shmem.kernel import collective_id_for, dist_pallas_call
 
 
 class GemmARMethod(enum.Enum):
     AUTO = "auto"
+    PALLAS_FUSED = "pallas_fused"
+    LL_ONE_SHOT = "ll_one_shot"
     RS_AG = "rs_ag"
     ONE_SHOT = "one_shot"
     XLA = "xla"
+
+
+#: Static fallback crossover (rows of M): at or below it the one-hop
+#: ll_one_shot kernel wins (kernel-launch + per-step ring latency dominates);
+#: above it the fused ring's 2·(w−1)/w bandwidth advantage takes over. 64
+#: rows is the analytic guess the bench's ``gemm_ar_decode`` section refines.
+DEFAULT_GEMM_AR_CROSSOVER_M = 64
+
+
+def gemm_ar_crossover_m(world: int) -> int:
+    """ll_one_shot↔pallas_fused routing threshold (rows of M), fed from the
+    tune cache (``gemm_ar_crossover|world=<w>``, emitted by bench.py's
+    ``gemm_ar_decode`` section) through ``agreed_cfg_value`` — the lookup is
+    resolved once per process and gated by cross-rank agreement, because the
+    two sides of the crossover are different collective kernels (see
+    ``allreduce.ar_crossover_bytes`` for the deadlock argument)."""
+    from triton_dist_tpu.tools.tune import agreed_cfg_value
+
+    return agreed_cfg_value(
+        f"gemm_ar_crossover|world={world}", "crossover_m",
+        DEFAULT_GEMM_AR_CROSSOVER_M,
+    )
+
+
+def get_auto_gemm_ar_method(m: int, world: int) -> GemmARMethod:
+    """Reference ``get_auto_method`` analog for GEMM-AR: ragged M (the fused
+    ring chunks rows over ranks) or decode-sized M → the low-latency one-shot
+    kernel; larger M → the tile-granular fused ring."""
+    if m % world != 0 or m <= gemm_ar_crossover_m(world):
+        return GemmARMethod.LL_ONE_SHOT
+    return GemmARMethod.PALLAS_FUSED
 
 
 @dataclasses.dataclass(frozen=True)
@@ -42,12 +101,469 @@ class GemmARContext:
     ctx: DistContext
     axis: str = "tp"
     method: GemmARMethod = GemmARMethod.AUTO
+    gemm_config: GemmConfig | None = None
 
 
 def create_gemm_ar_context(
     ctx: DistContext, axis: str = "tp", method: GemmARMethod = GemmARMethod.AUTO
 ) -> GemmARContext:
     return GemmARContext(ctx=ctx, axis=axis, method=method)
+
+
+def _gemm_ar_fused_kernel(
+    sched_ref,  # SMEM (world,) int32 — sched[s] = (me - 1 - s) % world
+    a_ref,  # (bm, bk) VMEM — pipelined A tile (rows of chunk sched[s])
+    b_ref,  # (bk, bn) VMEM — pipelined B tile
+    o_ref,  # (m, n) ANY — full product; my chunk tile-DMA'd at s==world-1,
+    #         the rest ring-broadcast in the AG phase
+    send_buf,  # (2, chunk, n) f32 ANY — outgoing partial chunk, per-slot
+    recv_buf,  # (2, chunk, n) f32 ANY — incoming partial chunk, per-slot
+    acc,  # VMEM (bm, bn) f32
+    recv_tile,  # VMEM (bm, bn) f32 — staged incoming tile
+    send_stage,  # VMEM (2, bm, bn) f32 — outgoing tile, double-buffered
+    out_stage,  # VMEM (2, bm, bn) out dtype — final tile, double-buffered
+    recv_sem,  # DMA (2,)
+    send_sem,  # DMA (2,) — remote send completion
+    tile_out_sem,  # DMA (2,) — local copies into send_buf (byte-counted)
+    tile_in_sem,  # DMA (1,) — recv tile staging
+    out_sem,  # DMA (2,) — final tile copies into o_ref
+    ag_send_sem,  # DMA (world-1,) — AG-phase sends, one slot per ring step
+    ag_recv_sem,  # DMA (world-1,) — AG-phase arrivals, one slot per ring step
+    credit_sem,  # REGULAR (2,) — receiver → left: RS slot consumed
+    *,
+    axis,
+    mesh_axes,
+    n_m: int,
+    n_n: int,
+    n_k: int,
+):
+    """Fused GEMM + all-reduce in one kernel: ring reduce-scatter matmul
+    (identical structure to ``_gemm_rs_fused_kernel`` — step ``s`` computes
+    the chunk-GEMM for chunk ``sched[s]``, adds the partial received from the
+    left neighbor, ships every finished tile into the outgoing buffer
+    immediately), then — once this rank's chunk is reduced and landed in
+    ``o_ref`` — the AG phase ring-broadcasts the finished chunks with the
+    per-step-slot protocol of ``_ring_ag_kernel``. The RS leg keeps the
+    credit-semaphore backpressure on its two send slots; the AG leg needs no
+    credits because each of its ``world-1`` steps owns a dedicated slot and
+    the destination rows are disjoint per chunk."""
+    s, im, jn, kk = (pl.program_id(i) for i in range(4))
+    me = tpl.rank(axis)
+    world = tpl.num_ranks(axis)
+    right = tpl.ring_neighbor(axis, +1, mesh_axes=mesh_axes)
+    left = tpl.ring_neighbor(axis, -1, mesh_axes=mesh_axes)
+    bm, bn = acc.shape
+    chunk = n_m * bm  # rows per rank
+    cur = jax.lax.rem(s, 2)  # outgoing slot of this step
+    prev = jax.lax.rem(s - 1 + 2, 2)  # incoming slot (left's step s-1)
+
+    @pl.when(jnp.logical_and(im == 0, jnp.logical_and(jn == 0, kk == 0)))
+    def _step_start():
+        @pl.when(s > 0)
+        def _():
+            # Incoming partial chunk fully arrived (dl.wait analog).
+            tpl.wait_recv(recv_sem.at[prev], recv_buf.at[prev])
+
+        @pl.when(s >= 2)
+        def _():
+            # Slot reuse: our send of step s-2 completed locally, and the
+            # right neighbor consumed it (credit backpressure).
+            tpl.wait_send(send_sem.at[cur], send_buf.at[cur])
+            tpl.wait(credit_sem.at[cur], 1)
+
+    # Stage the incoming tile for this (im, jn) early — overlaps the K-loop.
+    @pl.when(jnp.logical_and(s > 0, kk == 0))
+    def _():
+        pltpu.make_async_copy(
+            recv_buf.at[prev, pl.ds(im * bm, bm), pl.ds(jn * bn, bn)],
+            recv_tile,
+            tile_in_sem.at[0],
+        ).start()
+
+    @pl.when(kk == 0)
+    def _():
+        acc[...] = jnp.zeros_like(acc)
+
+    acc[...] += jax.lax.dot_general(
+        a_ref[...], b_ref[...], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(kk == n_k - 1)
+    def _tile_done():
+        @pl.when(s > 0)
+        def _():
+            pltpu.make_async_copy(
+                recv_buf.at[prev, pl.ds(im * bm, bm), pl.ds(jn * bn, bn)],
+                recv_tile,
+                tile_in_sem.at[0],
+            ).wait()
+
+        # where(), not arithmetic: recv_tile is uninitialized garbage at s==0
+        # and garbage*0 could be NaN.
+        val = acc[...] + jnp.where(s > 0, recv_tile[...], jnp.zeros_like(recv_tile))
+
+        tile_idx = im * n_n + jn
+
+        @pl.when(s == world - 1)
+        def _():
+            # My chunk's final tiles go straight into the full-size output at
+            # this rank's row offset (o_ref must be ANY + tile DMAs: a
+            # pipelined out BlockSpec would revisit blocks once per ring
+            # step, which Pallas forbids).
+            t = jax.lax.rem(tile_idx, 2)
+
+            @pl.when(tile_idx >= 2)
+            def _():
+                pltpu.make_async_copy(
+                    out_stage.at[t], out_stage.at[t], out_sem.at[t]
+                ).wait()
+
+            out_stage[t] = val.astype(out_stage.dtype)
+            pltpu.make_async_copy(
+                out_stage.at[t],
+                o_ref.at[pl.ds(me * chunk + im * bm, bm), pl.ds(jn * bn, bn)],
+                out_sem.at[t],
+            ).start()
+
+        @pl.when(s < world - 1)
+        def _():
+            # Ship this tile into the outgoing chunk buffer right away — the
+            # per-tile producer signal analog; the byte-counting semaphore
+            # doubles as the chunk-complete signal.
+            t = jax.lax.rem(im * n_n + jn, 2)
+
+            @pl.when(im * n_n + jn >= 2)
+            def _():
+                pltpu.make_async_copy(
+                    send_stage.at[t], send_stage.at[t], tile_out_sem.at[t]
+                ).wait()
+
+            send_stage[t] = val
+            pltpu.make_async_copy(
+                send_stage.at[t],
+                send_buf.at[cur, pl.ds(im * bm, bm), pl.ds(jn * bn, bn)],
+                tile_out_sem.at[t],
+            ).start()
+
+        is_chunk_end = jnp.logical_and(im == n_m - 1, jn == n_n - 1)
+
+        @pl.when(jnp.logical_and(is_chunk_end, s < world - 1))
+        def _chunk_send():
+            # Drain outstanding tile copies (the last tile's, and — when the
+            # chunk has ≥2 tiles — the second-to-last tile's on the other
+            # slot; everything older was waited before slot reuse), then push
+            # the whole chunk. Tile count is static, so slots are too.
+            t_last = (n_m * n_n - 1) % 2
+            if n_m * n_n >= 2:
+                pltpu.make_async_copy(
+                    send_stage.at[1 - t_last], send_stage.at[1 - t_last],
+                    tile_out_sem.at[1 - t_last],
+                ).wait()
+            pltpu.make_async_copy(
+                send_stage.at[t_last], send_stage.at[t_last], tile_out_sem.at[t_last]
+            ).wait()
+            pltpu.make_async_remote_copy(
+                src_ref=send_buf.at[cur],
+                dst_ref=recv_buf.at[cur],
+                send_sem=send_sem.at[cur],
+                recv_sem=recv_sem.at[cur],
+                device_id=right,
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            ).start()
+
+        @pl.when(jnp.logical_and(is_chunk_end, s > 0))
+        def _():
+            # Free the consumed slot back to the left neighbor.
+            tpl.notify(credit_sem.at[prev], left)
+
+    is_last = jnp.logical_and(
+        s == world - 1,
+        jnp.logical_and(im == n_m - 1, jnp.logical_and(jn == n_n - 1, kk == n_k - 1)),
+    )
+
+    @pl.when(is_last)
+    def _():
+        # Drain the RS leg: outstanding output-tile copies (my chunk must be
+        # fully in o_ref before the AG ring forwards it), our last send
+        # (step world-2), and the credit the right neighbor signalled when
+        # consuming it (its step world-1 chunk end runs before this wait on
+        # every rank — signal-before-wait, no cycle).
+        t_last = (n_m * n_n - 1) % 2
+        if n_m * n_n >= 2:
+            pltpu.make_async_copy(
+                out_stage.at[1 - t_last], out_stage.at[1 - t_last],
+                out_sem.at[1 - t_last],
+            ).wait()
+        pltpu.make_async_copy(
+            out_stage.at[t_last], out_stage.at[t_last], out_sem.at[t_last]
+        ).wait()
+        tpl.wait_send(send_sem.at[(world - 2) % 2], send_buf.at[0])
+        tpl.wait(credit_sem.at[(world - 2) % 2], 1)
+
+        # AG phase: ring-broadcast the finished chunks out of the same
+        # kernel (``_ring_ag_kernel``'s step protocol over o_ref row-slices).
+        # No rendezvous before step 0: I only forward rows that are complete
+        # (my own chunk, drained above; later steps forward what already
+        # arrived), destination rows are disjoint per chunk, and arrivals
+        # are byte-counted on per-step slots — ranks may drift freely.
+        def ag_step(s2, _):
+            src = jax.lax.rem(me - s2 + world, world)  # chunk I forward
+            rows = pl.ds(src * chunk, chunk)
+            dma = pltpu.make_async_remote_copy(
+                src_ref=o_ref.at[rows],
+                dst_ref=o_ref.at[rows],
+                send_sem=ag_send_sem.at[s2],
+                recv_sem=ag_recv_sem.at[s2],
+                device_id=right,
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            )
+            dma.start()
+            # Chunk (me-s2-1)%world arrives from the left on the same slot.
+            arriving = jax.lax.rem(me - s2 - 1 + world, world)
+            arows = pl.ds(arriving * chunk, chunk)
+            pltpu.make_async_copy(
+                o_ref.at[arows], o_ref.at[arows], ag_recv_sem.at[s2]
+            ).wait()
+            dma.wait_send()
+            return 0
+
+        jax.lax.fori_loop(0, world - 1, ag_step, 0)
+        # Peers must not start a next kernel that reuses these buffers (or
+        # this kernel again) while stragglers still forward chunks.
+        tpl.barrier_all(axis, mesh_axes=mesh_axes)
+
+
+def _gemm_ar_fused(a, b, *, axis, mesh_axes, config=None):
+    world = jax.lax.axis_size(axis)
+    # The RS leg's final drain waits on the step-(world-2) send and its
+    # credit; at world=1 neither is ever signaled — the kernel would
+    # deadlock. Callers go through gemm_ar_shard's world==1 shortcut.
+    assert world > 1, "fused GEMM-AR needs world > 1 (use gemm_ar_shard)"
+    me = jax.lax.axis_index(axis)
+    m, k = a.shape
+    n = b.shape[1]
+    assert m % world == 0, (m, world)
+    chunk = m // world
+
+    # Same tile shape the fused RS/AG GEMMs measured fastest on v5e.
+    cfg = config or GemmConfig(512, 512, 1024)
+    bm = fit_block(chunk, cfg.block_m)
+    bn = fit_block(n, cfg.block_n)
+    bk = fit_block(k, cfg.block_k)
+    n_m, n_n, n_k = chunk // bm, n // bn, k // bk
+    sched = jnp.mod(me - 1 - jnp.arange(world, dtype=jnp.int32), world).astype(jnp.int32)
+
+    out, _, _ = dist_pallas_call(
+        functools.partial(
+            _gemm_ar_fused_kernel,
+            axis=axis,
+            mesh_axes=mesh_axes,
+            n_m=n_m,
+            n_n=n_n,
+            n_k=n_k,
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(world, n_m, n_n, n_k),
+            in_specs=[
+                pl.BlockSpec(
+                    (bm, bk), lambda s, im, jn, kk, sched: (sched[s] * (a.shape[0] // world // bm) + im, kk)
+                ),
+                pl.BlockSpec((bk, bn), lambda s, im, jn, kk, sched: (kk, jn)),
+            ],
+            out_specs=(
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((bm, bn), jnp.float32),
+                pltpu.VMEM((bm, bn), jnp.float32),
+                pltpu.VMEM((2, bm, bn), jnp.float32),
+                pltpu.VMEM((2, bm, bn), a.dtype),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((1,)),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((max(world - 1, 1),)),
+                pltpu.SemaphoreType.DMA((max(world - 1, 1),)),
+                pltpu.SemaphoreType.REGULAR((2,)),
+            ],
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((m, n), a.dtype),
+            jax.ShapeDtypeStruct((2, chunk, n), jnp.float32),
+            jax.ShapeDtypeStruct((2, chunk, n), jnp.float32),
+        ),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary", "arbitrary", "arbitrary"),
+            has_side_effects=True,
+            collective_id=collective_id_for("_gemm_ar_fused_kernel"),
+        ),
+    )(sched, a, b)
+    return out
+
+
+def _gemm_ar_ll_kernel(
+    a_ref,  # (m, bk) VMEM — pipelined A panel (full M: ragged/tiny is fine)
+    b_ref,  # (bk, bn) VMEM — pipelined B tile
+    out_ref,  # (m, n) VMEM — full reduced product (flushed once, at the end)
+    gather_buf,  # (world, m, n) f32 ANY — symmetric landing zones (dummy out)
+    acc,  # VMEM (m, bn) f32
+    stage,  # VMEM (m, bn) f32 — finished tile staging (reused after wait)
+    red,  # VMEM (m, n) f32 — reduce accumulator
+    tmp,  # VMEM (m, n) f32 — per-slot staging for the reduce
+    tile_sem,  # DMA — stage → my landing-zone slot (waited inline)
+    send_sem,  # DMA — remote tile pushes (drained before reduce)
+    recv_sem,  # DMA (world,) — per-SOURCE slots: sender ``p`` signals slot p
+    copy_sem,  # DMA — slot → tmp during the reduce
+    *,
+    axis,
+    mesh_axes,
+    n_n: int,
+    n_k: int,
+):
+    """Fused low-latency GEMM-AR (grid ``(Nt, Kt)``): the partial GEMM's
+    epilogue pushes each finished fp32 output tile straight into every peer's
+    symmetric landing zone (reference multimem double-buffer phases,
+    ``gemm_allreduce.py:44-831``), so later tiles' K-loops overlap earlier
+    tiles' ICI pushes. The reducer waits per-source: ALL of a source's tile
+    pushes land on that source's byte-counting semaphore slot, so one wait
+    per peer covers its whole (m, n) contribution. fp32 on the wire → exact
+    parity with the fp32-accum ``dot + psum`` reference."""
+    jn, kk = pl.program_id(0), pl.program_id(1)
+    me = tpl.rank(axis)
+    world = tpl.num_ranks(axis)
+
+    @pl.when(jnp.logical_and(jn == 0, kk == 0))
+    def _():
+        # Peers may still be in a previous kernel using gather_buf (or a
+        # previous call of this one); rendezvous before the first push.
+        tpl.barrier_all(axis, mesh_axes=mesh_axes)
+
+    @pl.when(kk == 0)
+    def _():
+        acc[...] = jnp.zeros_like(acc)
+
+    acc[...] += jax.lax.dot_general(
+        a_ref[...], b_ref[...], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(kk == n_k - 1)
+    def _tile_done():
+        m, bn = acc.shape
+        # Land the finished tile in MY slot locally (remote DMA sources from
+        # HBM, and my slot doubles as my own contribution in the reduce)...
+        stage[...] = acc[...]
+        dst = gather_buf.at[me, :, pl.ds(jn * bn, bn)]
+        cp = pltpu.make_async_copy(stage, dst, tile_sem)
+        cp.start()
+        cp.wait()
+
+        # ... then push it to every peer's slot ``me`` — per-tile epilogue
+        # sends, skew-started so links stay balanced. The sender signals the
+        # DESTINATION's recv slot ``me``: per-source accounting.
+        def send(i, _):
+            peer = jax.lax.rem(me + i, world)
+            tpl.putmem_signal(
+                dst, dst, send_sem, recv_sem.at[me], peer,
+                axis=axis, mesh_axes=mesh_axes,
+            ).start()
+            return 0
+
+        jax.lax.fori_loop(1, world, send, 0)
+
+    is_last = jnp.logical_and(jn == n_n - 1, kk == n_k - 1)
+
+    @pl.when(is_last)
+    def _reduce():
+        m, bn = acc.shape
+
+        # Per-source waits: source src's n_n tile pushes sum to one full
+        # (m, n) f32 slot on its semaphore.
+        def wait_one(i, _):
+            src = jax.lax.rem(me + i, world)
+            tpl.wait_recv(recv_sem.at[src], gather_buf.at[src])
+            return 0
+
+        jax.lax.fori_loop(1, world, wait_one, 0)
+
+        # Drain my own sends: n_n tiles × (world-1) peers, all tile-sized.
+        def drain(i, _):
+            pltpu.make_async_copy(stage, stage, send_sem).wait()
+            return 0
+
+        jax.lax.fori_loop(0, n_n * (world - 1), drain, 0)
+
+        # Local reduce in slot order 0..world-1 (HBM slots → VMEM → fp32
+        # accumulate; HBM refs cannot be loaded directly by the VPU).
+        red[...] = jnp.zeros_like(red)
+
+        def add(i, _):
+            cp2 = pltpu.make_async_copy(gather_buf.at[i], tmp, copy_sem)
+            cp2.start()
+            cp2.wait()
+            red[...] += tmp[...]
+            return 0
+
+        jax.lax.fori_loop(0, world, add, 0)
+        out_ref[...] = red[...].astype(out_ref.dtype)
+        tpl.barrier_all(axis, mesh_axes=mesh_axes)
+
+
+def gemm_ar_ll_call(a, b, *, axis, mesh_axes=None, config=None):
+    """Direct entry to the fused low-latency GEMM-AR kernel, bypassing AUTO
+    routing and ``gemm_ar_shard``'s world==1 dot shortcut — lets the
+    decode-size bench time the KERNEL itself at world=1 (pushes degenerate
+    to the local landing-zone copy; the measured time is the kernel-overhead
+    floor, symmetric with ``allreduce.one_shot_ar_call``)."""
+    world = jax.lax.axis_size(axis)
+    m, k = a.shape
+    n = b.shape[1]
+    cfg = config or GemmConfig(512, 512, 1024)
+    bn = fit_block(n, cfg.block_n)
+    bk = fit_block(k, cfg.block_k)
+    n_n, n_k = n // bn, k // bk
+
+    out, _ = dist_pallas_call(
+        functools.partial(
+            _gemm_ar_ll_kernel, axis=axis, mesh_axes=mesh_axes, n_n=n_n, n_k=n_k
+        ),
+        grid=(n_n, n_k),
+        in_specs=[
+            pl.BlockSpec((m, bk), lambda jn, kk: (0, kk)),
+            pl.BlockSpec((bk, bn), lambda jn, kk: (kk, jn)),
+        ],
+        out_specs=(
+            # Constant index map: the block is revisited, written once at the
+            # last grid cell, flushed once after it.
+            pl.BlockSpec((m, n), lambda jn, kk: (0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((m, n), a.dtype),
+            jax.ShapeDtypeStruct((world, m, n), jnp.float32),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((m, bn), jnp.float32),
+            pltpu.VMEM((m, bn), jnp.float32),
+            pltpu.VMEM((m, n), jnp.float32),
+            pltpu.VMEM((m, n), jnp.float32),
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA((world,)),
+            pltpu.SemaphoreType.DMA,
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary"),
+            has_side_effects=True,
+            collective_id=collective_id_for("_gemm_ar_ll_kernel"),
+        ),
+    )(a, b)
+    return out
 
 
 def gemm_ar_shard(
@@ -57,6 +573,7 @@ def gemm_ar_shard(
     axis: str = "tp",
     mesh_axes=None,
     method: GemmARMethod = GemmARMethod.AUTO,
+    gemm_config: GemmConfig | None = None,
 ) -> jax.Array:
     """``all_reduce(A_local @ B_local)`` — every rank gets the full (m, n)
     product. Usable inside shard_map. Reference host ops
@@ -66,12 +583,19 @@ def gemm_ar_shard(
     if world == 1:
         return jnp.dot(a, b, preferred_element_type=jnp.float32).astype(a.dtype)
     if method is GemmARMethod.AUTO:
-        # Ragged or tiny M → one-shot (latency-bound); else rs_ag.
-        method = GemmARMethod.ONE_SHOT if (m % world != 0 or m <= 64) else GemmARMethod.RS_AG
+        method = get_auto_gemm_ar_method(m, world)
 
     if method is GemmARMethod.XLA:
         partial = jnp.dot(a, b, preferred_element_type=jnp.float32)
         return jax.lax.psum(partial, axis).astype(a.dtype)
+
+    if method is GemmARMethod.LL_ONE_SHOT:
+        return gemm_ar_ll_call(
+            a, b, axis=axis, mesh_axes=mesh_axes, config=gemm_config
+        )
+
+    if method is GemmARMethod.PALLAS_FUSED:
+        return _gemm_ar_fused(a, b, axis=axis, mesh_axes=mesh_axes, config=gemm_config)
 
     if method is GemmARMethod.ONE_SHOT:
         partial = jnp.dot(a, b, preferred_element_type=jnp.float32).astype(a.dtype)
@@ -94,7 +618,8 @@ def gemm_ar(ar_ctx: GemmARContext, a: jax.Array, b: jax.Array) -> jax.Array:
 
     def fn(a_shard, b_shard):
         return gemm_ar_shard(
-            a_shard, b_shard, axis=axis, mesh_axes=mesh_axes, method=ar_ctx.method
+            a_shard, b_shard, axis=axis, mesh_axes=mesh_axes, method=ar_ctx.method,
+            gemm_config=ar_ctx.gemm_config,
         )
 
     shard_f = jax.shard_map(

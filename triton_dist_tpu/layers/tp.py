@@ -113,6 +113,9 @@ class TP_MLP:
             g = jnp.dot(x, self.w_gate, preferred_element_type=jnp.float32)
             u = jnp.dot(x, self.w_up, preferred_element_type=jnp.float32)
             h = (jax.nn.silu(g) * u).astype(x.dtype)
+            # Row-parallel down-proj through GEMM-AR AUTO: decode-sized or
+            # ragged token counts take the fused ll_one_shot kernel, larger
+            # batches the fused RS+AG ring (gemm_allreduce crossover).
             return gemm_ar_shard(h, self.w_down, axis=axis, mesh_axes=self.mesh_axes)
         raise ValueError(f"unknown mode {mode}")
 
@@ -193,6 +196,8 @@ class TP_Attn:
         )
         o = o.reshape(bsz, -1)
         if mode == "dist_ar":
+            # bsz rows is decode-tiny (≤ the M crossover), so AUTO lands on
+            # the fused ll_one_shot GEMM-AR kernel here.
             out = gemm_ar_shard(o, self.wo, axis=self.axis, mesh_axes=self.mesh_axes)
         elif mode == "xla":
             out = jax.lax.psum(

@@ -419,9 +419,10 @@ class ModelBuilder:
 
         if op == "linear_allreduce":
             def standalone_linear_ar(env, lp, t=task):
-                # mesh_axes as in the fused-path ARs: at decode sizes
-                # the AUTO route picks the one-shot push kernel, whose peer
-                # addressing needs the full axis list on multi-axis meshes.
+                # mesh_axes as in the fused-path ARs: at decode sizes the
+                # AUTO route picks the fused ll_one_shot GEMM-AR kernel,
+                # whose peer addressing needs the full axis list on
+                # multi-axis meshes.
                 env[t.outputs[0]] = gemm_ar_shard(
                     env[t.inputs[0]], lp[param(t.inputs[1])], axis=axis,
                     mesh_axes=mesh_axes,

@@ -20,6 +20,14 @@ from triton_dist_tpu.version import __version__
 _CACHE_ENV = "TDT_TUNE_CACHE"
 _DEFAULT_DIR = pathlib.Path(__file__).parent / "tuned"
 
+#: Cache-file schema version. v2 adds resolved-at-init crossover entries
+#: (``ar_crossover|world=N``, ``gemm_ar_crossover|world=N``) whose values
+#: steer COLLECTIVE routing and therefore must never be half-read: a file
+#: from an older schema is ignored wholesale (treated as a cold cache)
+#: rather than partially interpreted with drifted key/field meanings.
+SCHEMA_VERSION = 2
+_SCHEMA_KEY = "__schema__"
+
 
 def device_fingerprint() -> str:
     """Hardware key for cache entries (reference fingerprints git/deps/hw)."""
@@ -37,16 +45,29 @@ def _cache_path() -> pathlib.Path:
 
 
 class TuneCache:
-    """JSON-file cache: {key: {"cfg": {...}, "time_s": t, "version": v}}."""
+    """JSON-file cache: {key: {"cfg": {...}, "time_s": t, "version": v}},
+    plus one ``__schema__`` marker entry (never returned by ``get``).
+
+    Files whose schema marker is missing or from a different version load as
+    EMPTY — stale pre-schema files are ignored, not half-read (their entries
+    may predate routing-relevant fields like the crossover values)."""
 
     def __init__(self, path: str | os.PathLike | None = None):
         self.path = pathlib.Path(path) if path is not None else _cache_path()
         self._data: dict[str, Any] = {}
+        # Per-instance memo for agreed_cfg_value: the cross-rank agreement
+        # allgather runs once per key per cache instance (resolve-at-init
+        # semantics); dropping/replacing the cache drops the memo with it.
+        self._agreed: dict[str, dict | None] = {}
         if self.path.exists():
             try:
-                self._data = json.loads(self.path.read_text())
+                raw = json.loads(self.path.read_text())
             except (json.JSONDecodeError, OSError):
-                self._data = {}
+                raw = None
+            if isinstance(raw, dict):
+                schema = raw.pop(_SCHEMA_KEY, None)
+                if isinstance(schema, dict) and schema.get("version") == SCHEMA_VERSION:
+                    self._data = raw
 
     def get(self, key: str) -> dict | None:
         return self._data.get(key)
@@ -60,7 +81,8 @@ class TuneCache:
 
     def save(self) -> None:
         self.path.parent.mkdir(parents=True, exist_ok=True)
-        self.path.write_text(json.dumps(self._data, indent=1, sort_keys=True))
+        payload = {_SCHEMA_KEY: {"version": SCHEMA_VERSION}, **self._data}
+        self.path.write_text(json.dumps(payload, indent=1, sort_keys=True))
 
 
 _default_cache: TuneCache | None = None
@@ -157,6 +179,34 @@ def _cache_hit_all_ranks_agree(usable) -> bool:
             digest = np.int64(1)
     all_d = multihost_utils.process_allgather(digest)
     return bool(all_d[0] != 0 and (all_d == all_d[0]).all())
+
+
+def agreed_cfg_value(key: str, field: str, default, *, cache: TuneCache | None = None):
+    """Cross-rank-safe tune-cache read for values that steer COLLECTIVE
+    routing (AR one/two-shot crossover, GEMM-AR method crossover, ...).
+
+    A plain ``cache.get`` is rank-local: a stale file on one host would route
+    the SAME message through different collective kernels on different ranks
+    — a deadlock, not a perf bug. So the hit is gated by
+    :func:`_cache_hit_all_ranks_agree` (digest allgather; any miss or
+    disagreement sends EVERY rank to ``default`` together) and the verdict is
+    memoized per cache instance, so the allgather runs once per key per
+    process — resolve-once-at-init-and-broadcast semantics without an extra
+    init hook. Returns ``cfg[field]`` coerced to ``type(default)``, or
+    ``default`` on miss/disagreement/malformed entry."""
+    cache = cache or default_cache()
+    if key not in cache._agreed:
+        hit = cache.get(key)
+        cfg = hit.get("cfg") if isinstance(hit, dict) else None
+        usable = dict(cfg) if isinstance(cfg, dict) else None
+        cache._agreed[key] = usable if _cache_hit_all_ranks_agree(usable) else None
+    cfg = cache._agreed[key]
+    if cfg is None:
+        return default
+    try:
+        return type(default)(cfg[field])
+    except (KeyError, TypeError, ValueError):
+        return default
 
 
 def cross_rank_time(t: float) -> float:

@@ -210,25 +210,49 @@ def overlap_report(log_dir: str, *, plane_pat: str = r"/device:",
     """Parse the newest capture under ``log_dir`` and account duration
     overlap between compute rows and DMA rows on the device plane.
 
+    The two line patterns are NOT disjoint (a TPU ``"Stream #1 queue"`` row
+    matches both ``stream`` and ``queue``), so each line is classified ONCE,
+    with DMA precedence: a line matching the DMA pattern contributes to the
+    DMA side only, never to both. Counting a dual-matched line on both sides
+    would make it "overlap" with itself and spuriously inflate
+    ``overlap_frac_of_dma`` — the exact number this report exists to defend.
+    Lines that matched both patterns are reported in ``dual_matched_lines``
+    (next to ``dma_lines_seen``) so a capture whose row naming defeats the
+    classification is visible in the report rather than silently skewed.
+
     Returns {compute_ps, dma_ps, overlap_ps, overlap_frac_of_dma,
-    planes_seen, dma_lines_seen}. ``overlap_frac_of_dma`` near 1.0 means
-    the transfers rode under compute (hidden); near 0.0 means they
-    serialized — THE number the ring/fused-kernel overlap claims need on
-    real hardware."""
+    planes_seen, dma_lines_seen, dual_matched_lines}.
+    ``overlap_frac_of_dma`` near 1.0 means the transfers rode under compute
+    (hidden); near 0.0 means they serialized — THE number the
+    ring/fused-kernel overlap claims need on real hardware."""
     planes = parse_xspace(latest_capture(log_dir))
-    compute = select_events(planes, plane_pat, compute_line_pat, compute_pat)
-    dma = select_events(planes, plane_pat, dma_line_pat, dma_pat)
+    compute: list[Event] = []
+    dma: list[Event] = []
+    dma_lines: set[str] = set()
+    dual_lines: set[str] = set()
+    for pname, lines in planes.items():
+        if not re.search(plane_pat, pname, re.I):
+            continue
+        for lname, evs in lines.items():
+            is_dma = bool(re.search(dma_line_pat, lname, re.I))
+            is_compute = bool(re.search(compute_line_pat, lname, re.I))
+            if is_dma and is_compute:
+                dual_lines.add(lname)
+            if is_dma:
+                dma_lines.add(lname)
+                dma.extend(e for e in evs if re.search(dma_pat, e.name, re.I))
+            elif is_compute:
+                compute.extend(
+                    e for e in evs if re.search(compute_pat, e.name, re.I))
     c_ps = _total_ps([(e.start_ps, e.end_ps) for e in compute])
     d_ps = _total_ps([(e.start_ps, e.end_ps) for e in dma])
     o_ps = overlap_ps(compute, dma)
-    dma_lines = sorted({
-        ln for pn, lines in planes.items() if re.search(plane_pat, pn, re.I)
-        for ln in lines if re.search(dma_line_pat, ln, re.I)})
     return {
         "compute_ps": c_ps,
         "dma_ps": d_ps,
         "overlap_ps": o_ps,
         "overlap_frac_of_dma": (o_ps / d_ps) if d_ps else 0.0,
         "planes_seen": sorted(planes),
-        "dma_lines_seen": dma_lines,
+        "dma_lines_seen": sorted(dma_lines),
+        "dual_matched_lines": sorted(dual_lines),
     }

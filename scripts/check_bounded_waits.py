@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""Lint: no raw unbounded semaphore waits in collective kernels.
+
+The bounded-wait helpers in ``triton_dist_tpu.shmem.kernel``
+(``bounded_wait`` / ``bounded_wait_recv`` / ``bounded_barrier_all``) are the
+blessed way for a collective kernel to wait on a REMOTE peer: they cap the
+poll count and write an abort record into the status buffer instead of
+spinning forever on a dead rank (see ``docs/resilience.md``). This script
+fails when a kernel source under ``triton_dist_tpu/kernels/`` uses a raw
+wait primitive directly.
+
+Escape hatches, in order of preference:
+
+* a trailing ``# unbounded-wait-ok: <reason>`` comment on the offending
+  line — for waits that are LOCAL by construction (send-DMA drains complete
+  regardless of peer health) and for per-line exceptions in otherwise
+  adopted files;
+* the module allowlist below — kernels that have not adopted the status
+  buffer yet, wholesale. Shrink it, never grow it.
+
+Usage: ``python scripts/check_bounded_waits.py [paths...]`` (default: the
+kernels package). Exit 1 with ``file:line`` diagnostics on violations.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import re
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+DEFAULT_ROOT = REPO / "triton_dist_tpu" / "kernels"
+
+# Raw wait primitives a kernel must not call directly on a remote-signaled
+# semaphore. tpl.wait_send and make_async_copy(...).wait() are deliberately
+# absent: send-leg drains are local-DMA completion and stay unbounded.
+RAW_WAIT = re.compile(
+    r"pltpu\.semaphore_wait\(|tpl\.wait\(|tpl\.wait_recv\(|"
+    r"tpl\.signal_wait_until\(|tpl\.barrier_all\("
+)
+
+WAIVER = "# unbounded-wait-ok:"
+
+# Kernels that predate the status-buffer protocol and still wait raw.
+# Adopting one = thread a status output through it and delete its entry.
+ALLOWLIST = {
+    "ag_attention.py",
+    "allgather_gemm.py",
+    "common_ops.py",
+    "ep_fused.py",
+    "gemm_reduce_scatter.py",
+    "p2p.py",
+}
+
+
+def check_file(path: pathlib.Path) -> list[str]:
+    errors = []
+    for lineno, line in enumerate(path.read_text().splitlines(), start=1):
+        if not RAW_WAIT.search(line):
+            continue
+        if WAIVER in line:
+            continue
+        try:
+            rel = path.relative_to(REPO)
+        except ValueError:
+            rel = path
+        errors.append(
+            f"{rel}:{lineno}: raw unbounded wait — use the bounded-wait "
+            f"helpers in shmem.kernel (or add '{WAIVER} <reason>'):\n"
+            f"    {line.strip()}"
+        )
+    return errors
+
+
+def main(argv: list[str]) -> int:
+    roots = [pathlib.Path(a) for a in argv] or [DEFAULT_ROOT]
+    files: list[pathlib.Path] = []
+    for root in roots:
+        if root.is_dir():
+            files.extend(sorted(root.rglob("*.py")))
+        else:
+            files.append(root)
+
+    errors = []
+    for f in files:
+        # Explicit path arguments are always checked (so tests can lint a
+        # fixture named like an allowlisted module); the default sweep skips
+        # the not-yet-adopted kernels.
+        if len(argv) == 0 and f.name in ALLOWLIST:
+            continue
+        errors.extend(check_file(f))
+
+    if errors:
+        print(f"check_bounded_waits: {len(errors)} violation(s)")
+        for e in errors:
+            print(e)
+        return 1
+    print(f"check_bounded_waits: OK ({len(files)} file(s) scanned)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

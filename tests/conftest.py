@@ -59,6 +59,11 @@ def pytest_configure(config):
         "tpu: runs compiled (non-interpret) kernels on the real chip; "
         "auto-skips when no TPU is reachable (see tests/test_on_tpu.py)",
     )
+    config.addinivalue_line(
+        "markers",
+        "chaos: fault-injection tests driving collective kernels under a "
+        "FaultPlan in interpret mode (see tests/test_resilience.py)",
+    )
 
 
 # ---------------------------------------------------------------------------

@@ -31,7 +31,9 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import PartitionSpec as P
 
 import triton_dist_tpu.language as tpl
+from triton_dist_tpu.runtime import resilience
 from triton_dist_tpu.runtime.mesh import DistContext
+from triton_dist_tpu.shmem import kernel as sk
 from triton_dist_tpu.shmem.kernel import dist_pallas_call
 
 
@@ -50,7 +52,14 @@ def get_auto_all_gather_method(shard_bytes: int, world: int) -> AllGatherMethod:
 
     Small shards → one-shot full-mesh (latency: 1 hop instead of world-1);
     large shards → ring (each link carries shard_bytes per step, all links
-    busy every step)."""
+    busy every step). Once the process is degraded (a bounded-wait abort or
+    watchdog trip), AUTO routes the plain XLA collective instead — sticky
+    until ``resilience.reset_degradation()``."""
+    if resilience.is_degraded("allgather"):
+        resilience.note_fallback_once(
+            "allgather.auto", "routing AUTO all-gather to XLA"
+        )
+        return AllGatherMethod.XLA
     if shard_bytes <= 128 * 1024:
         return AllGatherMethod.FULL_MESH_PUSH
     return AllGatherMethod.RING_1D
@@ -77,7 +86,7 @@ def create_allgather_context(
 # --------------------------------------------------------------------- kernels
 
 
-def _ring_ag_kernel(x_ref, out_ref, send_sem, recv_sem, copy_sem, *rest, axis, mesh_axes, straggler=None):
+def _ring_ag_kernel(x_ref, out_ref, status_ref, send_sem, recv_sem, copy_sem, *rest, axis, mesh_axes, straggler=None):
     """1D ring all-gather: out[(world, *shard)] filled in world-1 steps.
 
     Chunk flow: at step s, I send out[(me-s) % world] (received at step s-1,
@@ -87,6 +96,8 @@ def _ring_ag_kernel(x_ref, out_ref, send_sem, recv_sem, copy_sem, *rest, axis, m
     me = tpl.rank(axis)
     world = tpl.num_ranks(axis)
     right = tpl.ring_neighbor(axis, +1, mesh_axes=mesh_axes)
+    left_rank = jax.lax.rem(me - 1 + world, world)  # arrivals come from -1
+    sk.init_status(status_ref, axis=axis)
 
     if straggler is not None:
         # Device-side straggler injection (reference straggler_option,
@@ -103,7 +114,7 @@ def _ring_ag_kernel(x_ref, out_ref, send_sem, recv_sem, copy_sem, *rest, axis, m
     cp.wait()
 
     # Peers may still be in a previous kernel using out_ref; rendezvous first.
-    tpl.barrier_all(axis, mesh_axes=mesh_axes)
+    sk.bounded_barrier_all(status_ref, axis, mesh_axes=mesh_axes, phase="barrier")
 
     def step(s, _):
         src = jax.lax.rem(me - s + world, world)  # chunk I forward
@@ -122,18 +133,24 @@ def _ring_ag_kernel(x_ref, out_ref, send_sem, recv_sem, copy_sem, *rest, axis, m
         dma.start()
         # Chunk (me-s-1)%world arrives from my left neighbour on the same slot.
         arriving = jax.lax.rem(me - s - 1 + world, world)
-        pltpu.make_async_copy(out_ref.at[arriving], out_ref.at[arriving], recv_sem.at[slot]).wait()
+        sk.bounded_wait_recv(
+            recv_sem.at[slot], out_ref.at[arriving], status_ref,
+            phase="ag_recv", peer=left_rank,
+        )
+        # Send-leg drain stays unbounded: the LOCAL DMA engine completes the
+        # send even when the peer's kernel is dead, so this cannot hang.
         dma.wait_send()
         return 0
 
     jax.lax.fori_loop(0, world - 1, step, 0)
 
 
-def _fullmesh_ag_kernel(x_ref, out_ref, send_sem, recv_sem, copy_sem, *rest, axis, mesh_axes, straggler=None):
+def _fullmesh_ag_kernel(x_ref, out_ref, status_ref, send_sem, recv_sem, copy_sem, *rest, axis, mesh_axes, straggler=None):
     """Full-mesh push: put my shard to every peer's out[me] slot, then wait for
     world-1 arrivals (reference push producer ``allgather.py:82-148``)."""
     me = tpl.rank(axis)
     world = tpl.num_ranks(axis)
+    sk.init_status(status_ref, axis=axis)
 
     if straggler is not None:
         @pl.when(jnp.equal(me, straggler[0]))
@@ -144,7 +161,7 @@ def _fullmesh_ag_kernel(x_ref, out_ref, send_sem, recv_sem, copy_sem, *rest, axi
     cp.start()
     cp.wait()
 
-    tpl.barrier_all(axis, mesh_axes=mesh_axes)
+    sk.bounded_barrier_all(status_ref, axis, mesh_axes=mesh_axes, phase="barrier")
 
     def send(i, _):
         peer = jax.lax.rem(me + i, world)  # skew start so links are balanced
@@ -159,8 +176,11 @@ def _fullmesh_ag_kernel(x_ref, out_ref, send_sem, recv_sem, copy_sem, *rest, axi
     def wait_one(i, _):
         src = jax.lax.rem(me + i, world)
         # Each arrival delivers one shard-sized chunk; recv_sem counts bytes.
-        pltpu.make_async_copy(out_ref.at[src], out_ref.at[src], recv_sem).wait()
-        pltpu.make_async_copy(x_ref, x_ref, send_sem).wait()  # drain send leg
+        sk.bounded_wait_recv(
+            recv_sem, out_ref.at[src], status_ref, phase="fanin_recv", peer=src
+        )
+        # Send drain is a LOCAL completion — unbounded by design (can't hang).
+        pltpu.make_async_copy(x_ref, x_ref, send_sem).wait()
         return 0
 
     jax.lax.fori_loop(1, world, wait_one, 0)
@@ -182,13 +202,17 @@ def _ag_pallas(shard, *, axis, mesh_axes, method, straggler=None):
         # The delay scratch (and kernel arg) exists only under fault
         # injection — production launches keep the pre-straggler signature.
         sems = sems + [pltpu.VMEM((8, 128), jnp.float32)]
-    out = dist_pallas_call(
+    out, status = dist_pallas_call(
         functools.partial(kernel, axis=axis, mesh_axes=mesh_axes, straggler=straggler),
-        out_shape=jax.ShapeDtypeStruct((world, *shard.shape), shard.dtype),
+        out_shape=(
+            jax.ShapeDtypeStruct((world, *shard.shape), shard.dtype),
+            sk.status_out_shape(),
+        ),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
-        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        out_specs=(pl.BlockSpec(memory_space=pl.ANY), sk.status_out_spec()),
         scratch_shapes=sems,
     )(shard)
+    resilience.consume_status(status, feature="allgather", kernel=kernel.__name__)
     return out
 
 

@@ -31,7 +31,9 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import PartitionSpec as P
 
 import triton_dist_tpu.language as tpl
+from triton_dist_tpu.runtime import resilience
 from triton_dist_tpu.runtime.mesh import DistContext
+from triton_dist_tpu.shmem import kernel as sk
 from triton_dist_tpu.shmem.kernel import dist_pallas_call
 from triton_dist_tpu.kernels.allgather import all_gather_shard, AllGatherMethod
 from triton_dist_tpu.kernels.reduce_scatter import reduce_scatter_shard
@@ -75,7 +77,17 @@ def get_auto_all_reduce_method(nbytes: int, world: int) -> AllReduceMethod:
     """Reference ``get_auto_all_reduce_method`` (``kernels/allreduce.py:75``):
     latency-bound small messages → one-shot; bandwidth-bound → two-shot.
     The threshold is a tune-cache lookup (measured crossover) with the
-    static ``DEFAULT_AR_CROSSOVER_BYTES`` as fallback."""
+    static ``DEFAULT_AR_CROSSOVER_BYTES`` as fallback.
+
+    The degradation check runs FIRST — before the crossover lookup, which
+    is itself a collective (``agreed_cfg_value`` digest allgather) we must
+    not dispatch once the process is degraded. Two-shot composes RS+AG, so
+    any of the three features tripping routes AUTO to XLA (sticky)."""
+    if resilience.is_degraded("allreduce", "reduce_scatter", "allgather"):
+        resilience.note_fallback_once(
+            "allreduce.auto", "routing AUTO all-reduce to XLA psum"
+        )
+        return AllReduceMethod.XLA
     if nbytes <= ar_crossover_bytes(world):
         return AllReduceMethod.ONE_SHOT
     return AllReduceMethod.TWO_SHOT
@@ -98,6 +110,7 @@ def _one_shot_ar_kernel(
     x_ref,
     out_ref,
     gather_buf,  # HBM (world, *shape) symmetric landing zone (dummy output)
+    status_ref,
     acc_ref,
     tmp_ref,
     send_sem,
@@ -117,12 +130,13 @@ def _one_shot_ar_kernel(
     """
     me = tpl.rank(axis)
     world = tpl.num_ranks(axis)
+    sk.init_status(status_ref, axis=axis)
 
     cp = pltpu.make_async_copy(x_ref, gather_buf.at[me], copy_sem)
     cp.start()
     cp.wait()
 
-    tpl.barrier_all(axis, mesh_axes=mesh_axes)
+    sk.bounded_barrier_all(status_ref, axis, mesh_axes=mesh_axes, phase="barrier")
 
     def send(i, _):
         peer = jax.lax.rem(me + i, world)
@@ -135,7 +149,9 @@ def _one_shot_ar_kernel(
     jax.lax.fori_loop(1, world, send, 0)
 
     def drain(i, _):
-        pltpu.make_async_copy(x_ref, x_ref, recv_sem).wait()
+        # Shared fan-in recv semaphore: arrivals carry no sender identity,
+        # so a timeout here reports peer -1. Send drain is local (unbounded).
+        sk.bounded_wait_recv(recv_sem, x_ref, status_ref, phase="fanin_recv")
         pltpu.make_async_copy(x_ref, x_ref, send_sem).wait()
         return 0
 
@@ -155,7 +171,9 @@ def _one_shot_ar_kernel(
     jax.lax.fori_loop(0, world, add, 0)
     out_ref[...] = acc_ref[...].astype(out_ref.dtype)
 
-    tpl.barrier_all(axis, mesh_axes=mesh_axes)
+    sk.bounded_barrier_all(
+        status_ref, axis, mesh_axes=mesh_axes, phase="exit_barrier"
+    )
 
 
 def all_reduce_shard(
@@ -201,7 +219,7 @@ def one_shot_ar_call(x, *, axis, mesh_axes=None, accum_dtype=jnp.float32):
     the measured time is the kernel-overhead floor the perf model adds ICI
     wire time to)."""
     world = jax.lax.axis_size(axis)
-    out, _ = dist_pallas_call(
+    out, _, status = dist_pallas_call(
         functools.partial(
             _one_shot_ar_kernel, axis=axis, mesh_axes=mesh_axes, accum_dtype=accum_dtype
         ),
@@ -209,11 +227,13 @@ def one_shot_ar_call(x, *, axis, mesh_axes=None, accum_dtype=jnp.float32):
             jax.ShapeDtypeStruct(x.shape, x.dtype),
             # Symmetric landing zone as an ANY output (scratch must be VMEM).
             jax.ShapeDtypeStruct((world, *x.shape), x.dtype),
+            sk.status_out_shape(),
         ),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=(
             pl.BlockSpec(memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pl.ANY),
+            sk.status_out_spec(),
         ),
         scratch_shapes=[
             pltpu.VMEM(x.shape, accum_dtype),
@@ -223,6 +243,9 @@ def one_shot_ar_call(x, *, axis, mesh_axes=None, accum_dtype=jnp.float32):
             pltpu.SemaphoreType.DMA,
         ],
     )(x)
+    resilience.consume_status(
+        status, feature="allreduce", kernel="_one_shot_ar_kernel"
+    )
     return out
 
 

@@ -33,7 +33,9 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 import triton_dist_tpu.language as tpl
+from triton_dist_tpu.runtime import resilience
 from triton_dist_tpu.runtime.mesh import DistContext
+from triton_dist_tpu.shmem import kernel as sk
 from triton_dist_tpu.shmem.kernel import dist_pallas_call
 from triton_dist_tpu.kernels.moe_utils import RoutingPlan, make_routing_plan, dispatch as local_dispatch
 
@@ -41,17 +43,18 @@ from triton_dist_tpu.kernels.moe_utils import RoutingPlan, make_routing_plan, di
 # ------------------------------------------------------- one-sided all_to_all
 
 
-def _a2a_kernel(x_ref, out_ref, send_sem, recv_sem, copy_sem, *, axis, mesh_axes):
+def _a2a_kernel(x_ref, out_ref, status_ref, send_sem, recv_sem, copy_sem, *, axis, mesh_axes):
     """All-to-all of per-peer chunks: x[(world, c, d)] — chunk p goes to peer
     p's out[me]. Full-mesh one-shot puts (latency-optimal; the low-latency
     a2a shape)."""
     me = tpl.rank(axis)
     world = tpl.num_ranks(axis)
+    sk.init_status(status_ref, axis=axis)
 
     cp = pltpu.make_async_copy(x_ref.at[me], out_ref.at[me], copy_sem)
     cp.start()
     cp.wait()
-    tpl.barrier_all(axis, mesh_axes=mesh_axes)
+    sk.bounded_barrier_all(status_ref, axis, mesh_axes=mesh_axes, phase="barrier")
 
     def send(i, _):
         peer = jax.lax.rem(me + i, world)
@@ -65,12 +68,16 @@ def _a2a_kernel(x_ref, out_ref, send_sem, recv_sem, copy_sem, *, axis, mesh_axes
     jax.lax.fori_loop(1, world, send, 0)
 
     def drain(i, _):
-        pltpu.make_async_copy(x_ref.at[0], x_ref.at[0], recv_sem).wait()
+        # Shared fan-in recv semaphore: arrivals carry no sender identity,
+        # so a timeout here reports peer -1. Send drain is local (unbounded).
+        sk.bounded_wait_recv(recv_sem, x_ref.at[0], status_ref, phase="a2a_recv")
         pltpu.make_async_copy(x_ref.at[0], x_ref.at[0], send_sem).wait()
         return 0
 
     jax.lax.fori_loop(1, world, drain, 0)
-    tpl.barrier_all(axis, mesh_axes=mesh_axes)
+    sk.bounded_barrier_all(
+        status_ref, axis, mesh_axes=mesh_axes, phase="exit_barrier"
+    )
 
 
 def all_to_all_single_shard(
@@ -85,19 +92,29 @@ def all_to_all_single_shard(
     world = jax.lax.axis_size(axis)
     if world == 1:
         return x
+    if use_pallas and resilience.is_degraded("a2a"):
+        resilience.note_fallback_once(
+            "a2a", "routing all-to-all to XLA lax.all_to_all"
+        )
+        use_pallas = False
     if not use_pallas:
         return jax.lax.all_to_all(x, axis, split_axis=0, concat_axis=0, tiled=False)
-    return dist_pallas_call(
+    out, status = dist_pallas_call(
         functools.partial(_a2a_kernel, axis=axis, mesh_axes=mesh_axes),
-        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        out_shape=(
+            jax.ShapeDtypeStruct(x.shape, x.dtype),
+            sk.status_out_shape(),
+        ),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
-        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        out_specs=(pl.BlockSpec(memory_space=pl.ANY), sk.status_out_spec()),
         scratch_shapes=[
             pltpu.SemaphoreType.DMA,
             pltpu.SemaphoreType.DMA,
             pltpu.SemaphoreType.DMA,
         ],
     )(x)
+    resilience.consume_status(status, feature="a2a", kernel="_a2a_kernel")
+    return out
 
 
 # ------------------------------------------------------------ EP dispatch/combine

@@ -45,11 +45,13 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import PartitionSpec as P
 
 import triton_dist_tpu.language as tpl
+from triton_dist_tpu.runtime import resilience
 from triton_dist_tpu.runtime.mesh import DistContext
 from triton_dist_tpu.kernels.allgather import all_gather_shard, AllGatherMethod
 from triton_dist_tpu.kernels.allreduce import all_reduce_shard, AllReduceMethod
 from triton_dist_tpu.kernels.gemm import GemmConfig, fit_block
 from triton_dist_tpu.kernels.gemm_reduce_scatter import _gemm_rs_xla_ring
+from triton_dist_tpu.shmem import kernel as sk
 from triton_dist_tpu.shmem.kernel import collective_id_for, dist_pallas_call
 
 
@@ -87,7 +89,17 @@ def gemm_ar_crossover_m(world: int) -> int:
 def get_auto_gemm_ar_method(m: int, world: int) -> GemmARMethod:
     """Reference ``get_auto_method`` analog for GEMM-AR: ragged M (the fused
     ring chunks rows over ranks) or decode-sized M → the low-latency one-shot
-    kernel; larger M → the tile-granular fused ring."""
+    kernel; larger M → the tile-granular fused ring.
+
+    Degradation check FIRST — before the crossover lookup, which is itself
+    a collective (``agreed_cfg_value``) that must not be dispatched once
+    the process is degraded. Sticky: AUTO keeps routing ``dot + psum``
+    until ``resilience.reset_degradation()``."""
+    if resilience.is_degraded("gemm_ar"):
+        resilience.note_fallback_once(
+            "gemm_ar.auto", "routing AUTO gemm+allreduce to XLA dot+psum"
+        )
+        return GemmARMethod.XLA
     if m % world != 0 or m <= gemm_ar_crossover_m(world):
         return GemmARMethod.LL_ONE_SHOT
     return GemmARMethod.PALLAS_FUSED
@@ -118,6 +130,7 @@ def _gemm_ar_fused_kernel(
     #         the rest ring-broadcast in the AG phase
     send_buf,  # (2, chunk, n) f32 ANY — outgoing partial chunk, per-slot
     recv_buf,  # (2, chunk, n) f32 ANY — incoming partial chunk, per-slot
+    status_ref,  # SMEM (STATUS_WORDS,) bounded-wait abort record
     acc,  # VMEM (bm, bn) f32
     recv_tile,  # VMEM (bm, bn) f32 — staged incoming tile
     send_stage,  # VMEM (2, bm, bn) f32 — outgoing tile, double-buffered
@@ -152,6 +165,11 @@ def _gemm_ar_fused_kernel(
     world = tpl.num_ranks(axis)
     right = tpl.ring_neighbor(axis, +1, mesh_axes=mesh_axes)
     left = tpl.ring_neighbor(axis, -1, mesh_axes=mesh_axes)
+    # Peer attribution is by rank index along `axis` (not logical device id):
+    # this kernel has NO entry barrier, so the first wait that a dead left
+    # neighbour starves (rs_recv) names the exact peer in the abort record.
+    left_rank = jax.lax.rem(me - 1 + world, world)
+    right_rank = jax.lax.rem(me + 1, world)
     bm, bn = acc.shape
     chunk = n_m * bm  # rows per rank
     cur = jax.lax.rem(s, 2)  # outgoing slot of this step
@@ -159,17 +177,28 @@ def _gemm_ar_fused_kernel(
 
     @pl.when(jnp.logical_and(im == 0, jnp.logical_and(jn == 0, kk == 0)))
     def _step_start():
+        @pl.when(s == 0)
+        def _():
+            sk.init_status(status_ref, axis=axis)
+
         @pl.when(s > 0)
         def _():
             # Incoming partial chunk fully arrived (dl.wait analog).
-            tpl.wait_recv(recv_sem.at[prev], recv_buf.at[prev])
+            sk.bounded_wait_recv(
+                recv_sem.at[prev], recv_buf.at[prev], status_ref,
+                phase="rs_recv", peer=left_rank,
+            )
 
         @pl.when(s >= 2)
         def _():
-            # Slot reuse: our send of step s-2 completed locally, and the
-            # right neighbor consumed it (credit backpressure).
+            # Slot reuse: our send of step s-2 completed locally (LOCAL DMA
+            # completion — unbounded by design), and the right neighbor
+            # consumed it (credit backpressure — bounded).
             tpl.wait_send(send_sem.at[cur], send_buf.at[cur])
-            tpl.wait(credit_sem.at[cur], 1)
+            sk.bounded_wait(
+                credit_sem.at[cur], status_ref,
+                phase="rs_credit", peer=right_rank,
+            )
 
     # Stage the incoming tile for this (im, jn) early — overlaps the K-loop.
     @pl.when(jnp.logical_and(s > 0, kk == 0))
@@ -299,7 +328,10 @@ def _gemm_ar_fused_kernel(
             out_stage.at[t_last], out_stage.at[t_last], out_sem.at[t_last]
         ).wait()
         tpl.wait_send(send_sem.at[(world - 2) % 2], send_buf.at[0])
-        tpl.wait(credit_sem.at[(world - 2) % 2], 1)
+        sk.bounded_wait(
+            credit_sem.at[(world - 2) % 2], status_ref,
+            phase="rs_credit_drain", peer=right_rank,
+        )
 
         # AG phase: ring-broadcast the finished chunks out of the same
         # kernel (``_ring_ag_kernel``'s step protocol over o_ref row-slices).
@@ -322,16 +354,20 @@ def _gemm_ar_fused_kernel(
             # Chunk (me-s2-1)%world arrives from the left on the same slot.
             arriving = jax.lax.rem(me - s2 - 1 + world, world)
             arows = pl.ds(arriving * chunk, chunk)
-            pltpu.make_async_copy(
-                o_ref.at[arows], o_ref.at[arows], ag_recv_sem.at[s2]
-            ).wait()
+            sk.bounded_wait_recv(
+                ag_recv_sem.at[s2], o_ref.at[arows], status_ref,
+                phase="ag_recv", peer=left_rank,
+            )
+            # Send drain is a LOCAL completion — unbounded by design.
             dma.wait_send()
             return 0
 
         jax.lax.fori_loop(0, world - 1, ag_step, 0)
         # Peers must not start a next kernel that reuses these buffers (or
         # this kernel again) while stragglers still forward chunks.
-        tpl.barrier_all(axis, mesh_axes=mesh_axes)
+        sk.bounded_barrier_all(
+            status_ref, axis, mesh_axes=mesh_axes, phase="exit_barrier"
+        )
 
 
 def _gemm_ar_fused(a, b, *, axis, mesh_axes, config=None):
@@ -354,7 +390,7 @@ def _gemm_ar_fused(a, b, *, axis, mesh_axes, config=None):
     n_m, n_n, n_k = chunk // bm, n // bn, k // bk
     sched = jnp.mod(me - 1 - jnp.arange(world, dtype=jnp.int32), world).astype(jnp.int32)
 
-    out, _, _ = dist_pallas_call(
+    out, _, _, status = dist_pallas_call(
         functools.partial(
             _gemm_ar_fused_kernel,
             axis=axis,
@@ -376,6 +412,7 @@ def _gemm_ar_fused(a, b, *, axis, mesh_axes, config=None):
                 pl.BlockSpec(memory_space=pl.ANY),
                 pl.BlockSpec(memory_space=pl.ANY),
                 pl.BlockSpec(memory_space=pl.ANY),
+                sk.status_out_spec(),
             ),
             scratch_shapes=[
                 pltpu.VMEM((bm, bn), jnp.float32),
@@ -396,6 +433,7 @@ def _gemm_ar_fused(a, b, *, axis, mesh_axes, config=None):
             jax.ShapeDtypeStruct((m, n), a.dtype),
             jax.ShapeDtypeStruct((2, chunk, n), jnp.float32),
             jax.ShapeDtypeStruct((2, chunk, n), jnp.float32),
+            sk.status_out_shape(),
         ),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary", "arbitrary", "arbitrary"),
@@ -403,6 +441,9 @@ def _gemm_ar_fused(a, b, *, axis, mesh_axes, config=None):
             collective_id=collective_id_for("_gemm_ar_fused_kernel"),
         ),
     )(sched, a, b)
+    resilience.consume_status(
+        status, feature="gemm_ar", kernel="_gemm_ar_fused_kernel"
+    )
     return out
 
 
@@ -411,6 +452,7 @@ def _gemm_ar_ll_kernel(
     b_ref,  # (bk, bn) VMEM — pipelined B tile
     out_ref,  # (m, n) VMEM — full reduced product (flushed once, at the end)
     gather_buf,  # (world, m, n) f32 ANY — symmetric landing zones (dummy out)
+    status_ref,  # SMEM (STATUS_WORDS,) bounded-wait abort record
     acc,  # VMEM (m, bn) f32
     stage,  # VMEM (m, bn) f32 — finished tile staging (reused after wait)
     red,  # VMEM (m, n) f32 — reduce accumulator
@@ -439,9 +481,12 @@ def _gemm_ar_ll_kernel(
 
     @pl.when(jnp.logical_and(jn == 0, kk == 0))
     def _():
+        sk.init_status(status_ref, axis=axis)
         # Peers may still be in a previous kernel using gather_buf (or a
         # previous call of this one); rendezvous before the first push.
-        tpl.barrier_all(axis, mesh_axes=mesh_axes)
+        sk.bounded_barrier_all(
+            status_ref, axis, mesh_axes=mesh_axes, phase="barrier"
+        )
 
     @pl.when(kk == 0)
     def _():
@@ -483,10 +528,14 @@ def _gemm_ar_ll_kernel(
         m, bn = acc.shape
 
         # Per-source waits: source src's n_n tile pushes sum to one full
-        # (m, n) f32 slot on its semaphore.
+        # (m, n) f32 slot on its semaphore — so a timeout names the exact
+        # peer whose contribution never arrived.
         def wait_one(i, _):
             src = jax.lax.rem(me + i, world)
-            tpl.wait_recv(recv_sem.at[src], gather_buf.at[src])
+            sk.bounded_wait_recv(
+                recv_sem.at[src], gather_buf.at[src], status_ref,
+                phase="fanin_recv", peer=src,
+            )
             return 0
 
         jax.lax.fori_loop(1, world, wait_one, 0)
@@ -511,7 +560,9 @@ def _gemm_ar_ll_kernel(
 
         jax.lax.fori_loop(0, world, add, 0)
         out_ref[...] = red[...].astype(out_ref.dtype)
-        tpl.barrier_all(axis, mesh_axes=mesh_axes)
+        sk.bounded_barrier_all(
+            status_ref, axis, mesh_axes=mesh_axes, phase="exit_barrier"
+        )
 
 
 def gemm_ar_ll_call(a, b, *, axis, mesh_axes=None, config=None):
@@ -528,7 +579,7 @@ def gemm_ar_ll_call(a, b, *, axis, mesh_axes=None, config=None):
     bk = fit_block(k, cfg.block_k)
     n_n, n_k = n // bn, k // bk
 
-    out, _ = dist_pallas_call(
+    out, _, status = dist_pallas_call(
         functools.partial(
             _gemm_ar_ll_kernel, axis=axis, mesh_axes=mesh_axes, n_n=n_n, n_k=n_k
         ),
@@ -542,10 +593,12 @@ def gemm_ar_ll_call(a, b, *, axis, mesh_axes=None, config=None):
             # last grid cell, flushed once after it.
             pl.BlockSpec((m, n), lambda jn, kk: (0, 0)),
             pl.BlockSpec(memory_space=pl.ANY),
+            sk.status_out_spec(),
         ),
         out_shape=(
             jax.ShapeDtypeStruct((m, n), a.dtype),
             jax.ShapeDtypeStruct((world, m, n), jnp.float32),
+            sk.status_out_shape(),
         ),
         scratch_shapes=[
             pltpu.VMEM((m, bn), jnp.float32),
@@ -563,6 +616,9 @@ def gemm_ar_ll_call(a, b, *, axis, mesh_axes=None, config=None):
             collective_id=collective_id_for("_gemm_ar_ll_kernel"),
         ),
     )(a, b)
+    resilience.consume_status(
+        status, feature="gemm_ar", kernel="_gemm_ar_ll_kernel"
+    )
     return out
 
 

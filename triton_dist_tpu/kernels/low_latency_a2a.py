@@ -29,6 +29,7 @@ import dataclasses
 import jax
 import jax.numpy as jnp
 
+from triton_dist_tpu.runtime import resilience
 from triton_dist_tpu.kernels.ep_a2a import all_to_all_single_shard
 from triton_dist_tpu.kernels.group_gemm import group_gemm, group_gemm_swiglu
 from triton_dist_tpu.kernels.moe_utils import (
@@ -84,6 +85,13 @@ def ll_dispatch_shard(
     t, d = x.shape
     e_local = num_experts // world
 
+    # Degraded-mode gate at the composition level: one trace-time check
+    # covers BOTH legs (payload + scale a2a) instead of two downstream
+    # checks inside all_to_all_single_shard — every transfer of this
+    # dispatch rides the same transport. The bounded waits themselves live
+    # in the shared ``ep_a2a._a2a_kernel`` all legs route through.
+    use_pallas = use_pallas and not resilience.is_degraded("a2a")
+
     plan = make_routing_plan(expert_idx, num_experts, capacity)
     buf = local_dispatch(x, plan)  # (E, C, d) destination-major
     send = buf.reshape(world, e_local * capacity, d)
@@ -130,6 +138,8 @@ def combine_leg_shard(
     world = jax.lax.axis_size(axis)
     e_local, wc, d = y.shape
     capacity = wc // world
+    # Same composition-level degraded-mode gate as ll_dispatch_shard.
+    use_pallas = use_pallas and not resilience.is_degraded("a2a")
     send = ungroup_to_peers(y, world, e_local, capacity)
     recv = all_to_all_single_shard(
         send, axis=axis, mesh_axes=mesh_axes, use_pallas=use_pallas

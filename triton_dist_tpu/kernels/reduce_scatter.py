@@ -29,7 +29,9 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import PartitionSpec as P
 
 import triton_dist_tpu.language as tpl
+from triton_dist_tpu.runtime import resilience
 from triton_dist_tpu.runtime.mesh import DistContext
+from triton_dist_tpu.shmem import kernel as sk
 from triton_dist_tpu.shmem.kernel import dist_pallas_call
 
 
@@ -54,6 +56,7 @@ def _ring_rs_kernel(
     out_ref,  # (chunk_m, n)
     recv_buf,  # HBM (2, chunk_m, n) landing zone for incoming partials (dummy output)
     send_buf,  # HBM (2, chunk_m, n) staged outgoing partials (dummy output)
+    status_ref,  # SMEM (STATUS_WORDS,) bounded-wait abort record
     acc_ref,  # VMEM (chunk_m, n) wire dtype — running sum, also the send stage
     tmp_in,  # VMEM (chunk_m, n)
     tmp_x,  # VMEM (chunk_m, n)
@@ -79,8 +82,12 @@ def _ring_rs_kernel(
     world = tpl.num_ranks(axis)
     right = tpl.ring_neighbor(axis, +1, mesh_axes=mesh_axes)
     left = tpl.ring_neighbor(axis, -1, mesh_axes=mesh_axes)
+    # Peer attribution is by rank index along `axis` (not logical device id).
+    left_rank = jax.lax.rem(me - 1 + world, world)
+    right_rank = jax.lax.rem(me + 1, world)
+    sk.init_status(status_ref, axis=axis)
 
-    tpl.barrier_all(axis, mesh_axes=mesh_axes)
+    sk.bounded_barrier_all(status_ref, axis, mesh_axes=mesh_axes, phase="barrier")
 
     # Stage my partial for chunk (me-1): copy into send_buf[0] via VMEM acc.
     first = jax.lax.rem(me - 1 + world, world)
@@ -97,7 +104,10 @@ def _ring_rs_kernel(
         # step s-2. Wait for its "slot free" credit before re-sending into it.
         @pl.when(s >= 2)
         def _():
-            tpl.wait(credit_sem, 1)
+            # Credits are granted by my +1 neighbour as it consumes slots.
+            sk.bounded_wait(
+                credit_sem, status_ref, phase="rs_credit", peer=right_rank
+            )
 
         dma = pltpu.make_async_remote_copy(
             src_ref=send_buf.at[send_slot],
@@ -110,7 +120,11 @@ def _ring_rs_kernel(
         dma.start()
         # Receive the running sum for chunk (me - s - 2).
         incoming = jax.lax.rem(me - s - 2 + 2 * world, world)
-        pltpu.make_async_copy(recv_buf.at[recv_slot], recv_buf.at[recv_slot], recv_sem.at[recv_slot]).wait()
+        sk.bounded_wait_recv(
+            recv_sem.at[recv_slot], recv_buf.at[recv_slot], status_ref,
+            phase="rs_recv", peer=left_rank,
+        )
+        # Send drain is a LOCAL completion — unbounded by design (can't hang).
         dma.wait_send()
         # HBM → VMEM: incoming partial and my own partial for that chunk
         # (HBM refs cannot be read by the VPU directly).
@@ -143,10 +157,15 @@ def _ring_rs_kernel(
     out_ref[...] = acc_ref[...]
     # Drain unconsumed credits (granted world-1, consumed max(world-3,0))
     # so the semaphore is zero at kernel exit.
-    tpl.wait(credit_sem, min(world - 1, 2))
+    sk.bounded_wait(
+        credit_sem, status_ref, value=min(world - 1, 2),
+        phase="rs_credit_drain", peer=right_rank,
+    )
 
     # Ranks drift; make buffer reuse across calls safe.
-    tpl.barrier_all(axis, mesh_axes=mesh_axes)
+    sk.bounded_barrier_all(
+        status_ref, axis, mesh_axes=mesh_axes, phase="exit_barrier"
+    )
 
 
 def reduce_scatter_shard(
@@ -160,7 +179,11 @@ def reduce_scatter_shard(
     """Reduce-scatter local partials over ``axis``: returns this rank's
     ``(chunk_m, n)`` chunk of the sum. Usable inside shard_map."""
     world = jax.lax.axis_size(axis)
-    if use_xla or world == 1:
+    if use_xla or world == 1 or resilience.is_degraded("reduce_scatter"):
+        if not use_xla and world > 1:
+            resilience.note_fallback_once(
+                "reduce_scatter", "routing reduce-scatter to XLA psum_scatter"
+            )
         return jax.lax.psum_scatter(x, axis, scatter_dimension=0, tiled=True)
     assert x.shape[0] % world == 0, (x.shape, world)
     chunk_m = x.shape[0] // world
@@ -172,7 +195,7 @@ def reduce_scatter_shard(
     # Comm buffers are extra ANY (HBM) *outputs*, not scratch: scratch is
     # VMEM/SMEM-only (interpret mode enforces it; on hw ANY-scratch would
     # alias real HBM anyway). The dummy outputs are dropped.
-    out, _, _ = dist_pallas_call(
+    out, _, _, status = dist_pallas_call(
         functools.partial(
             _ring_rs_kernel, axis=axis, mesh_axes=mesh_axes, accum_dtype=accum_dtype
         ),
@@ -180,12 +203,14 @@ def reduce_scatter_shard(
             jax.ShapeDtypeStruct(chunk_shape, x.dtype),
             jax.ShapeDtypeStruct((2, *chunk_shape), wire_dtype),
             jax.ShapeDtypeStruct((2, *chunk_shape), wire_dtype),
+            sk.status_out_shape(),
         ),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=(
             pl.BlockSpec(memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
+            sk.status_out_spec(),
         ),
         scratch_shapes=[
             pltpu.VMEM(chunk_shape, wire_dtype),
@@ -198,6 +223,9 @@ def reduce_scatter_shard(
             pltpu.SemaphoreType.REGULAR,
         ],
     )(xw)
+    resilience.consume_status(
+        status, feature="reduce_scatter", kernel="_ring_rs_kernel"
+    )
     return out
 
 

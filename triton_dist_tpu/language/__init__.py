@@ -60,6 +60,7 @@ from triton_dist_tpu.language.core import (
     getmem_nbi,
     local_copy,
     barrier_all,
+    barrier_signal_all,
     quiet,
     delay,
     semaphore_read,
@@ -83,6 +84,7 @@ __all__ = [
     "getmem_nbi",
     "local_copy",
     "barrier_all",
+    "barrier_signal_all",
     "quiet",
     "delay",
     "semaphore_read",

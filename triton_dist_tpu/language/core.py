@@ -249,9 +249,24 @@ def barrier_all(axis: str | Sequence[str] = "tp", mesh_axes: Sequence[str] | Non
     """
     axes = (axis,) if isinstance(axis, str) else tuple(axis)
     barrier_sem = pltpu.get_barrier_semaphore()
+    world = barrier_signal_all(axes, mesh_axes)
+    pltpu.semaphore_wait(barrier_sem, world)
+
+
+def barrier_signal_all(
+    axes: Sequence[str], mesh_axes: Sequence[str] | None = None
+) -> int:
+    """Signal the Mosaic barrier semaphore on every rank of ``axes``
+    (including a self-signal to keep counts uniform) and return ``world``.
+
+    The arrival half of :func:`barrier_all`, factored out so bounded-wait
+    barriers (``shmem.kernel.bounded_barrier_all``) reuse the exact same
+    peer-id decomposition while replacing only the blocking wait half.
+    """
+    axes = tuple(axes)
+    barrier_sem = pltpu.get_barrier_semaphore()
     world = num_ranks(axes)
 
-    # Signal every peer (including a self-signal to keep the count uniform).
     def signal_peer(i, _):
         # i is the peer's linear index along `axes`; convert to logical id.
         peer_linear = i
@@ -281,7 +296,7 @@ def barrier_all(axis: str | Sequence[str] = "tp", mesh_axes: Sequence[str] | Non
         return 0
 
     jax.lax.fori_loop(0, world, signal_peer, 0)
-    pltpu.semaphore_wait(barrier_sem, world)
+    return world
 
 
 def quiet(dma_descriptors) -> None:

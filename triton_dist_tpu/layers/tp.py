@@ -40,6 +40,24 @@ from triton_dist_tpu.kernels.moe_utils import (
     topk_routing,
 )
 from triton_dist_tpu.kernels.group_gemm import group_gemm
+from triton_dist_tpu.runtime import resilience
+
+
+def _tp_mode(mode: str) -> str:
+    """Degraded-mode remap for the per-call forward switch (trace time).
+
+    Once any collective is marked degraded (bounded-wait abort or watchdog
+    trip), ``dist_ar`` calls run as ``xla`` — the two modes share the
+    replicated-input contract, so the swap is transparent to callers.
+    ``dist`` takes SEQUENCE-SHARDED inputs (a different data contract), so
+    it is NOT remapped here; its collectives degrade kernel-by-kernel via
+    their own routing gates."""
+    if mode == "dist_ar" and resilience.any_degraded():
+        resilience.note_fallback_once(
+            "layers.tp", "running dist_ar layers on the xla backend"
+        )
+        return "xla"
+    return mode
 
 
 def _pytree_dataclass(cls):
@@ -96,6 +114,7 @@ class TP_MLP:
     def __call__(self, x: jax.Array, mode: str = "dist") -> jax.Array:
         """x: (m_shard, d) for 'dist' (seq-sharded), (m, d) for
         'xla'/'dist_ar' (replicated input). Output matches input sharding."""
+        mode = _tp_mode(mode)
         axis = self.axis
         if mode == "xla":
             g = jnp.dot(x, self.w_gate, preferred_element_type=jnp.float32)
@@ -153,6 +172,7 @@ class TP_Attn:
         """x: (bsz·seq[_shard], d) tokens; pos: (bsz, seq) positions.
         Returns (out, (k, v)) — out sharded like x, k/v local heads (B,H,S,D).
         """
+        mode = _tp_mode(mode)
         axis = self.axis
         seq = pos.shape[1]
         if mode == "dist":
@@ -182,6 +202,7 @@ class TP_Attn:
         at ``lengths`` (static shapes — the XLA analog of the reference's
         CUDA-graph-safe ``KV_Cache.inc_offset``) and returns
         (out (bsz, d) replicated, (k_cache, v_cache) updated)."""
+        mode = _tp_mode(mode)
         bsz = x.shape[0]
         qkv = jnp.dot(x, self.wqkv, preferred_element_type=jnp.float32).astype(x.dtype)
         q, k, v = self._split_qkv(qkv, bsz, 1)
@@ -254,6 +275,7 @@ class TP_MoE:
         """
         from triton_dist_tpu.kernels.moe_comm import tp_moe_ar_shard, tp_moe_rs_shard
 
+        mode = _tp_mode(mode)
         world = jax.lax.axis_size(self.axis)
         t, d = x.shape
         from triton_dist_tpu.kernels.moe_utils import CAPACITY_ALIGN

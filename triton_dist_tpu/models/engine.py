@@ -66,11 +66,22 @@ class Engine:
                  sample: str = "greedy", temperature: float = 1.0, top_p: float = 1.0):
         assert backend in _BACKENDS, backend
         self.model = model
-        self.backend = backend
         self.max_len = max_len
         self.sample_method = sample
         self.temperature = temperature
         self.top_p = top_p
+        self._build(backend)
+
+    def _build(self, backend: str) -> None:
+        """(Re)build the compiled prefill/decode programs for ``backend``.
+
+        Callable after construction: degraded-mode fallback rebuilds the
+        engine on "xla" (fresh jit functions retrace, so the sticky
+        degradation flags and the backend switch take effect) and serving
+        continues on the same model/caches."""
+        assert backend in _BACKENDS, backend
+        model = self.model
+        self.backend = backend
         ctx = model.ctx
         mesh = ctx.mesh
         axis = model.axis
@@ -220,6 +231,44 @@ class Engine:
                 # stops before the device work runs.
                 jax.block_until_ready(out)
                 return out
+        from triton_dist_tpu.runtime import resilience
+
+        watchdog = resilience.CollectiveWatchdog(
+            feature="collectives", name=f"engine.serve[{self.backend}]"
+        )
+
+        def fallback(ids, n, k):
+            # The watchdog has already marked "collectives" degraded; rebuild
+            # on the xla backend and serve the same request. Prefill re-runs
+            # from input_ids, so the donated caches of the wedged attempt
+            # are not needed.
+            self._degrade_to_xla("serve timed out under the collective watchdog")
+            return self._serve_once(ids, n, k)
+
+        try:
+            return watchdog.call(
+                self._serve_once, input_ids, gen_len, key, fallback=fallback
+            )
+        except Exception:
+            # A bounded-wait abort surfaced mid-serve (CollectiveAbortError
+            # via consume_status). The abort already flipped the sticky
+            # degradation flag for the stalled collective — rebuild on xla
+            # and retry once; further serves go straight to the fallback.
+            if self.backend != "xla" and resilience.any_degraded():
+                self._degrade_to_xla("a collective aborted mid-serve")
+                return self._serve_once(input_ids, gen_len, key)
+            raise
+
+    def _degrade_to_xla(self, why: str) -> None:
+        from triton_dist_tpu.runtime import resilience
+
+        resilience.note_fallback_once(
+            "engine.serve", f"rebuilding engine on the xla backend ({why})"
+        )
+        if self.backend != "xla":
+            self._build("xla")
+
+    def _serve_once(self, input_ids: jax.Array, gen_len: int, key: jax.Array | None):
         model = self.model
         bsz, seq = input_ids.shape
         assert seq + gen_len <= self.max_len

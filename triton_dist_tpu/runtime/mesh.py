@@ -20,6 +20,7 @@ from __future__ import annotations
 import dataclasses
 import math
 import os
+import time
 from typing import Mapping, Sequence
 
 import jax
@@ -129,11 +130,29 @@ def initialize_distributed(
             num_processes = int(os.environ.get("NUM_PROCESSES", "1"))
         if process_id is None:
             process_id = int(os.environ.get("PROCESS_ID", "0"))
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes,
-            process_id=process_id,
-        )
+        # Retry the rendezvous with exponential backoff: in a gang-scheduled
+        # launch the coordinator process may come up seconds after its
+        # followers, and a single refused connection should not kill the job.
+        attempts = 3
+        last: Exception | None = None
+        for attempt in range(attempts):
+            try:
+                jax.distributed.initialize(
+                    coordinator_address=coordinator_address,
+                    num_processes=num_processes,
+                    process_id=process_id,
+                )
+                last = None
+                break
+            except Exception as e:  # noqa: BLE001 — connect errors vary by transport
+                last = e
+                if attempt < attempts - 1:
+                    time.sleep(0.5 * 2**attempt)
+        if last is not None:
+            raise RuntimeError(
+                f"could not reach coordinator at {coordinator_address} "
+                f"after {attempts} attempts: {type(last).__name__}: {last}"
+            ) from last
         _JAX_DISTRIBUTED_INITIALIZED = True
 
     mesh = _build_mesh(axis_names, axis_sizes, devices)

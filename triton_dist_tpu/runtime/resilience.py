@@ -1,0 +1,431 @@
+"""Resilience layer: fault-injection plans, abort bookkeeping, watchdogs.
+
+The reference ships straggler injection (``sleep_async``, ``utils.py:650``)
+and otherwise leans on vendor SHMEM timeouts. This module is the TPU port's
+production counterpart, spanning four layers:
+
+* **FaultPlan** — a trace-time fault-injection registry threaded through
+  ``shmem.kernel.dist_pallas_call``: any distributed kernel can run under a
+  delayed rank, a dropped (dead) peer, or a corrupted status flag in CPU
+  interpret mode, without the kernel opting in.
+* **Status-buffer protocol** — every adopted collective kernel carries a
+  small SMEM status output (see ``shmem.kernel.STATUS_WORDS``); bounded
+  semaphore waits write an abort record (code, phase, peer, polls) into it
+  instead of spinning forever. :func:`consume_status` surfaces that record
+  host-side as a :class:`CollectiveAbortError` naming the stalled phase and
+  peer rank, and marks the collective degraded.
+* **Degradation registry** — sticky per-process flags consulted at trace
+  time by the AUTO routing in ``kernels/gemm_allreduce``/``allreduce``/
+  ``allgather``/``reduce_scatter``/``ep_a2a`` and by ``layers/tp``: once a
+  collective has aborted (or a watchdog tripped), subsequent traces route
+  the plain XLA collective path with a logged reason. Stickiness takes
+  effect at the next trace — exiting a :func:`fault_plan` context or an
+  ``Engine._build`` rebuild clears the jit caches that would otherwise
+  replay the cached Pallas executable.
+* **CollectiveWatchdog** — host-side wall-time bound on collective dispatch
+  with retry/backoff (``TDT_COLL_TIMEOUT_MS``, ``TDT_COLL_RETRIES``); on
+  final timeout it marks the feature degraded and either runs the caller's
+  fallback or raises :class:`CollectiveTimeoutError`. This complements the
+  PR 1 *bench* watchdog (``TDT_BENCH_WATCHDOG_S``), which hard-kills the
+  process: the collective watchdog is the serving-path version that keeps
+  the process alive on the XLA fallback.
+
+Env flags::
+
+    TDT_COLL_TIMEOUT_MS    watchdog per-attempt budget (0 = disabled, default)
+    TDT_COLL_RETRIES       extra watchdog attempts after the first (default 2)
+    TDT_WAIT_BOUND_ITERS   device-side wait poll cap (0 = unbounded waits)
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import enum
+import threading
+
+import numpy as np
+
+from triton_dist_tpu.runtime.utils import get_int_env
+
+# ------------------------------------------------------------- status protocol
+
+#: Status-word layout (int32): [0]=code, [1]=phase id, [2]=peer rank along the
+#: collective's axis (-1 = unattributable, e.g. a barrier or a shared fan-in
+#: semaphore), [3]=polls spent before giving up.
+STATUS_OK = 0
+STATUS_ABORT = 1
+
+#: Device-side wait poll caps when ``TDT_WAIT_BOUND_ITERS`` is unset. Each
+#: poll is a ``semaphore_read`` + compare: nanoseconds compiled on hardware,
+#: a host callback (~µs) in interpret mode — hence the split defaults. Both
+#: sit far above any legitimate wait so production traffic never trips them.
+DEFAULT_WAIT_BOUND_HW = 100_000_000
+DEFAULT_WAIT_BOUND_SIM = 1_000_000
+
+# Phase names are registered at trace time; SPMD tracing is identical on
+# every process, so ids agree across ranks without any exchange.
+_PHASES: list[str] = [
+    "barrier",
+    "exit_barrier",
+    "rs_recv",
+    "rs_credit",
+    "rs_credit_drain",
+    "ag_recv",
+    "fanin_recv",
+    "a2a_recv",
+    "injected_corrupt",
+]
+
+
+def phase_id(name: str) -> int:
+    """Stable small-int id for a wait-phase name (registers new names)."""
+    if name not in _PHASES:
+        _PHASES.append(name)
+    return _PHASES.index(name)
+
+
+def phase_name(pid: int) -> str:
+    return _PHASES[pid] if 0 <= pid < len(_PHASES) else "unknown"
+
+
+def wait_bound(explicit: int | None = None) -> int:
+    """Resolve the device-side wait poll cap at TRACE time (static in the
+    kernel). Priority: explicit arg > active FaultPlan override >
+    ``TDT_WAIT_BOUND_ITERS`` > platform default. 0 means unbounded (the
+    helpers emit the plain blocking wait)."""
+    if explicit is not None:
+        return int(explicit)
+    plan = _ACTIVE_PLAN
+    if plan is not None and plan.wait_bound is not None:
+        return int(plan.wait_bound)
+    env = get_int_env("TDT_WAIT_BOUND_ITERS", -1)
+    if env >= 0:
+        return env
+    from triton_dist_tpu.runtime.platform import is_cpu_platform
+
+    return DEFAULT_WAIT_BOUND_SIM if is_cpu_platform() else DEFAULT_WAIT_BOUND_HW
+
+
+# ------------------------------------------------------------------ exceptions
+
+
+class CollectiveAbortError(RuntimeError):
+    """A bounded device-side wait gave up: the status buffer reported an
+    abort, naming the stalled phase and (when attributable) the peer rank."""
+
+
+class CollectiveTimeoutError(RuntimeError):
+    """The host-side CollectiveWatchdog exhausted its attempts."""
+
+
+# ------------------------------------------------------------------ fault plans
+
+
+class FaultKind(enum.Enum):
+    #: Victim rank busy-waits ``delay_iters`` dependent iterations before
+    #: running the kernel body — the protocol must absorb the drift.
+    DELAY_RANK = "delay_rank"
+    #: Victim rank skips the kernel body entirely (sends, signals, barriers):
+    #: the dead-peer scenario. Peers' bounded waits must abort, not hang.
+    DROP_PEER = "drop_peer"
+    #: Victim rank's status buffer is initialized already-aborted (a poisoned
+    #: flag): its bounded waits short-circuit and the abort must surface.
+    CORRUPT_FLAG = "corrupt_flag"
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """One injected fault, applied at trace time to every kernel launched
+    through ``dist_pallas_call`` while the plan is active (interpret mode
+    only — fault injection is a simulation feature)."""
+
+    kind: FaultKind
+    rank: int
+    axis: str = "tp"
+    delay_iters: int = 20_000
+    #: Override the bounded-wait poll cap while this plan is active, so
+    #: chaos tests abort in milliseconds instead of the production bound.
+    wait_bound: int | None = None
+
+
+_ACTIVE_PLAN: FaultPlan | None = None
+
+
+def active_plan() -> FaultPlan | None:
+    return _ACTIVE_PLAN
+
+
+@contextlib.contextmanager
+def fault_plan(kind: FaultKind | str, rank: int, **kwargs):
+    """Activate a :class:`FaultPlan` for every ``dist_pallas_call`` traced
+    inside the context. Like ``platform.race_detection``, the plan is read
+    at TRACE time and does not participate in jit cache keys, so entry and
+    exit clear jax's compilation caches — functions re-trace with the fault
+    inside the context and re-trace clean after it (which is also what
+    makes the post-abort sticky XLA fallback take effect "transparently"
+    on the next call)."""
+    import jax
+
+    global _ACTIVE_PLAN
+    plan = FaultPlan(kind=FaultKind(kind), rank=rank, **kwargs)
+    prev = _ACTIVE_PLAN
+    _ACTIVE_PLAN = plan
+    jax.clear_caches()
+    try:
+        yield plan
+    finally:
+        _ACTIVE_PLAN = prev
+        jax.clear_caches()
+
+
+def apply_fault_plan(kernel, plan: FaultPlan):
+    """Wrap a kernel body with the plan's fault. Called by
+    ``dist_pallas_call`` AFTER the collective id is derived from the
+    original kernel (a wrapper key would burn a fresh id slot per plan)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    def wrapped(*refs):
+        me = jax.lax.axis_index(plan.axis)
+        if plan.kind is FaultKind.DROP_PEER:
+            @pl.when(me != jnp.int32(plan.rank))
+            def _():
+                kernel(*refs)
+        elif plan.kind is FaultKind.DELAY_RANK:
+            n = jnp.where(me == jnp.int32(plan.rank),
+                          jnp.int32(plan.delay_iters), jnp.int32(0))
+            spun = jax.lax.fori_loop(
+                0, n, lambda i, a: a * 1.0000001 + 1e-7, jnp.float32(1.0)
+            )
+            # Gate the body on a data-dependent, always-true-for-finite
+            # predicate so the spin cannot be dead-code-eliminated or
+            # const-folded away from the kernel.
+            @pl.when(spun > jnp.float32(-1.0))
+            def _():
+                kernel(*refs)
+        else:  # CORRUPT_FLAG is injected by shmem.kernel.init_status
+            kernel(*refs)
+
+    return wrapped
+
+
+# ------------------------------------------------------ degradation registry
+
+
+@dataclasses.dataclass(frozen=True)
+class AbortInfo:
+    feature: str
+    kernel: str
+    phase: str
+    peer: int
+    polls: int
+    reason: str
+
+
+_LOCK = threading.Lock()
+_DEGRADED: dict[str, str] = {}
+_ABORTS: list[AbortInfo] = []
+_NOTED: set[str] = set()
+
+
+def mark_degraded(feature: str, reason: str) -> None:
+    """Sticky per-process degradation flag with a logged reason. Consulted
+    at trace time by AUTO routing; the first mark per feature logs once."""
+    with _LOCK:
+        if feature in _DEGRADED:
+            return
+        _DEGRADED[feature] = reason
+    _log(f"[resilience] '{feature}' degraded to XLA fallback: {reason}")
+
+
+def is_degraded(*features: str) -> bool:
+    """True when any named feature — or the global 'collectives' flag the
+    watchdog sets — has been marked degraded."""
+    with _LOCK:
+        return any(f in _DEGRADED for f in (*features, "collectives"))
+
+
+def any_degraded() -> bool:
+    with _LOCK:
+        return bool(_DEGRADED)
+
+
+def degraded_reasons() -> dict[str, str]:
+    with _LOCK:
+        return dict(_DEGRADED)
+
+
+def reset_degradation() -> None:
+    """Clear all sticky flags and recorded aborts (tests / operator reset)."""
+    with _LOCK:
+        _DEGRADED.clear()
+        _ABORTS.clear()
+        _NOTED.clear()
+
+
+def aborts() -> list[AbortInfo]:
+    with _LOCK:
+        return list(_ABORTS)
+
+
+def last_abort() -> AbortInfo | None:
+    with _LOCK:
+        return _ABORTS[-1] if _ABORTS else None
+
+
+def note_fallback_once(site: str, what: str) -> None:
+    """One-time-per-site log line for a degraded-mode route change."""
+    with _LOCK:
+        if site in _NOTED:
+            return
+        _NOTED.add(site)
+    _log(f"[resilience] {site}: {what} (degraded: {degraded_reasons()})")
+
+
+def _log(msg: str) -> None:
+    try:
+        from triton_dist_tpu.runtime.utils import dist_print
+
+        dist_print(msg)
+    except Exception:  # pragma: no cover - never let logging mask the event
+        print(msg)
+
+
+# ----------------------------------------------------------- abort surfacing
+
+
+def describe_status(words) -> str | None:
+    """Human-readable abort description for one rank's status words, or
+    None when the status is OK. Unit-testable host-side."""
+    w = np.asarray(words).reshape(-1)
+    if int(w[0]) != STATUS_ABORT:
+        return None
+    phase = phase_name(int(w[1]))
+    peer = int(w[2])
+    who = f"peer rank {peer}" if peer >= 0 else "an unattributable peer"
+    return (
+        f"stalled in phase '{phase}' waiting on {who} "
+        f"(bounded-wait abort after {int(w[3])} polls)"
+    )
+
+
+def record_status(words, *, feature: str, kernel: str) -> None:
+    """Host callback body: record an abort (degradation + AbortInfo) and
+    raise CollectiveAbortError naming the stalled phase and peer rank.
+    No-op on an OK status."""
+    desc = describe_status(words)
+    if desc is None:
+        return
+    w = np.asarray(words).reshape(-1)
+    reason = f"{feature} collective ({kernel}) {desc}"
+    info = AbortInfo(
+        feature=feature,
+        kernel=kernel,
+        phase=phase_name(int(w[1])),
+        peer=int(w[2]),
+        polls=int(w[3]),
+        reason=reason,
+    )
+    with _LOCK:
+        _ABORTS.append(info)
+    mark_degraded(feature, reason)
+    raise CollectiveAbortError(reason)
+
+
+def consume_status(status, *, feature: str, kernel: str) -> None:
+    """Attach the host-side abort check to a collective's status output.
+
+    Runs per device under shard_map via ``jax.debug.callback`` (kept by its
+    debug effect, so it cannot be DCE'd with the unused status value). An
+    aborted rank marks the feature degraded FIRST, then raises — the raise
+    surfaces through the runtime (typically as an ``XlaRuntimeError``
+    wrapping the :class:`CollectiveAbortError` message); callers that
+    swallow it can still consult :func:`last_abort` / :func:`is_degraded`.
+    """
+    import jax
+
+    def _cb(s):
+        record_status(s, feature=feature, kernel=kernel)
+
+    jax.debug.callback(_cb, status)
+
+
+# ------------------------------------------------------------------- watchdog
+
+
+class CollectiveWatchdog:
+    """Host-side wall-time bound on collective dispatch.
+
+    Runs ``fn`` on a worker thread and waits ``timeout_ms`` (growing by
+    ``backoff``× per retry, ``TDT_COLL_RETRIES`` extra attempts). A timed-out
+    attempt's thread cannot be cancelled — a wedged XLA rendezvous is not
+    interruptible — so it is abandoned (daemon) and the watchdog's job is to
+    unwedge the SERVING path: mark the feature degraded, then run the
+    caller's ``fallback`` (e.g. rebuild on the XLA backend) or raise
+    :class:`CollectiveTimeoutError`. ``timeout_ms=0`` disables the watchdog
+    (direct call), which is the default — opt in via ``TDT_COLL_TIMEOUT_MS``.
+    """
+
+    def __init__(
+        self,
+        timeout_ms: int | None = None,
+        retries: int | None = None,
+        backoff: float = 2.0,
+        feature: str = "collectives",
+        name: str = "collective",
+    ):
+        self.timeout_ms = (
+            get_int_env("TDT_COLL_TIMEOUT_MS", 0) if timeout_ms is None else timeout_ms
+        )
+        self.retries = (
+            get_int_env("TDT_COLL_RETRIES", 2) if retries is None else retries
+        )
+        self.backoff = backoff
+        self.feature = feature
+        self.name = name
+
+    def call(self, fn, *args, fallback=None, **kwargs):
+        if self.timeout_ms <= 0:
+            return fn(*args, **kwargs)
+        from triton_dist_tpu.runtime.utils import block_until_ready
+
+        timeout_s = self.timeout_ms / 1e3
+        for attempt in range(self.retries + 1):
+            result: list = [None]
+            err: list = [None]
+            done = threading.Event()
+
+            def _run():
+                try:
+                    # block_until_ready: async dispatch would "finish"
+                    # instantly and the device hang would escape the bound.
+                    result[0] = block_until_ready(fn(*args, **kwargs))
+                except BaseException as e:  # surfaced in the caller thread
+                    err[0] = e
+                finally:
+                    done.set()
+
+            t = threading.Thread(
+                target=_run, name=f"{self.name}-watchdog-{attempt}", daemon=True
+            )
+            t.start()
+            if done.wait(timeout_s):
+                if err[0] is not None:
+                    raise err[0]
+                return result[0]
+            _log(
+                f"[resilience] {self.name}: attempt {attempt + 1}/"
+                f"{self.retries + 1} exceeded {timeout_s * 1e3:.0f} ms"
+            )
+            timeout_s *= self.backoff
+
+        reason = (
+            f"{self.name} dispatch exceeded {self.timeout_ms} ms watchdog "
+            f"({self.retries + 1} attempts, backoff x{self.backoff})"
+        )
+        mark_degraded(self.feature, reason)
+        if fallback is not None:
+            return fallback(*args, **kwargs)
+        raise CollectiveTimeoutError(reason)

@@ -20,16 +20,44 @@ import numpy as np
 # ----------------------------------------------------------------- env flags
 
 
+_warned_env: set[str] = set()
+
+
+def _warn_env_once(name: str, value: str, default) -> None:
+    """One warning per var per process; a garbage flag must not crash a
+    serving job (nor spam every trace that reads it)."""
+    if name in _warned_env:
+        return
+    _warned_env.add(name)
+    msg = f"[env] ignoring unparseable {name}={value!r}; using default {default!r}"
+    try:
+        dist_print(msg)
+    except Exception:  # printing must never be the thing that fails
+        print(msg)
+
+
 def get_bool_env(name: str, default: bool = False) -> bool:
     v = os.environ.get(name)
     if v is None:
         return default
-    return v.lower() in ("1", "true", "yes", "on")
+    s = v.strip().lower()
+    if s in ("1", "true", "yes", "on"):
+        return True
+    if s in ("0", "false", "no", "off"):
+        return False
+    _warn_env_once(name, v, default)
+    return default
 
 
 def get_int_env(name: str, default: int = 0) -> int:
     v = os.environ.get(name)
-    return int(v) if v is not None else default
+    if v is None:
+        return default
+    try:
+        return int(v.strip())
+    except ValueError:
+        _warn_env_once(name, v, default)
+        return default
 
 
 # ------------------------------------------------------------------ printing

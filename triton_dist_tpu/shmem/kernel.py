@@ -11,18 +11,30 @@ and remote-DMA ops natively — so the wrapper's job reduces to launch hygiene:
 * mark communication kernels ``has_side_effects`` so XLA cannot DCE a launch
   whose only effect is a DMA (pitfall #6 in the Pallas guide);
 * allocate a process-unique ``collective_id`` per kernel *site* so barrier
-  semaphores of different kernels never alias.
+  semaphores of different kernels never alias;
+* thread the active ``runtime.resilience.FaultPlan`` (if any) around the
+  kernel body in interpret mode, so any distributed kernel can run under an
+  injected fault without opting in;
+* provide the bounded-wait helpers (:func:`bounded_wait`,
+  :func:`bounded_wait_recv`, :func:`bounded_barrier_all`) and the status
+  buffer protocol (:func:`status_out_shape` / :func:`init_status`) that
+  collective kernels adopt instead of raw unbounded semaphore waits.
 """
 
 from __future__ import annotations
 
 import functools
 import itertools
-from typing import Any
+from typing import Any, Sequence
 
+import jax
+import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+import triton_dist_tpu.language as tpl
+from triton_dist_tpu.runtime import resilience
 from triton_dist_tpu.runtime.platform import interpret_mode_default
 
 _collective_ids = itertools.count(0)
@@ -122,10 +134,196 @@ def dist_pallas_call(
         )
     if interpret is None:
         interpret = interpret_mode_default(detect_races=detect_races)
+    # Fault injection is a simulation feature: apply the active FaultPlan
+    # only in interpret mode, and only after the collective id was derived
+    # from the ORIGINAL kernel above (a wrapper has no stable key and would
+    # burn a fresh id slot on every trace).
+    plan = resilience.active_plan()
+    if plan is not None and interpret:
+        kernel = resilience.apply_fault_plan(kernel, plan)
     return pl.pallas_call(
         kernel,
         out_shape=out_shape,
         compiler_params=compiler_params,
         interpret=interpret,
         **kwargs,
+    )
+
+
+# --------------------------------------------------- status buffer protocol
+#
+# Every adopted collective kernel appends one small SMEM int32 output (LAST
+# in its out_shape tuple) holding [0]=code (STATUS_OK/STATUS_ABORT),
+# [1]=phase id (resilience.phase_name), [2]=peer rank along the collective
+# axis (-1 when unattributable, e.g. a barrier), [3]=polls spent. Bounded
+# waits write an abort record instead of spinning forever; the host surfaces
+# it via resilience.consume_status. SMEM outputs start uninitialized — call
+# init_status() first thing in the kernel (once per launch under a grid).
+
+#: Number of int32 words in a collective status buffer.
+STATUS_WORDS = 4
+STATUS_OK = resilience.STATUS_OK
+STATUS_ABORT = resilience.STATUS_ABORT
+
+
+def status_out_shape() -> jax.ShapeDtypeStruct:
+    """ShapeDtypeStruct for a collective's status output."""
+    return jax.ShapeDtypeStruct((STATUS_WORDS,), jnp.int32)
+
+
+def status_out_spec() -> pl.BlockSpec:
+    """BlockSpec placing the status output in SMEM (scalar words)."""
+    return pl.BlockSpec(memory_space=pltpu.SMEM)
+
+
+def init_status(status_ref, *, axis: str | Sequence[str] = "tp") -> None:
+    """Initialize a status buffer to OK inside the kernel body.
+
+    Also the CORRUPT_FLAG injection point: when a FaultPlan of that kind is
+    active (trace time), the victim rank's buffer is initialized already
+    aborted, so its bounded waits short-circuit and the poisoned flag must
+    surface host-side. ``axis`` is the collective's axis (used to identify
+    the victim rank).
+    """
+    status_ref[0] = jnp.int32(STATUS_OK)
+    status_ref[1] = jnp.int32(-1)
+    status_ref[2] = jnp.int32(-1)
+    status_ref[3] = jnp.int32(0)
+    plan = resilience.active_plan()
+    if plan is not None and plan.kind is resilience.FaultKind.CORRUPT_FLAG:
+        me = tpl.rank(axis)
+
+        @pl.when(me == jnp.int32(plan.rank))
+        def _():
+            status_ref[0] = jnp.int32(STATUS_ABORT)
+            status_ref[1] = jnp.int32(resilience.phase_id("injected_corrupt"))
+
+
+def _bounded_poll(read_done, consume, status_ref, *, phase, peer, bound) -> None:
+    """Shared core: poll ``read_done()`` up to ``bound`` times, then either
+    ``consume()`` the semaphore for real (blocking wait with acquire
+    semantics) or write an abort record. A buffer already aborted (earlier
+    phase, or injected corruption) skips polling entirely and never
+    consumes — cascading the abort forward is intended; post-abort
+    semaphore state is undefined and the sticky XLA fallback never reuses
+    the kernel."""
+    pid = resilience.phase_id(phase)
+    pre_ok = status_ref[0] == jnp.int32(STATUS_OK)
+    eff_bound = jnp.where(pre_ok, jnp.int32(bound), jnp.int32(0))
+
+    def cond(carry):
+        it, done = carry
+        return jnp.logical_and(it < eff_bound, jnp.logical_not(done))
+
+    def body(carry):
+        it, _ = carry
+        return it + 1, read_done()
+
+    polls, done = jax.lax.while_loop(cond, body, (jnp.int32(0), jnp.bool_(False)))
+
+    @pl.when(jnp.logical_and(pre_ok, done))
+    def _():
+        consume()
+
+    peer_val = jnp.int32(-1) if peer is None else jnp.asarray(peer, dtype=jnp.int32)
+
+    @pl.when(jnp.logical_and(pre_ok, jnp.logical_not(done)))
+    def _():
+        status_ref[0] = jnp.int32(STATUS_ABORT)
+        status_ref[1] = jnp.int32(pid)
+        status_ref[2] = peer_val
+        status_ref[3] = polls
+
+
+def bounded_wait(
+    sem,
+    status_ref,
+    *,
+    value: int | jax.Array = 1,
+    phase: str,
+    peer=None,
+    bound: int | None = None,
+) -> None:
+    """Iteration-capped ``tpl.wait``: poll the semaphore up to ``bound``
+    times; on success consume ``value`` via the real blocking wait, on
+    timeout record an abort (phase + peer) in ``status_ref`` instead of
+    spinning forever. ``bound`` resolves through ``resilience.wait_bound``
+    (explicit > FaultPlan override > ``TDT_WAIT_BOUND_ITERS`` > platform
+    default); a resolved bound of 0 emits the plain unbounded wait."""
+    bound = resilience.wait_bound(bound)
+    if bound == 0:
+        tpl.wait(sem, value)
+        return
+    target = jnp.asarray(value, dtype=jnp.int32)
+    _bounded_poll(
+        lambda: pltpu.semaphore_read(sem) >= target,
+        lambda: pltpu.semaphore_wait(sem, value),
+        status_ref,
+        phase=phase,
+        peer=peer,
+        bound=bound,
+    )
+
+
+def bounded_wait_recv(
+    recv_sem,
+    ref,
+    status_ref,
+    *,
+    phase: str,
+    peer=None,
+    bound: int | None = None,
+) -> None:
+    """Iteration-capped ``tpl.wait_recv``: DMA semaphores count BYTES, so
+    poll for ``ref``'s byte size before consuming via the blocking DMA
+    wait. Same bound resolution and abort protocol as :func:`bounded_wait`.
+    """
+    bound = resilience.wait_bound(bound)
+    if bound == 0:
+        tpl.wait_recv(recv_sem, ref)
+        return
+    nbytes = int(np.prod(ref.shape)) * np.dtype(ref.dtype).itemsize
+    _bounded_poll(
+        lambda: pltpu.semaphore_read(recv_sem) >= jnp.int32(nbytes),
+        lambda: pltpu.make_async_copy(ref, ref, recv_sem).wait(),
+        status_ref,
+        phase=phase,
+        peer=peer,
+        bound=bound,
+    )
+
+
+def bounded_barrier_all(
+    status_ref,
+    axis: str | Sequence[str] = "tp",
+    mesh_axes: Sequence[str] | None = None,
+    *,
+    phase: str = "barrier",
+    bound: int | None = None,
+) -> None:
+    """Iteration-capped ``tpl.barrier_all``. An already-aborted rank skips
+    both the signal and the wait half (its peers' bounded barrier waits
+    then time out too — the cascade is how an abort propagates without any
+    extra control channel). Barrier arrivals carry no sender identity, so
+    a barrier abort always reports peer -1."""
+    bound = resilience.wait_bound(bound)
+    if bound == 0:
+        tpl.barrier_all(axis, mesh_axes)
+        return
+    axes = (axis,) if isinstance(axis, str) else tuple(axis)
+    barrier_sem = pltpu.get_barrier_semaphore()
+    world = tpl.num_ranks(axes)
+    pre_ok = status_ref[0] == jnp.int32(STATUS_OK)
+
+    @pl.when(pre_ok)
+    def _():
+        tpl.barrier_signal_all(axes, mesh_axes)
+
+    _bounded_poll(
+        lambda: pltpu.semaphore_read(barrier_sem) >= jnp.int32(world),
+        lambda: pltpu.semaphore_wait(barrier_sem, world),
+        status_ref,
+        phase=phase,
+        peer=None,
+        bound=bound,
     )

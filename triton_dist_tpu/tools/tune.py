@@ -8,6 +8,7 @@ redesign (offline tuning, cache consulted at trace time).
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import json
 import os
@@ -62,8 +63,16 @@ class TuneCache:
         if self.path.exists():
             try:
                 raw = json.loads(self.path.read_text())
-            except (json.JSONDecodeError, OSError):
+            except (json.JSONDecodeError, OSError) as e:
+                # A truncated/garbled file (e.g. a crash mid-write before the
+                # save path went atomic) means a cold cache, not a dead job.
                 raw = None
+                from triton_dist_tpu.runtime.utils import dist_print
+
+                dist_print(
+                    f"[tune] ignoring corrupt cache {self.path}: "
+                    f"{type(e).__name__}: {e}"
+                )
             if isinstance(raw, dict):
                 schema = raw.pop(_SCHEMA_KEY, None)
                 if isinstance(schema, dict) and schema.get("version") == SCHEMA_VERSION:
@@ -80,9 +89,28 @@ class TuneCache:
         self._data[key] = value
 
     def save(self) -> None:
+        """Atomic write (tempfile + ``os.replace`` in the target dir): a
+        reader — or a crash mid-save — never observes a half-written file.
+        The cache steers collective routing, so a torn file is a cross-rank
+        hazard, not just a perf bug."""
         self.path.parent.mkdir(parents=True, exist_ok=True)
         payload = {_SCHEMA_KEY: {"version": SCHEMA_VERSION}, **self._data}
-        self.path.write_text(json.dumps(payload, indent=1, sort_keys=True))
+        text = json.dumps(payload, indent=1, sort_keys=True)
+        import tempfile
+
+        fd, tmp = tempfile.mkstemp(
+            dir=self.path.parent, prefix=self.path.name, suffix=".tmp"
+        )
+        try:
+            with os.fdopen(fd, "w") as f:
+                f.write(text)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path)
+        except BaseException:
+            with contextlib.suppress(OSError):
+                os.unlink(tmp)
+            raise
 
 
 _default_cache: TuneCache | None = None

@@ -837,6 +837,18 @@ def main():
         if error:
             ex["error"] = error
             ex["phase"] = state["phase"]
+        # Attach the telemetry snapshot (collective launch counts, abort /
+        # fallback counters, decode-latency histogram summaries) to every
+        # BENCH line — the driver's salvage parse gets observability for
+        # free. Never let telemetry break the bench's one contract (a final
+        # well-formed JSON line).
+        try:
+            from triton_dist_tpu.runtime import telemetry
+
+            if telemetry.enabled():
+                ex["telemetry"] = telemetry.summary()
+        except Exception:  # noqa: BLE001
+            pass
         line = json.dumps({**primary, "extra": ex})
         if locked:
             with emit_lock:

@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""Lint: telemetry metric names follow ``tdt_<subsystem>_<name>``.
+
+The registry in ``triton_dist_tpu.runtime.telemetry`` keys metrics by bare
+string — nothing structural stops a call site from minting
+``my_cool_counter`` or, worse, interpolating a shape into the metric NAME
+(unbounded cardinality, the classic Prometheus foot-gun). This lint makes
+the convention (see ``docs/observability.md``) machine-enforced:
+
+* the first argument of ``telemetry.inc`` / ``observe`` / ``set_gauge`` /
+  ``counter_value`` must be a **string literal** — dynamic metric names are
+  rejected outright (dynamic dimensions belong in label VALUES);
+* the literal must match ``tdt_<subsystem>_<name>`` — lowercase
+  ``[a-z0-9_]``, at least three underscore-separated segments, ``tdt_``
+  prefix;
+* ``telemetry.emit`` kinds must be literal snake-case strings (the event
+  ring is grep'd by kind; a dynamic kind is un-greppable).
+
+Escape hatch: a trailing ``# metric-name-ok: <reason>`` comment on the
+offending line — for a call site that genuinely needs to forward a
+caller-supplied name (none exist today; keep it that way).
+
+Usage: ``python scripts/check_metric_names.py [paths...]`` (default:
+``triton_dist_tpu/`` and ``bench.py``). Exit 1 with ``file:line``
+diagnostics on violations. Scans by AST, so aliased imports
+(``from ... import telemetry as t``) are caught too, as long as the module
+is bound to a name containing ``telemetry``.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+DEFAULT_ROOTS = (REPO / "triton_dist_tpu", REPO / "bench.py")
+
+WAIVER = "# metric-name-ok:"
+
+#: Registry entry points whose first argument is a METRIC name.
+METRIC_FNS = {"inc", "observe", "set_gauge", "counter_value"}
+#: Entry point whose first argument is an event KIND.
+EVENT_FNS = {"emit", "events"}
+
+METRIC_NAME = re.compile(r"^tdt_[a-z0-9]+_[a-z0-9_]+$")
+EVENT_KIND = re.compile(r"^[a-z][a-z0-9_]*$")
+
+
+def _is_telemetry_call(node: ast.Call) -> str | None:
+    """Return the called function name when this is ``telemetry.<fn>(...)``
+    (or an alias whose receiver name contains 'telemetry'), else None."""
+    fn = node.func
+    if not isinstance(fn, ast.Attribute):
+        return None
+    recv = fn.value
+    if isinstance(recv, ast.Name) and "telemetry" in recv.id:
+        return fn.attr
+    # runtime.telemetry.inc(...) style: Attribute receiver named telemetry.
+    if isinstance(recv, ast.Attribute) and recv.attr == "telemetry":
+        return fn.attr
+    return None
+
+
+def check_file(path: pathlib.Path) -> list[str]:
+    src = path.read_text()
+    try:
+        tree = ast.parse(src, filename=str(path))
+    except SyntaxError as e:  # a broken file is some other tool's problem
+        return [f"{path}:{e.lineno}: syntax error while linting: {e.msg}"]
+    lines = src.splitlines()
+    try:
+        rel = path.relative_to(REPO)
+    except ValueError:
+        rel = path
+
+    errors = []
+
+    def err(node: ast.AST, msg: str) -> None:
+        line = lines[node.lineno - 1] if node.lineno - 1 < len(lines) else ""
+        if WAIVER in line:
+            return
+        errors.append(f"{rel}:{node.lineno}: {msg}\n    {line.strip()}")
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = _is_telemetry_call(node)
+        if fname is None or not node.args:
+            continue
+        first = node.args[0]
+        if fname in METRIC_FNS:
+            if not (isinstance(first, ast.Constant) and isinstance(first.value, str)):
+                err(node, "dynamic metric name — metric names must be string "
+                          "literals (put dynamic dimensions in label values)")
+            elif not METRIC_NAME.match(first.value):
+                err(node, f"metric name {first.value!r} does not match "
+                          "tdt_<subsystem>_<name> (lowercase, >=3 segments)")
+        elif fname in EVENT_FNS:
+            if isinstance(first, ast.Constant) and first.value is None:
+                continue  # events(kind=None) positional form
+            if not (isinstance(first, ast.Constant) and isinstance(first.value, str)):
+                err(node, "dynamic event kind — emit/filter kinds must be "
+                          "string literals")
+            elif not EVENT_KIND.match(first.value):
+                err(node, f"event kind {first.value!r} is not snake_case")
+    return errors
+
+
+def main(argv: list[str]) -> int:
+    roots = [pathlib.Path(a) for a in argv] or list(DEFAULT_ROOTS)
+    files: list[pathlib.Path] = []
+    for root in roots:
+        if root.is_dir():
+            files.extend(sorted(root.rglob("*.py")))
+        else:
+            files.append(root)
+
+    errors = []
+    for f in files:
+        errors.extend(check_file(f))
+
+    if errors:
+        print(f"check_metric_names: {len(errors)} violation(s)")
+        for e in errors:
+            print(e)
+        return 1
+    print(f"check_metric_names: OK ({len(files)} file(s) scanned)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,151 @@
+#!/usr/bin/env python
+"""Render triton_dist_tpu telemetry snapshots.
+
+There is no in-process scrape endpoint (serving runs are batch jobs, not
+daemons): a process dumps its registry to JSON — either explicitly via
+``telemetry.dump(path)`` or automatically at exit with
+``TDT_TELEMETRY_DUMP=/path/snap.json`` — and this CLI renders the file.
+
+Usage::
+
+    python scripts/tdt_metrics.py show snap.json    # human-readable summary
+    python scripts/tdt_metrics.py prom snap.json    # Prometheus exposition
+    python scripts/tdt_metrics.py demo [out.json]   # tiny CPU serve -> live
+                                                    # snapshot (smoke check)
+
+See ``docs/observability.md`` for the metric naming convention and the full
+set of env flags.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _load(path: str) -> dict:
+    with open(path) as f:
+        return json.load(f)
+
+
+def _fmt_labels(labels: dict) -> str:
+    if not labels:
+        return ""
+    return "{" + ",".join(f"{k}={v}" for k, v in labels.items()) + "}"
+
+
+def cmd_show(path: str) -> int:
+    snap = _load(path)
+    print(f"telemetry snapshot: {path} (enabled={snap.get('enabled')})")
+    counters = snap.get("counters", {})
+    if counters:
+        print("\ncounters:")
+        for name, entries in counters.items():
+            for e in entries:
+                print(f"  {name}{_fmt_labels(e['labels'])} = {e['value']:g}")
+    gauges = snap.get("gauges", {})
+    if gauges:
+        print("\ngauges:")
+        for name, entries in gauges.items():
+            for e in entries:
+                print(f"  {name}{_fmt_labels(e['labels'])} = {e['value']:g}")
+    hists = snap.get("histograms", {})
+    if hists:
+        print("\nhistograms:")
+        for name, entries in hists.items():
+            for e in entries:
+                n = e["count"]
+                mean = e["sum"] / n if n else 0.0
+                # p50/p95 from the cumulative buckets (upper-bound estimate).
+                quantiles = {}
+                for bound, cum in e["buckets"]:
+                    for q in (0.5, 0.95):
+                        if q not in quantiles and n and cum >= q * n:
+                            quantiles[q] = bound
+                q50 = quantiles.get(0.5, "+Inf")
+                q95 = quantiles.get(0.95, "+Inf")
+                print(
+                    f"  {name}{_fmt_labels(e['labels'])}: count={n} "
+                    f"mean={mean:.6g}s p50<={q50} p95<={q95}"
+                )
+    evs = snap.get("events", [])
+    if evs:
+        print(f"\nevents ({len(evs)} in ring, newest last):")
+        for e in evs[-20:]:
+            kind = e.get("kind", "?")
+            rest = {k: v for k, v in e.items() if k not in ("kind", "seq")}
+            print(f"  [{e.get('seq', '?')}] {kind}: {rest}")
+    traces = snap.get("kernel_traces", [])
+    if traces:
+        print(f"\nkernel traces: {len(traces)} rank-buffers collected")
+        for t in traces:
+            print(
+                f"  {t['kernel']} rank={t['rank']}: "
+                f"{len(t.get('events', []))} events, "
+                f"{t.get('n_dropped', 0)} dropped"
+            )
+    return 0
+
+
+def cmd_prom(path: str) -> int:
+    from triton_dist_tpu.runtime import telemetry
+
+    sys.stdout.write(telemetry.to_prometheus(_load(path)))
+    return 0
+
+
+def cmd_demo(out: str | None) -> int:
+    """Serve a few tokens from the tiny test model on the 8-device CPU mesh
+    and show the live registry — the zero-to-snapshot smoke path."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from triton_dist_tpu.runtime import telemetry
+    from triton_dist_tpu.runtime.platform import (
+        use_cpu_devices,
+        cpu_mesh,
+        tpu_interpret_available,
+    )
+    from triton_dist_tpu.runtime.mesh import initialize_distributed
+
+    use_cpu_devices(8)
+    if not tpu_interpret_available():
+        # Old jax: no TPU interpret classes — let the demo's single-device
+        # kernels (flash-attn) run under the generic HLO interpreter.
+        os.environ.setdefault("TDT_INTERPRET_FALLBACK", "1")
+    import jax
+    import jax.numpy as jnp
+
+    from triton_dist_tpu.models import PRESETS, DenseLLM, Engine
+
+    m = cpu_mesh((4,), ("tp",))
+    ctx = initialize_distributed(
+        devices=list(m.devices.flat), axis_names=("tp",), set_default=False
+    )
+    model = DenseLLM(PRESETS["test-dense"], ctx, key=jax.random.PRNGKey(0))
+    eng = Engine(model, backend="xla", max_len=32)
+    ids = jnp.zeros((1, 8), jnp.int32)
+    jax.block_until_ready(eng.serve(ids, gen_len=4))
+
+    if out:
+        print(f"wrote {telemetry.dump(out)}")
+        return cmd_show(out)
+    sys.stdout.write(telemetry.to_prometheus())
+    return 0
+
+
+def main(argv: list[str]) -> int:
+    if len(argv) >= 2 and argv[0] == "show":
+        return cmd_show(argv[1])
+    if len(argv) >= 2 and argv[0] == "prom":
+        return cmd_prom(argv[1])
+    if argv and argv[0] == "demo":
+        return cmd_demo(argv[1] if len(argv) > 1 else None)
+    print(__doc__, file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

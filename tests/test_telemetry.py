@@ -1,0 +1,375 @@
+"""Telemetry tests: registry semantics, no-op path, serve-path histograms,
+chaos abort counters, the kernel-trace round trip, and the metric-name lint.
+
+The registry is process-global (like the degradation registry), so every
+test starts and ends from a clean reset; the kernel-trace test additionally
+clears jit caches because ``TDT_KERNEL_TRACE`` is a trace-time flag that
+does not participate in jit cache keys (the FaultPlan rule).
+"""
+
+import json
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from triton_dist_tpu.runtime import resilience, telemetry
+from triton_dist_tpu.runtime.platform import tpu_interpret_available
+
+LINT = "scripts/check_metric_names.py"
+
+# Collective kernels need the TPU interpret machinery (semaphore + remote-DMA
+# simulation); on jax builds without it they cannot run on CPU at all.
+needs_tpu_interpret = pytest.mark.skipif(
+    not tpu_interpret_available(),
+    reason="jax build lacks pltpu (TPU)InterpretParams — no collective simulation",
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    telemetry.reset()
+    resilience.reset_degradation()
+    yield
+    telemetry.reset()
+    resilience.reset_degradation()
+
+
+def shard(ctx, fn, in_specs, out_specs):
+    return jax.jit(
+        jax.shard_map(fn, mesh=ctx.mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_vma=False)
+    )
+
+
+# ------------------------------------------------------------------ registry
+
+
+def test_counter_labels_are_distinct_series():
+    telemetry.inc("tdt_test_ops_total", backend="xla")
+    telemetry.inc("tdt_test_ops_total", backend="xla")
+    telemetry.inc("tdt_test_ops_total", backend="dist")
+    assert telemetry.counter_value("tdt_test_ops_total", backend="xla") == 2.0
+    assert telemetry.counter_value("tdt_test_ops_total", backend="dist") == 1.0
+    # Label ORDER does not matter, label VALUES are str-coerced.
+    telemetry.inc("tdt_test_pairs_total", a=1, b="x")
+    assert telemetry.counter_value("tdt_test_pairs_total", b="x", a="1") == 1.0
+
+
+def test_histogram_bucketing_and_snapshot():
+    telemetry.observe("tdt_test_lat_seconds", 0.001)
+    telemetry.observe("tdt_test_lat_seconds", 0.5)
+    telemetry.observe("tdt_test_lat_seconds", 1e9)  # lands in +Inf
+    snap = telemetry.snapshot()
+    (entry,) = snap["histograms"]["tdt_test_lat_seconds"]
+    assert entry["count"] == 3
+    assert entry["sum"] == pytest.approx(0.501 + 1e9)
+    buckets = entry["buckets"]
+    # Cumulative: monotone nondecreasing, +Inf last covers everything.
+    cums = [c for _, c in buckets]
+    assert cums == sorted(cums)
+    assert buckets[-1][0] == "+Inf" and buckets[-1][1] == 3
+    # 0.001 <= 2^-9; the finite buckets hold exactly two observations.
+    finite_total = buckets[-2][1]
+    assert finite_total == 2
+
+
+def test_event_ring_bounded_and_filtered(monkeypatch):
+    monkeypatch.setenv("TDT_EVENT_RING", "4")
+    telemetry.reset()
+    for i in range(10):
+        telemetry.emit("tick", i=i)
+    telemetry.emit("other", note="x")
+    evs = telemetry.events()
+    assert len(evs) == 4  # bounded ring
+    assert telemetry.events(kind="other")[0]["note"] == "x"
+    # seq keeps counting across evictions; fields are JSON-primitive.
+    assert evs[-1]["seq"] == 11
+    telemetry.emit("coerced", obj=object())
+    assert isinstance(telemetry.events(kind="coerced")[0]["obj"], str)
+
+
+def test_disabled_is_noop():
+    telemetry.reset(enabled_override=False)
+    assert not telemetry.enabled()
+    telemetry.inc("tdt_test_ops_total")
+    telemetry.observe("tdt_test_lat_seconds", 1.0)
+    telemetry.set_gauge("tdt_test_level", 3.0)
+    telemetry.emit("tick")
+    assert telemetry.counter_value("tdt_test_ops_total") == 0.0
+    snap = telemetry.snapshot()
+    assert snap["counters"] == {} and snap["histograms"] == {}
+    assert snap["gauges"] == {} and snap["events"] == []
+    assert telemetry.summary()["counters"] == {}
+
+
+def test_env_flag_disables(monkeypatch):
+    monkeypatch.setenv("TDT_TELEMETRY", "0")
+    telemetry.reset()
+    assert not telemetry.enabled()
+    assert not telemetry.kernel_trace_enabled()  # master gate wins
+    # Instrumented call sites (engine serve path gates its fences on this)
+    # execute the early-return path.
+    telemetry.inc("tdt_engine_serve_total", backend="xla")
+    assert telemetry.snapshot()["counters"] == {}
+
+
+def test_prometheus_exposition():
+    telemetry.inc("tdt_test_ops_total", backend="xla")
+    telemetry.set_gauge("tdt_test_level", 2.5)
+    telemetry.observe("tdt_test_lat_seconds", 0.25)
+    text = telemetry.to_prometheus()
+    assert "# TYPE tdt_test_ops_total counter" in text
+    assert 'tdt_test_ops_total{backend="xla"} 1' in text
+    assert "# TYPE tdt_test_level gauge" in text
+    assert "# TYPE tdt_test_lat_seconds histogram" in text
+    assert 'tdt_test_lat_seconds_bucket{le="0.25"} 1' in text
+    assert 'tdt_test_lat_seconds_bucket{le="+Inf"} 1' in text
+    assert "tdt_test_lat_seconds_sum 0.25" in text
+    assert "tdt_test_lat_seconds_count 1" in text
+    # The exporter renders foreign (dumped) snapshots too — the CLI path.
+    again = telemetry.to_prometheus(json.loads(json.dumps(telemetry.snapshot())))
+    assert again == text
+
+
+def test_dump_and_cli_show(tmp_path):
+    telemetry.inc("tdt_test_ops_total", backend="xla")
+    telemetry.observe("tdt_test_lat_seconds", 0.01)
+    telemetry.emit("tick", i=1)
+    path = telemetry.dump(str(tmp_path / "snap.json"))
+    r = subprocess.run(
+        [sys.executable, "scripts/tdt_metrics.py", "show", path],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "tdt_test_ops_total{backend=xla} = 1" in r.stdout
+    assert "tdt_test_lat_seconds" in r.stdout and "tick" in r.stdout
+
+
+# ------------------------------------------------------------ wired-in sites
+
+
+def test_auto_routing_counters():
+    from triton_dist_tpu.kernels.allreduce import get_auto_all_reduce_method
+
+    m = get_auto_all_reduce_method(1024, 4)
+    assert telemetry.counter_value(
+        "tdt_kernels_auto_route_total", collective="allreduce", method=m.value
+    ) == 1.0
+
+
+def test_degradation_and_fallback_counters():
+    resilience.mark_degraded("gemm_ar", "test reason")
+    assert telemetry.counter_value(
+        "tdt_resilience_degradations_total", feature="gemm_ar"
+    ) == 1.0
+    assert telemetry.events(kind="degraded")[0]["feature"] == "gemm_ar"
+    # note_fallback_once dedups the LOG line but counts every occurrence —
+    # fallback traffic volume is the operational signal.
+    resilience.note_fallback_once("site.a", "why")
+    resilience.note_fallback_once("site.a", "why")
+    assert telemetry.counter_value(
+        "tdt_resilience_fallbacks_total", site="site.a"
+    ) == 2.0
+    assert len(telemetry.events(kind="fallback")) == 1
+
+
+def test_record_status_abort_counter():
+    words = [resilience.STATUS_ABORT, resilience.phase_id("ag_recv"), 3, 123]
+    with pytest.raises(Exception):
+        resilience.record_status(words, feature="allgather", kernel="_ring_ag_kernel")
+    assert telemetry.counter_value(
+        "tdt_resilience_aborts_total", feature="allgather", phase="ag_recv", peer=3
+    ) == 1.0
+    ev = telemetry.events(kind="collective_abort")[0]
+    assert ev["phase"] == "ag_recv" and ev["peer"] == 3
+
+
+@pytest.fixture(scope="module")
+def dense_model(request):
+    import tests.conftest  # ensure CPU devices
+
+    from triton_dist_tpu.models import DenseLLM, PRESETS
+    from triton_dist_tpu.runtime.mesh import initialize_distributed
+    from triton_dist_tpu.runtime.platform import cpu_mesh
+
+    m = cpu_mesh((4,), ("tp",))
+    ctx = initialize_distributed(
+        devices=list(m.devices.flat), axis_names=("tp",), set_default=False
+    )
+    cfg = PRESETS["test-dense"]
+    return DenseLLM(cfg, ctx, key=jax.random.PRNGKey(1))
+
+
+@pytest.fixture
+def single_device_kernels(monkeypatch):
+    """On jax builds without the TPU interpret classes, single-device Pallas
+    kernels (the xla serve path's flash-attn) can still run under the generic
+    HLO interpreter. Trace-time flag: clear caches around the flip."""
+    if not tpu_interpret_available():
+        monkeypatch.setenv("TDT_INTERPRET_FALLBACK", "1")
+        jax.clear_caches()
+    yield
+    if not tpu_interpret_available():
+        jax.clear_caches()
+
+
+def test_serve_latency_histograms(dense_model, single_device_kernels):
+    from triton_dist_tpu.models import Engine
+
+    eng = Engine(dense_model, backend="xla", max_len=32)
+    assert telemetry.counter_value("tdt_engine_rebuilds_total", backend="xla") == 1.0
+    ids = jnp.asarray([[3, 17, 42, 7, 99, 5, 23, 11]], jnp.int32)
+    out = eng.serve(ids, gen_len=6)
+    assert out.shape == (1, 6)
+    assert telemetry.counter_value("tdt_engine_serve_total", backend="xla") == 1.0
+    snap = telemetry.snapshot()
+    for name in ("tdt_engine_ttft_seconds", "tdt_engine_decode_token_seconds"):
+        (entry,) = snap["histograms"][name]
+        assert entry["labels"] == {"backend": "xla"}
+        assert entry["count"] >= 1 and entry["sum"] > 0.0
+    # The summary digest (what bench.py attaches) carries the same series.
+    s = telemetry.summary()
+    assert s["histograms"]['tdt_engine_ttft_seconds{backend="xla"}']["count"] >= 1
+
+
+# ============================================================= chaos (device)
+
+CHAOS_BOUND = 2_000
+VICTIM = 1
+W4 = 4
+
+
+@pytest.mark.chaos
+@needs_tpu_interpret
+def test_chaos_abort_counter_labeled(ctx4, rng):
+    """The acceptance scenario: after a dropped-peer abort, the snapshot
+    shows ``tdt_resilience_aborts_total`` labeled with the stalled phase and
+    observed peer."""
+    from triton_dist_tpu.kernels import AllGatherMethod, all_gather_shard
+
+    f = shard(
+        ctx4,
+        lambda xs: all_gather_shard(xs, axis="tp", method=AllGatherMethod.RING_1D)
+        .reshape(-1, xs.shape[-1]),
+        (P("tp"),),
+        P(),
+    )
+    x = jnp.asarray(rng.standard_normal((W4 * 8, 64)), jnp.float32)
+    with resilience.fault_plan("drop_peer", rank=VICTIM, wait_bound=CHAOS_BOUND):
+        with pytest.raises(Exception):
+            jax.block_until_ready(f(x))
+    ab = resilience.last_abort()
+    assert ab is not None
+    assert telemetry.counter_value(
+        "tdt_resilience_aborts_total",
+        feature=ab.feature, phase=ab.phase, peer=ab.peer,
+    ) >= 1.0
+    entries = telemetry.snapshot()["counters"]["tdt_resilience_aborts_total"]
+    assert any(e["labels"]["phase"] == ab.phase for e in entries)
+    jax.clear_caches()  # a degraded trace must not leak into later tests
+
+
+# ------------------------------------------------------- kernel trace (device)
+
+
+@pytest.fixture
+def kernel_trace_env(monkeypatch):
+    """TDT_KERNEL_TRACE is a trace-time flag outside the jit cache key:
+    clear caches around the flip so both this test and its successors
+    compile with the setting they expect."""
+    monkeypatch.setenv("TDT_KERNEL_TRACE", "1")
+    jax.clear_caches()
+    yield
+    jax.clear_caches()
+
+
+@needs_tpu_interpret
+def test_kernel_trace_roundtrip_allgather(ctx4, rng, kernel_trace_env, tmp_path):
+    from triton_dist_tpu.kernels import AllGatherMethod, all_gather_shard
+    from triton_dist_tpu.tools import profiler
+
+    assert telemetry.kernel_trace_enabled()
+    f = shard(
+        ctx4,
+        lambda xs: all_gather_shard(xs, axis="tp", method=AllGatherMethod.RING_1D)
+        .reshape(-1, xs.shape[-1]),
+        (P("tp"),),
+        P(),
+    )
+    x = jnp.asarray(rng.standard_normal((W4 * 8, 64)), jnp.float32)
+    out = jax.block_until_ready(f(x))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x), rtol=0, atol=0)
+
+    recs = telemetry.kernel_traces(kernel="_ring_ag_kernel")
+    assert {r["rank"] for r in recs} == set(range(W4))  # one buffer per rank
+    for r in recs:
+        assert r["n_dropped"] == 0
+        tags = [e["tag"] for e in r["events"]]
+        # Entry barrier in/out, then per ring step: send, wait, recv.
+        assert tags.count(profiler.TAG_BARRIER) >= 2
+        assert tags.count(profiler.TAG_SEND) == W4 - 1
+        assert tags.count(profiler.TAG_WAIT) == W4 - 1
+        assert tags.count(profiler.TAG_RECV) == W4 - 1
+        # Ordering, not wall time: each wait is satisfied before the next.
+        seqs = [e["seq"] for e in r["events"]]
+        assert seqs == sorted(seqs)
+
+    ct = profiler.decode_to_chrome(recs)
+    path = ct.save(str(tmp_path / "ktrace.json"))
+    data = json.load(open(path))
+    assert len(data["traceEvents"]) == sum(len(r["events"]) for r in recs)
+    pids = {e["pid"] for e in data["traceEvents"]}
+    assert pids == set(range(W4))  # one chrome row per rank
+
+
+@needs_tpu_interpret
+def test_kernel_trace_off_means_no_buffers(ctx4, rng):
+    """Flag unset: maybe_kernel_trace returns None and kernels keep their
+    exact pre-trace signature — nothing is collected."""
+    from triton_dist_tpu.kernels import AllGatherMethod, all_gather_shard
+
+    assert telemetry.maybe_kernel_trace() is None
+    f = shard(
+        ctx4,
+        lambda xs: all_gather_shard(xs, axis="tp", method=AllGatherMethod.FULL_MESH_PUSH)
+        .reshape(-1, xs.shape[-1]),
+        (P("tp"),),
+        P(),
+    )
+    x = jnp.asarray(rng.standard_normal((W4 * 8, 32)), jnp.float32)
+    jax.block_until_ready(f(x))
+    assert telemetry.kernel_traces() == []
+
+
+# ------------------------------------------------------------------ name lint
+
+
+def test_metric_name_lint_repo_is_clean():
+    r = subprocess.run([sys.executable, LINT], capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_metric_name_lint_flags_violations(tmp_path):
+    bad = tmp_path / "bad_site.py"
+    bad.write_text(
+        "from triton_dist_tpu.runtime import telemetry\n"
+        "def f(name, shape):\n"
+        "    telemetry.inc(name)\n"  # dynamic metric name
+        "    telemetry.inc(f'tdt_x_{shape}_total')\n"  # interpolated name
+        "    telemetry.inc('my_counter')\n"  # missing tdt_ prefix
+        "    telemetry.inc('tdt_ops')\n"  # too few segments
+        "    telemetry.emit('Bad-Kind')\n"  # not snake_case
+        "    telemetry.inc('tdt_good_ops_total', shape=shape)\n"  # OK: label
+        "    telemetry.inc(name)  # metric-name-ok: test waiver\n"
+    )
+    r = subprocess.run([sys.executable, LINT, str(bad)], capture_output=True, text=True)
+    assert r.returncode == 1
+    for line in (3, 4, 5, 6, 7):
+        assert f"bad_site.py:{line}" in r.stdout, r.stdout
+    for line in (8, 9):
+        assert f"bad_site.py:{line}" not in r.stdout, r.stdout

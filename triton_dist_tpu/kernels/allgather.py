@@ -31,10 +31,11 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import PartitionSpec as P
 
 import triton_dist_tpu.language as tpl
-from triton_dist_tpu.runtime import resilience
+from triton_dist_tpu.runtime import resilience, telemetry
 from triton_dist_tpu.runtime.mesh import DistContext
 from triton_dist_tpu.shmem import kernel as sk
 from triton_dist_tpu.shmem.kernel import dist_pallas_call
+from triton_dist_tpu.tools import profiler
 
 
 class AllGatherMethod(enum.Enum):
@@ -54,15 +55,21 @@ def get_auto_all_gather_method(shard_bytes: int, world: int) -> AllGatherMethod:
     large shards → ring (each link carries shard_bytes per step, all links
     busy every step). Once the process is degraded (a bounded-wait abort or
     watchdog trip), AUTO routes the plain XLA collective instead — sticky
-    until ``resilience.reset_degradation()``."""
+    until ``resilience.reset_degradation()``. Every resolution ticks the
+    routing counter, so cache- or degradation-driven flips are visible."""
     if resilience.is_degraded("allgather"):
         resilience.note_fallback_once(
             "allgather.auto", "routing AUTO all-gather to XLA"
         )
-        return AllGatherMethod.XLA
-    if shard_bytes <= 128 * 1024:
-        return AllGatherMethod.FULL_MESH_PUSH
-    return AllGatherMethod.RING_1D
+        method = AllGatherMethod.XLA
+    elif shard_bytes <= 128 * 1024:
+        method = AllGatherMethod.FULL_MESH_PUSH
+    else:
+        method = AllGatherMethod.RING_1D
+    telemetry.inc(
+        "tdt_kernels_auto_route_total", collective="allgather", method=method.value
+    )
+    return method
 
 
 @dataclasses.dataclass(frozen=True)
@@ -86,18 +93,27 @@ def create_allgather_context(
 # --------------------------------------------------------------------- kernels
 
 
-def _ring_ag_kernel(x_ref, out_ref, status_ref, send_sem, recv_sem, copy_sem, *rest, axis, mesh_axes, straggler=None):
+def _ring_ag_kernel(x_ref, out_ref, status_ref, *rest, axis, mesh_axes, straggler=None, trace=None):
     """1D ring all-gather: out[(world, *shard)] filled in world-1 steps.
 
     Chunk flow: at step s, I send out[(me-s) % world] (received at step s-1,
     or my own shard at s=0) to my +1 neighbour; simultaneously my -1 neighbour
     delivers chunk (me-s-1) % world into my out.
+
+    ``trace`` (a ``tools.profiler.KernelTrace``, threaded by ``_ag_pallas``
+    when ``TDT_KERNEL_TRACE=1``) appends its SMEM event buffer as an extra
+    output and marks send / bounded-wait phase boundaries.
     """
+    rest = list(rest)
+    ev_ref = rest.pop(0) if trace is not None else None
+    send_sem, recv_sem, copy_sem = rest[:3]
     me = tpl.rank(axis)
     world = tpl.num_ranks(axis)
     right = tpl.ring_neighbor(axis, +1, mesh_axes=mesh_axes)
     left_rank = jax.lax.rem(me - 1 + world, world)  # arrivals come from -1
     sk.init_status(status_ref, axis=axis)
+    if trace is not None:
+        trace.init(ev_ref, rank=me)
 
     if straggler is not None:
         # Device-side straggler injection (reference straggler_option,
@@ -106,7 +122,7 @@ def _ring_ag_kernel(x_ref, out_ref, status_ref, send_sem, recv_sem, copy_sem, *r
         # semaphore slots, not lockstep.
         @pl.when(jnp.equal(me, straggler[0]))
         def _():
-            tpl.delay(rest[0], straggler[1])
+            tpl.delay(rest[3], straggler[1])
 
     # Local shard into its slot (HBM→HBM local DMA).
     cp = pltpu.make_async_copy(x_ref, out_ref.at[me], copy_sem)
@@ -114,7 +130,11 @@ def _ring_ag_kernel(x_ref, out_ref, status_ref, send_sem, recv_sem, copy_sem, *r
     cp.wait()
 
     # Peers may still be in a previous kernel using out_ref; rendezvous first.
+    if trace is not None:
+        trace.mark(ev_ref, 0, profiler.TAG_BARRIER, 0)
     sk.bounded_barrier_all(status_ref, axis, mesh_axes=mesh_axes, phase="barrier")
+    if trace is not None:
+        trace.mark(ev_ref, 0, profiler.TAG_BARRIER, 1)
 
     def step(s, _):
         src = jax.lax.rem(me - s + world, world)  # chunk I forward
@@ -130,13 +150,19 @@ def _ring_ag_kernel(x_ref, out_ref, status_ref, send_sem, recv_sem, copy_sem, *r
             device_id=right,
             device_id_type=pltpu.DeviceIdType.LOGICAL,
         )
+        if trace is not None:
+            trace.mark(ev_ref, s, profiler.TAG_SEND, src)
         dma.start()
         # Chunk (me-s-1)%world arrives from my left neighbour on the same slot.
         arriving = jax.lax.rem(me - s - 1 + world, world)
+        if trace is not None:
+            trace.mark(ev_ref, s, profiler.TAG_WAIT, arriving)
         sk.bounded_wait_recv(
             recv_sem.at[slot], out_ref.at[arriving], status_ref,
             phase="ag_recv", peer=left_rank,
         )
+        if trace is not None:
+            trace.mark(ev_ref, s, profiler.TAG_RECV, arriving)
         # Send-leg drain stays unbounded: the LOCAL DMA engine completes the
         # send even when the peer's kernel is dead, so this cannot hang.
         dma.wait_send()
@@ -145,29 +171,40 @@ def _ring_ag_kernel(x_ref, out_ref, status_ref, send_sem, recv_sem, copy_sem, *r
     jax.lax.fori_loop(0, world - 1, step, 0)
 
 
-def _fullmesh_ag_kernel(x_ref, out_ref, status_ref, send_sem, recv_sem, copy_sem, *rest, axis, mesh_axes, straggler=None):
+def _fullmesh_ag_kernel(x_ref, out_ref, status_ref, *rest, axis, mesh_axes, straggler=None, trace=None):
     """Full-mesh push: put my shard to every peer's out[me] slot, then wait for
     world-1 arrivals (reference push producer ``allgather.py:82-148``)."""
+    rest = list(rest)
+    ev_ref = rest.pop(0) if trace is not None else None
+    send_sem, recv_sem, copy_sem = rest[:3]
     me = tpl.rank(axis)
     world = tpl.num_ranks(axis)
     sk.init_status(status_ref, axis=axis)
+    if trace is not None:
+        trace.init(ev_ref, rank=me)
 
     if straggler is not None:
         @pl.when(jnp.equal(me, straggler[0]))
         def _():
-            tpl.delay(rest[0], straggler[1])
+            tpl.delay(rest[3], straggler[1])
 
     cp = pltpu.make_async_copy(x_ref, out_ref.at[me], copy_sem)
     cp.start()
     cp.wait()
 
+    if trace is not None:
+        trace.mark(ev_ref, 0, profiler.TAG_BARRIER, 0)
     sk.bounded_barrier_all(status_ref, axis, mesh_axes=mesh_axes, phase="barrier")
+    if trace is not None:
+        trace.mark(ev_ref, 0, profiler.TAG_BARRIER, 1)
 
     def send(i, _):
         peer = jax.lax.rem(me + i, world)  # skew start so links are balanced
         dma = tpl.putmem_signal(
             x_ref, out_ref.at[me], send_sem, recv_sem, peer, axis=axis, mesh_axes=mesh_axes
         )
+        if trace is not None:
+            trace.mark(ev_ref, i, profiler.TAG_SEND, peer)
         dma.start()
         return 0
 
@@ -175,10 +212,14 @@ def _fullmesh_ag_kernel(x_ref, out_ref, status_ref, send_sem, recv_sem, copy_sem
 
     def wait_one(i, _):
         src = jax.lax.rem(me + i, world)
+        if trace is not None:
+            trace.mark(ev_ref, i, profiler.TAG_WAIT, src)
         # Each arrival delivers one shard-sized chunk; recv_sem counts bytes.
         sk.bounded_wait_recv(
             recv_sem, out_ref.at[src], status_ref, phase="fanin_recv", peer=src
         )
+        if trace is not None:
+            trace.mark(ev_ref, i, profiler.TAG_RECV, src)
         # Send drain is a LOCAL completion — unbounded by design (can't hang).
         pltpu.make_async_copy(x_ref, x_ref, send_sem).wait()
         return 0
@@ -189,6 +230,11 @@ def _fullmesh_ag_kernel(x_ref, out_ref, status_ref, send_sem, recv_sem, copy_sem
 def _ag_pallas(shard, *, axis, mesh_axes, method, straggler=None):
     world = jax.lax.axis_size(axis)
     kernel = _ring_ag_kernel if method is AllGatherMethod.RING_1D else _fullmesh_ag_kernel
+    # Trace-time opt-in (TDT_KERNEL_TRACE=1): thread a KernelTrace SMEM
+    # buffer as an extra output; the host callback decodes it into the
+    # telemetry kernel-trace ring. Production launches (flag unset) keep the
+    # exact pre-trace signature and outputs.
+    trace = telemetry.maybe_kernel_trace()
     sems = (
         [
             pltpu.SemaphoreType.DMA((max(world - 1, 1),)),
@@ -202,17 +248,26 @@ def _ag_pallas(shard, *, axis, mesh_axes, method, straggler=None):
         # The delay scratch (and kernel arg) exists only under fault
         # injection — production launches keep the pre-straggler signature.
         sems = sems + [pltpu.VMEM((8, 128), jnp.float32)]
-    out, status = dist_pallas_call(
-        functools.partial(kernel, axis=axis, mesh_axes=mesh_axes, straggler=straggler),
-        out_shape=(
-            jax.ShapeDtypeStruct((world, *shard.shape), shard.dtype),
-            sk.status_out_shape(),
+    out_shape = [
+        jax.ShapeDtypeStruct((world, *shard.shape), shard.dtype),
+        sk.status_out_shape(),
+    ]
+    out_specs = [pl.BlockSpec(memory_space=pl.ANY), sk.status_out_spec()]
+    if trace is not None:
+        out_shape.append(trace.out_shape)
+        out_specs.append(trace.out_spec())
+    out, status, *ev = dist_pallas_call(
+        functools.partial(
+            kernel, axis=axis, mesh_axes=mesh_axes, straggler=straggler, trace=trace
         ),
+        out_shape=tuple(out_shape),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
-        out_specs=(pl.BlockSpec(memory_space=pl.ANY), sk.status_out_spec()),
+        out_specs=tuple(out_specs),
         scratch_shapes=sems,
     )(shard)
     resilience.consume_status(status, feature="allgather", kernel=kernel.__name__)
+    if trace is not None:
+        telemetry.consume_kernel_trace(trace, ev[0], kernel=kernel.__name__)
     return out
 
 

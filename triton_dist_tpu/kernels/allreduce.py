@@ -31,7 +31,7 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import PartitionSpec as P
 
 import triton_dist_tpu.language as tpl
-from triton_dist_tpu.runtime import resilience
+from triton_dist_tpu.runtime import resilience, telemetry
 from triton_dist_tpu.runtime.mesh import DistContext
 from triton_dist_tpu.shmem import kernel as sk
 from triton_dist_tpu.shmem.kernel import dist_pallas_call
@@ -87,10 +87,15 @@ def get_auto_all_reduce_method(nbytes: int, world: int) -> AllReduceMethod:
         resilience.note_fallback_once(
             "allreduce.auto", "routing AUTO all-reduce to XLA psum"
         )
-        return AllReduceMethod.XLA
-    if nbytes <= ar_crossover_bytes(world):
-        return AllReduceMethod.ONE_SHOT
-    return AllReduceMethod.TWO_SHOT
+        method = AllReduceMethod.XLA
+    elif nbytes <= ar_crossover_bytes(world):
+        method = AllReduceMethod.ONE_SHOT
+    else:
+        method = AllReduceMethod.TWO_SHOT
+    telemetry.inc(
+        "tdt_kernels_auto_route_total", collective="allreduce", method=method.value
+    )
+    return method
 
 
 @dataclasses.dataclass(frozen=True)

@@ -45,7 +45,7 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import PartitionSpec as P
 
 import triton_dist_tpu.language as tpl
-from triton_dist_tpu.runtime import resilience
+from triton_dist_tpu.runtime import resilience, telemetry
 from triton_dist_tpu.runtime.mesh import DistContext
 from triton_dist_tpu.kernels.allgather import all_gather_shard, AllGatherMethod
 from triton_dist_tpu.kernels.allreduce import all_reduce_shard, AllReduceMethod
@@ -53,6 +53,7 @@ from triton_dist_tpu.kernels.gemm import GemmConfig, fit_block
 from triton_dist_tpu.kernels.gemm_reduce_scatter import _gemm_rs_xla_ring
 from triton_dist_tpu.shmem import kernel as sk
 from triton_dist_tpu.shmem.kernel import collective_id_for, dist_pallas_call
+from triton_dist_tpu.tools import profiler
 
 
 class GemmARMethod(enum.Enum):
@@ -99,10 +100,15 @@ def get_auto_gemm_ar_method(m: int, world: int) -> GemmARMethod:
         resilience.note_fallback_once(
             "gemm_ar.auto", "routing AUTO gemm+allreduce to XLA dot+psum"
         )
-        return GemmARMethod.XLA
-    if m % world != 0 or m <= gemm_ar_crossover_m(world):
-        return GemmARMethod.LL_ONE_SHOT
-    return GemmARMethod.PALLAS_FUSED
+        method = GemmARMethod.XLA
+    elif m % world != 0 or m <= gemm_ar_crossover_m(world):
+        method = GemmARMethod.LL_ONE_SHOT
+    else:
+        method = GemmARMethod.PALLAS_FUSED
+    telemetry.inc(
+        "tdt_kernels_auto_route_total", collective="gemm_ar", method=method.value
+    )
+    return method
 
 
 @dataclasses.dataclass(frozen=True)
@@ -453,19 +459,22 @@ def _gemm_ar_ll_kernel(
     out_ref,  # (m, n) VMEM — full reduced product (flushed once, at the end)
     gather_buf,  # (world, m, n) f32 ANY — symmetric landing zones (dummy out)
     status_ref,  # SMEM (STATUS_WORDS,) bounded-wait abort record
-    acc,  # VMEM (m, bn) f32
-    stage,  # VMEM (m, bn) f32 — finished tile staging (reused after wait)
-    red,  # VMEM (m, n) f32 — reduce accumulator
-    tmp,  # VMEM (m, n) f32 — per-slot staging for the reduce
-    tile_sem,  # DMA — stage → my landing-zone slot (waited inline)
-    send_sem,  # DMA — remote tile pushes (drained before reduce)
-    recv_sem,  # DMA (world,) — per-SOURCE slots: sender ``p`` signals slot p
-    copy_sem,  # DMA — slot → tmp during the reduce
-    *,
+    # With ``trace`` set, its SMEM event buffer follows status_ref (the last
+    # output); then the scratch operands below in order:
+    #   acc,       VMEM (m, bn) f32
+    #   stage,     VMEM (m, bn) f32 — finished tile staging (reused after wait)
+    #   red,       VMEM (m, n) f32 — reduce accumulator
+    #   tmp,       VMEM (m, n) f32 — per-slot staging for the reduce
+    #   tile_sem,  DMA — stage → my landing-zone slot (waited inline)
+    #   send_sem,  DMA — remote tile pushes (drained before reduce)
+    #   recv_sem,  DMA (world,) — per-SOURCE slots: sender ``p`` signals slot p
+    #   copy_sem,  DMA — slot → tmp during the reduce
+    *rest,
     axis,
     mesh_axes,
     n_n: int,
     n_k: int,
+    trace=None,
 ):
     """Fused low-latency GEMM-AR (grid ``(Nt, Kt)``): the partial GEMM's
     epilogue pushes each finished fp32 output tile straight into every peer's
@@ -475,6 +484,9 @@ def _gemm_ar_ll_kernel(
     pushes land on that source's byte-counting semaphore slot, so one wait
     per peer covers its whole (m, n) contribution. fp32 on the wire → exact
     parity with the fp32-accum ``dot + psum`` reference."""
+    rest = list(rest)
+    ev_ref = rest.pop(0) if trace is not None else None
+    acc, stage, red, tmp, tile_sem, send_sem, recv_sem, copy_sem = rest
     jn, kk = pl.program_id(0), pl.program_id(1)
     me = tpl.rank(axis)
     world = tpl.num_ranks(axis)
@@ -482,14 +494,24 @@ def _gemm_ar_ll_kernel(
     @pl.when(jnp.logical_and(jn == 0, kk == 0))
     def _():
         sk.init_status(status_ref, axis=axis)
+        if trace is not None:
+            trace.init(ev_ref, rank=me)
+            trace.mark(ev_ref, 0, profiler.TAG_BARRIER, 0)
         # Peers may still be in a previous kernel using gather_buf (or a
         # previous call of this one); rendezvous before the first push.
         sk.bounded_barrier_all(
             status_ref, axis, mesh_axes=mesh_axes, phase="barrier"
         )
+        if trace is not None:
+            trace.mark(ev_ref, 0, profiler.TAG_BARRIER, 1)
 
     @pl.when(kk == 0)
     def _():
+        # Compute-step entry: one mark per output tile's K-loop start — the
+        # ordering evidence that tile jn's GEMM ran before/after peers'
+        # pushes (the overlap claim the LL design makes).
+        if trace is not None:
+            trace.mark(ev_ref, jn, profiler.TAG_COMPUTE, kk)
         acc[...] = jnp.zeros_like(acc)
 
     acc[...] += jax.lax.dot_general(
@@ -513,6 +535,8 @@ def _gemm_ar_ll_kernel(
         # DESTINATION's recv slot ``me``: per-source accounting.
         def send(i, _):
             peer = jax.lax.rem(me + i, world)
+            if trace is not None:
+                trace.mark(ev_ref, jn, profiler.TAG_SEND, peer)
             tpl.putmem_signal(
                 dst, dst, send_sem, recv_sem.at[me], peer,
                 axis=axis, mesh_axes=mesh_axes,
@@ -532,10 +556,14 @@ def _gemm_ar_ll_kernel(
         # peer whose contribution never arrived.
         def wait_one(i, _):
             src = jax.lax.rem(me + i, world)
+            if trace is not None:
+                trace.mark(ev_ref, i, profiler.TAG_WAIT, src)
             sk.bounded_wait_recv(
                 recv_sem.at[src], gather_buf.at[src], status_ref,
                 phase="fanin_recv", peer=src,
             )
+            if trace is not None:
+                trace.mark(ev_ref, i, profiler.TAG_RECV, src)
             return 0
 
         jax.lax.fori_loop(1, world, wait_one, 0)
@@ -560,9 +588,13 @@ def _gemm_ar_ll_kernel(
 
         jax.lax.fori_loop(0, world, add, 0)
         out_ref[...] = red[...].astype(out_ref.dtype)
+        if trace is not None:
+            trace.mark(ev_ref, 1, profiler.TAG_BARRIER, 0)
         sk.bounded_barrier_all(
             status_ref, axis, mesh_axes=mesh_axes, phase="exit_barrier"
         )
+        if trace is not None:
+            trace.mark(ev_ref, 1, profiler.TAG_BARRIER, 1)
 
 
 def gemm_ar_ll_call(a, b, *, axis, mesh_axes=None, config=None):
@@ -579,27 +611,34 @@ def gemm_ar_ll_call(a, b, *, axis, mesh_axes=None, config=None):
     bk = fit_block(k, cfg.block_k)
     n_n, n_k = n // bn, k // bk
 
-    out, _, status = dist_pallas_call(
+    trace = telemetry.maybe_kernel_trace()
+    out_specs = [
+        # Constant index map: the block is revisited, written once at the
+        # last grid cell, flushed once after it.
+        pl.BlockSpec((m, n), lambda jn, kk: (0, 0)),
+        pl.BlockSpec(memory_space=pl.ANY),
+        sk.status_out_spec(),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((m, n), a.dtype),
+        jax.ShapeDtypeStruct((world, m, n), jnp.float32),
+        sk.status_out_shape(),
+    ]
+    if trace is not None:
+        out_specs.append(trace.out_spec())
+        out_shape.append(trace.out_shape)
+    out, _, status, *ev = dist_pallas_call(
         functools.partial(
-            _gemm_ar_ll_kernel, axis=axis, mesh_axes=mesh_axes, n_n=n_n, n_k=n_k
+            _gemm_ar_ll_kernel, axis=axis, mesh_axes=mesh_axes, n_n=n_n, n_k=n_k,
+            trace=trace,
         ),
         grid=(n_n, n_k),
         in_specs=[
             pl.BlockSpec((m, bk), lambda jn, kk: (0, kk)),
             pl.BlockSpec((bk, bn), lambda jn, kk: (kk, jn)),
         ],
-        out_specs=(
-            # Constant index map: the block is revisited, written once at the
-            # last grid cell, flushed once after it.
-            pl.BlockSpec((m, n), lambda jn, kk: (0, 0)),
-            pl.BlockSpec(memory_space=pl.ANY),
-            sk.status_out_spec(),
-        ),
-        out_shape=(
-            jax.ShapeDtypeStruct((m, n), a.dtype),
-            jax.ShapeDtypeStruct((world, m, n), jnp.float32),
-            sk.status_out_shape(),
-        ),
+        out_specs=tuple(out_specs),
+        out_shape=tuple(out_shape),
         scratch_shapes=[
             pltpu.VMEM((m, bn), jnp.float32),
             pltpu.VMEM((m, bn), jnp.float32),
@@ -619,6 +658,8 @@ def gemm_ar_ll_call(a, b, *, axis, mesh_axes=None, config=None):
     resilience.consume_status(
         status, feature="gemm_ar", kernel="_gemm_ar_ll_kernel"
     )
+    if trace is not None:
+        telemetry.consume_kernel_trace(trace, ev[0], kernel="_gemm_ar_ll_kernel")
     return out
 
 

@@ -40,7 +40,7 @@ from triton_dist_tpu.kernels.moe_utils import (
     topk_routing,
 )
 from triton_dist_tpu.kernels.group_gemm import group_gemm
-from triton_dist_tpu.runtime import resilience
+from triton_dist_tpu.runtime import resilience, telemetry
 
 
 def _tp_mode(mode: str) -> str:
@@ -52,12 +52,16 @@ def _tp_mode(mode: str) -> str:
     ``dist`` takes SEQUENCE-SHARDED inputs (a different data contract), so
     it is NOT remapped here; its collectives degrade kernel-by-kernel via
     their own routing gates."""
+    resolved = mode
     if mode == "dist_ar" and resilience.any_degraded():
         resilience.note_fallback_once(
             "layers.tp", "running dist_ar layers on the xla backend"
         )
-        return "xla"
-    return mode
+        resolved = "xla"
+    telemetry.inc(
+        "tdt_layers_tp_mode_total", requested=mode, resolved=resolved
+    )
+    return resolved
 
 
 def _pytree_dataclass(cls):

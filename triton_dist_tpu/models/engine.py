@@ -28,6 +28,7 @@ from jax.sharding import PartitionSpec as P
 
 from triton_dist_tpu.models.dense import DenseLLM
 from triton_dist_tpu.models.kv_cache import KVCache
+from triton_dist_tpu.runtime import telemetry
 
 
 _BACKENDS = ("xla", "dist", "dist_ar", "mega")
@@ -80,6 +81,7 @@ class Engine:
         degradation flags and the backend switch take effect) and serving
         continues on the same model/caches."""
         assert backend in _BACKENDS, backend
+        telemetry.inc("tdt_engine_rebuilds_total", backend=backend)
         model = self.model
         self.backend = backend
         ctx = model.ctx
@@ -233,6 +235,7 @@ class Engine:
                 return out
         from triton_dist_tpu.runtime import resilience
 
+        telemetry.inc("tdt_engine_serve_total", backend=self.backend)
         watchdog = resilience.CollectiveWatchdog(
             feature="collectives", name=f"engine.serve[{self.backend}]"
         )
@@ -262,6 +265,8 @@ class Engine:
     def _degrade_to_xla(self, why: str) -> None:
         from triton_dist_tpu.runtime import resilience
 
+        telemetry.inc("tdt_engine_fallbacks_total", from_backend=self.backend)
+        telemetry.emit("engine_fallback", from_backend=self.backend, why=why)
         resilience.note_fallback_once(
             "engine.serve", f"rebuilding engine on the xla backend ({why})"
         )
@@ -275,15 +280,41 @@ class Engine:
         if key is None:
             key = jax.random.PRNGKey(0)
 
+        # Serve-path latency histograms. The extra block_until_ready fences
+        # are gated on telemetry being enabled — with TDT_TELEMETRY=0 the
+        # serve path keeps its fully-async dispatch (no added syncs).
+        timed = telemetry.enabled()
+        t0 = time.perf_counter() if timed else 0.0
+
         logits, ks, vs = self._prefill(model.params, input_ids)
         cache = self._make_cache(ks, vs, seq)
 
         key, sub = jax.random.split(key)
         token0 = sample_token(logits, sub, self.sample_method, self.temperature, self.top_p)
+        if timed:
+            jax.block_until_ready(token0)
+            # TTFT: wall from serve entry to the first sampled token being
+            # materialized (prefill + cache build + token-0 sample).
+            telemetry.observe(
+                "tdt_engine_ttft_seconds", time.perf_counter() - t0,
+                backend=self.backend,
+            )
+            t1 = time.perf_counter()
         out, k2, v2 = self._generate(
             model.params, self._decode_extra, token0, cache.k, cache.v,
             cache.lengths, gen_len, key
         )
+        if timed:
+            jax.block_until_ready(out)
+            # The decode loop is ONE on-device fori_loop dispatch — per-token
+            # latency is host-derived: decode wall / steps (gen_len-1 steps
+            # ran; token0 came from prefill). One observation per serve.
+            steps = max(gen_len - 1, 1)
+            telemetry.observe(
+                "tdt_engine_decode_token_seconds",
+                (time.perf_counter() - t1) / steps,
+                backend=self.backend,
+            )
         # gen_len-1 decode steps ran, each writing its input token's KV:
         # slots [0, seq+gen_len-1) hold valid entries; the LAST generated
         # token's KV is not yet written (a resumed decode feeds it next).

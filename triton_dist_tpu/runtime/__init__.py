@@ -19,3 +19,4 @@ from triton_dist_tpu.runtime.platform import (
     interpret_mode_default,
     is_cpu_platform,
 )
+from triton_dist_tpu.runtime import telemetry

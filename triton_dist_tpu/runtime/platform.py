@@ -106,6 +106,20 @@ def force_mosaic():
         _FORCE_MOSAIC = prev
 
 
+def tpu_interpret_available() -> bool:
+    """True when this jax build ships the TPU interpret machinery (semaphore +
+    remote-DMA simulation). Old jax has neither spelling of the params class;
+    collective-kernel tests must skip there — the generic HLO interpreter
+    cannot simulate inter-device signalling (and is orders of magnitude
+    slower, which blows the tier-1 time budget)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    return (
+        getattr(pltpu, "InterpretParams", None)
+        or getattr(pltpu, "TPUInterpretParams", None)
+    ) is not None
+
+
 def interpret_mode_default(detect_races: bool = False):
     """Return the value for ``pallas_call(interpret=...)`` on this platform.
 
@@ -118,7 +132,21 @@ def interpret_mode_default(detect_races: bool = False):
     if is_cpu_platform():
         from jax.experimental.pallas import tpu as pltpu
 
-        return pltpu.InterpretParams(detect_races=detect_races or _RACE_DETECTION)
+        # The TPU interpret machinery was renamed (TPUInterpretParams ->
+        # InterpretParams) and does not exist at all on older jax. Fall back
+        # through the names; when neither exists return False by default —
+        # the generic HLO interpreter (interpret=True) can't simulate
+        # semaphores/remote DMA anyway and is slow enough to blow test time
+        # budgets, so let kernels fail fast at lowering instead.
+        # TDT_INTERPRET_FALLBACK=1 opts into the generic interpreter for
+        # single-device kernels (flash-attn, local GEMM); it is a trace-time
+        # flag — clear jit caches around flips.
+        params_cls = getattr(pltpu, "InterpretParams", None) or getattr(
+            pltpu, "TPUInterpretParams", None
+        )
+        if params_cls is None:
+            return os.environ.get("TDT_INTERPRET_FALLBACK", "0") == "1"
+        return params_cls(detect_races=detect_races or _RACE_DETECTION)
     return False
 
 

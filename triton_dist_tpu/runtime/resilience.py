@@ -35,6 +35,11 @@ Env flags::
     TDT_COLL_TIMEOUT_MS    watchdog per-attempt budget (0 = disabled, default)
     TDT_COLL_RETRIES       extra watchdog attempts after the first (default 2)
     TDT_WAIT_BOUND_ITERS   device-side wait poll cap (0 = unbounded waits)
+    TDT_LOG                log verbosity: silent / warn (default) / debug
+
+Every degradation, abort, fallback, and watchdog trip is also recorded as a
+``runtime.telemetry`` counter + structured event (``docs/observability.md``)
+— the log lines are the human echo, telemetry is the record.
 """
 
 from __future__ import annotations
@@ -46,7 +51,8 @@ import threading
 
 import numpy as np
 
-from triton_dist_tpu.runtime.utils import get_int_env
+from triton_dist_tpu.runtime import telemetry
+from triton_dist_tpu.runtime.utils import get_int_env, tdt_log
 
 # ------------------------------------------------------------- status protocol
 
@@ -237,6 +243,8 @@ def mark_degraded(feature: str, reason: str) -> None:
         if feature in _DEGRADED:
             return
         _DEGRADED[feature] = reason
+    telemetry.inc("tdt_resilience_degradations_total", feature=feature)
+    telemetry.emit("degraded", feature=feature, reason=reason)
     _log(f"[resilience] '{feature}' degraded to XLA fallback: {reason}")
 
 
@@ -276,19 +284,21 @@ def last_abort() -> AbortInfo | None:
 
 
 def note_fallback_once(site: str, what: str) -> None:
-    """One-time-per-site log line for a degraded-mode route change."""
+    """One-time-per-site log line for a degraded-mode route change. The
+    telemetry counter increments on EVERY call (fallback traffic volume is
+    the operational signal); only the human log line is deduplicated."""
+    telemetry.inc("tdt_resilience_fallbacks_total", site=site)
     with _LOCK:
         if site in _NOTED:
             return
         _NOTED.add(site)
+    telemetry.emit("fallback", site=site, what=what)
     _log(f"[resilience] {site}: {what} (degraded: {degraded_reasons()})")
 
 
-def _log(msg: str) -> None:
+def _log(msg: str, level: str = "warn") -> None:
     try:
-        from triton_dist_tpu.runtime.utils import dist_print
-
-        dist_print(msg)
+        tdt_log(msg, level=level)
     except Exception:  # pragma: no cover - never let logging mask the event
         print(msg)
 
@@ -330,6 +340,18 @@ def record_status(words, *, feature: str, kernel: str) -> None:
     )
     with _LOCK:
         _ABORTS.append(info)
+    # The acceptance signal for chaos runs: abort counters labeled with the
+    # stalled phase and peer rank (low-cardinality: phases are a fixed
+    # vocabulary, peers are bounded by world size).
+    telemetry.inc(
+        "tdt_resilience_aborts_total",
+        feature=feature, phase=info.phase, peer=info.peer,
+    )
+    telemetry.emit(
+        "collective_abort",
+        feature=feature, kernel=kernel, phase=info.phase,
+        peer=info.peer, polls=info.polls,
+    )
     mark_degraded(feature, reason)
     raise CollectiveAbortError(reason)
 
@@ -415,6 +437,14 @@ class CollectiveWatchdog:
                 if err[0] is not None:
                     raise err[0]
                 return result[0]
+            telemetry.inc("tdt_resilience_watchdog_timeouts_total", name=self.name)
+            if attempt < self.retries:
+                telemetry.inc("tdt_resilience_watchdog_retries_total", name=self.name)
+            telemetry.emit(
+                "watchdog_timeout",
+                name=self.name, attempt=attempt + 1,
+                attempts=self.retries + 1, timeout_ms=timeout_s * 1e3,
+            )
             _log(
                 f"[resilience] {self.name}: attempt {attempt + 1}/"
                 f"{self.retries + 1} exceeded {timeout_s * 1e3:.0f} ms"

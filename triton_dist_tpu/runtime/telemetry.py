@@ -1,0 +1,360 @@
+"""Process-wide runtime telemetry: metrics registry + structured event ring.
+
+Production systems attribute most debugging wins to always-on telemetry
+rather than offline profilers (MegaScale's observability discipline); the
+reference repo's intra-kernel profiler answers "what did kernel X do" but
+nothing answers "what is this *process* doing right now". This module is
+that answer, and every later perf/robustness layer reports through it:
+
+* **Metrics registry** — counters, gauges, and histograms with fixed
+  log-scale buckets, all labeled (``telemetry.inc("tdt_engine_serve_total",
+  backend="dist_ar")``). Metric names follow ``tdt_<subsystem>_<name>``
+  (enforced by ``scripts/check_metric_names.py``); label VALUES may be
+  dynamic but must stay low-cardinality (rank ids, phase names — never
+  shapes or pointers).
+* **Structured event ring** — ``emit(kind, **fields)`` appends one dict to
+  a bounded ring (``TDT_EVENT_RING`` entries, default 1024): the
+  machine-readable replacement for resilience's ad-hoc ``_log`` lines.
+* **Exporters** — :func:`snapshot` / :func:`dump` (JSON) and
+  :func:`to_prometheus` (text exposition), surfaced by the
+  ``scripts/tdt_metrics.py`` CLI.
+* **Kernel-trace collector** — when ``TDT_KERNEL_TRACE=1`` (read at TRACE
+  time, like FaultPlans), the allgather / gemm-allreduce kernels thread a
+  ``tools.profiler.KernelTrace`` SMEM buffer and the host callback here
+  decodes each rank's events into a bounded ring; merge them into one
+  chrome://tracing JSON via ``tools.profiler.decode_to_chrome``.
+
+Zero-overhead path: ``TDT_TELEMETRY=0`` makes every instrumentation call a
+single cached-bool check and early return — no allocation, no lock, no
+string formatting. The flag is resolved once per process (first call);
+:func:`reset` re-reads it, which is how tests flip it.
+
+Counting semantics on this runtime: jit means most call sites run at TRACE
+time, so counters like ``tdt_shmem_collective_calls`` count *traced
+launches* (one per compilation), not per-step executions — which is exactly
+the signal routing bugs need ("AUTO flipped methods between traces").
+Host-side sites (``Engine.serve``, watchdog, abort callbacks) count real
+runtime occurrences. See ``docs/observability.md``.
+
+Env flags::
+
+    TDT_TELEMETRY        0 disables all collection (default 1)
+    TDT_TELEMETRY_DUMP   path: dump a JSON snapshot at process exit
+    TDT_EVENT_RING       event-ring capacity (default 1024)
+    TDT_KERNEL_TRACE     1 wires KernelTrace into adopted kernels (default 0)
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import threading
+from typing import Any, Iterable, Mapping
+
+from triton_dist_tpu.runtime.utils import get_bool_env, get_int_env
+
+# ----------------------------------------------------------------- enable gate
+
+_ENABLED: bool | None = None  # resolved lazily; reset() re-resolves
+
+
+def enabled() -> bool:
+    """Cached ``TDT_TELEMETRY`` gate — the no-op path's single check."""
+    global _ENABLED
+    if _ENABLED is None:
+        _ENABLED = get_bool_env("TDT_TELEMETRY", True)
+    return _ENABLED
+
+
+def kernel_trace_enabled() -> bool:
+    """``TDT_KERNEL_TRACE`` gate, read at TRACE time by adopted kernels.
+
+    Deliberately NOT cached: flipping it between jit traces is how a test
+    (or an operator with fresh functions) turns tracing on — but like every
+    trace-time flag here it does not participate in jit cache keys, so a
+    cached executable keeps its previous setting until caches clear."""
+    return enabled() and get_bool_env("TDT_KERNEL_TRACE", False)
+
+
+# -------------------------------------------------------------------- storage
+
+# Fixed log2-scale histogram bounds: ~1 µs .. 64 s in doubling steps. One
+# static tuple shared by every histogram keeps bucketing allocation-free and
+# cross-metric comparable; latencies outside the span land in the first /
+# +Inf bucket with count+sum still exact.
+DEFAULT_BUCKETS: tuple[float, ...] = tuple(2.0**e for e in range(-20, 7))
+
+_LOCK = threading.Lock()
+_COUNTERS: dict[tuple[str, tuple], float] = {}
+_GAUGES: dict[tuple[str, tuple], float] = {}
+# histogram value: [counts per bucket + overflow, total_sum, n]
+_HISTS: dict[tuple[str, tuple], list] = {}
+_EVENT_SEQ = 0
+_EVENTS: collections.deque | None = None
+_KTRACES: collections.deque = collections.deque(maxlen=64)
+
+
+def _ring() -> collections.deque:
+    global _EVENTS
+    if _EVENTS is None:
+        _EVENTS = collections.deque(maxlen=max(get_int_env("TDT_EVENT_RING", 1024), 1))
+    return _EVENTS
+
+
+def _key(name: str, labels: Mapping[str, Any]) -> tuple[str, tuple]:
+    return name, tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+
+def reset(enabled_override: bool | None = None) -> None:
+    """Clear every metric, event, and kernel trace; re-resolve the enable
+    gate from the env (or force it). Tests and operator resets only — a
+    serving process keeps its registry for the life of the process."""
+    global _ENABLED, _EVENT_SEQ, _EVENTS
+    with _LOCK:
+        _COUNTERS.clear()
+        _GAUGES.clear()
+        _HISTS.clear()
+        _KTRACES.clear()
+        _EVENT_SEQ = 0
+        _EVENTS = None
+        _ENABLED = None
+    if enabled_override is not None:
+        _ENABLED = bool(enabled_override)
+
+
+# ---------------------------------------------------------------- instruments
+
+
+def inc(name: str, value: float = 1.0, /, **labels) -> None:
+    """Add ``value`` to the counter ``name`` with the given labels."""
+    if not enabled():
+        return
+    k = _key(name, labels)
+    with _LOCK:
+        _COUNTERS[k] = _COUNTERS.get(k, 0.0) + value
+
+
+def set_gauge(name: str, value: float, /, **labels) -> None:
+    """Set the gauge ``name`` to ``value`` (last write wins)."""
+    if not enabled():
+        return
+    k = _key(name, labels)
+    with _LOCK:
+        _GAUGES[k] = float(value)
+
+
+def observe(name: str, value: float, /, **labels) -> None:
+    """Record ``value`` into the histogram ``name`` (log2 buckets)."""
+    if not enabled():
+        return
+    k = _key(name, labels)
+    with _LOCK:
+        h = _HISTS.get(k)
+        if h is None:
+            h = _HISTS[k] = [[0] * (len(DEFAULT_BUCKETS) + 1), 0.0, 0]
+        counts, _, _ = h
+        for i, bound in enumerate(DEFAULT_BUCKETS):
+            if value <= bound:
+                counts[i] += 1
+                break
+        else:
+            counts[-1] += 1  # +Inf bucket
+        h[1] += float(value)
+        h[2] += 1
+
+
+def emit(kind: str, /, **fields) -> None:
+    """Append one structured event to the bounded ring."""
+    if not enabled():
+        return
+    global _EVENT_SEQ
+    ev = {
+        k: (v if isinstance(v, (str, int, float, bool, type(None))) else str(v))
+        for k, v in fields.items()
+    }
+    with _LOCK:
+        _EVENT_SEQ += 1
+        ev["seq"] = _EVENT_SEQ
+        ev["kind"] = kind
+        _ring().append(ev)
+
+
+def events(kind: str | None = None) -> list[dict]:
+    """Events currently in the ring, oldest first (optionally one kind)."""
+    with _LOCK:
+        evs = list(_EVENTS or ())
+    return [e for e in evs if kind is None or e["kind"] == kind]
+
+
+def counter_value(name: str, /, **labels) -> float:
+    """Current value of one labeled counter (0.0 when never incremented)."""
+    with _LOCK:
+        return _COUNTERS.get(_key(name, labels), 0.0)
+
+
+# ------------------------------------------------------ kernel-trace collector
+
+
+def maybe_kernel_trace(capacity: int = 256):
+    """A fresh ``KernelTrace`` when ``TDT_KERNEL_TRACE=1``, else None — the
+    one-line opt-in adopted kernel entry points call at trace time."""
+    if not kernel_trace_enabled():
+        return None
+    from triton_dist_tpu.tools.profiler import KernelTrace
+
+    return KernelTrace(capacity=capacity)
+
+
+def consume_kernel_trace(kt, events_arr, *, kernel: str) -> None:
+    """Attach a host callback that decodes one rank's event buffer into the
+    bounded trace ring. Runs per device under shard_map via
+    ``jax.debug.callback`` (the ``resilience.consume_status`` pattern: the
+    debug effect keeps the otherwise-unused SMEM output alive)."""
+    import jax
+    import numpy as np
+
+    def _cb(ev):
+        e = np.asarray(ev)
+        rec = {"kernel": kernel, "rank": int(e[0, 1]), **kt.decode(e)}
+        with _LOCK:
+            _KTRACES.append(rec)
+
+    jax.debug.callback(_cb, events_arr)
+
+
+def kernel_traces(kernel: str | None = None) -> list[dict]:
+    """Decoded per-rank kernel traces collected so far, oldest first:
+    ``{"kernel", "rank", "events": [...], "n_dropped"}`` dicts, ready for
+    ``tools.profiler.decode_to_chrome``."""
+    with _LOCK:
+        recs = list(_KTRACES)
+    return [r for r in recs if kernel is None or r["kernel"] == kernel]
+
+
+# ------------------------------------------------------------------- exporters
+
+
+def _metric_entries(table: dict) -> dict[str, list[dict]]:
+    out: dict[str, list[dict]] = {}
+    for (name, labels), value in sorted(table.items()):
+        out.setdefault(name, []).append({"labels": dict(labels), "value": value})
+    return out
+
+
+def snapshot() -> dict:
+    """One JSON-safe dict of everything: metrics, events, kernel traces."""
+    with _LOCK:
+        counters = dict(_COUNTERS)
+        gauges = dict(_GAUGES)
+        hists = {k: [list(v[0]), v[1], v[2]] for k, v in _HISTS.items()}
+        evs = list(_EVENTS or ())
+        traces = list(_KTRACES)
+    hist_out: dict[str, list[dict]] = {}
+    for (name, labels), (counts, total, n) in sorted(hists.items()):
+        cum = 0
+        buckets = []
+        for bound, c in zip(DEFAULT_BUCKETS, counts):
+            cum += c
+            buckets.append([bound, cum])
+        buckets.append(["+Inf", cum + counts[-1]])
+        hist_out.setdefault(name, []).append(
+            {"labels": dict(labels), "count": n, "sum": total, "buckets": buckets}
+        )
+    return {
+        "enabled": enabled(),
+        "counters": _metric_entries(counters),
+        "gauges": _metric_entries(gauges),
+        "histograms": hist_out,
+        "events": evs,
+        "kernel_traces": traces,
+    }
+
+
+def dump(path: str) -> str:
+    """Write :func:`snapshot` as JSON; returns the path."""
+    with open(path, "w") as f:
+        json.dump(snapshot(), f, indent=1)
+    return path
+
+
+def _fmt_labels(labels: Mapping[str, str], extra: Iterable[tuple[str, str]] = ()) -> str:
+    items = [*labels.items(), *extra]
+    if not items:
+        return ""
+    body = ",".join(f'{k}="{v}"' for k, v in items)
+    return "{" + body + "}"
+
+
+def to_prometheus(snap: dict | None = None) -> str:
+    """Prometheus text exposition of a snapshot (default: the live one).
+
+    Accepting a snapshot dict lets ``scripts/tdt_metrics.py`` render a file
+    another process dumped — there is no in-process scrape endpoint."""
+    snap = snapshot() if snap is None else snap
+    lines: list[str] = []
+    for name, entries in snap.get("counters", {}).items():
+        lines.append(f"# TYPE {name} counter")
+        for e in entries:
+            lines.append(f"{name}{_fmt_labels(e['labels'])} {e['value']:g}")
+    for name, entries in snap.get("gauges", {}).items():
+        lines.append(f"# TYPE {name} gauge")
+        for e in entries:
+            lines.append(f"{name}{_fmt_labels(e['labels'])} {e['value']:g}")
+    for name, entries in snap.get("histograms", {}).items():
+        lines.append(f"# TYPE {name} histogram")
+        for e in entries:
+            for bound, cum in e["buckets"]:
+                le = bound if isinstance(bound, str) else f"{bound:g}"
+                lines.append(
+                    f"{name}_bucket{_fmt_labels(e['labels'], [('le', le)])} {cum}"
+                )
+            lines.append(f"{name}_sum{_fmt_labels(e['labels'])} {e['sum']:g}")
+            lines.append(f"{name}_count{_fmt_labels(e['labels'])} {e['count']}")
+    return "\n".join(lines) + "\n"
+
+
+def summary() -> dict:
+    """Compact per-section digest for bench emission: flattened counters,
+    histogram count/sum/mean, event + kernel-trace tallies. Small enough to
+    ride along every BENCH line without bloating it."""
+    with _LOCK:
+        counters = dict(_COUNTERS)
+        hists = {k: (v[1], v[2]) for k, v in _HISTS.items()}
+        n_events = len(_EVENTS or ())
+        n_traces = len(_KTRACES)
+
+    def flat(name: str, labels: tuple) -> str:
+        return name + _fmt_labels(dict(labels))
+
+    hist_summary = {}
+    for (name, labels), (total, n) in sorted(hists.items()):
+        hist_summary[flat(name, labels)] = {
+            "count": n,
+            "sum_s": round(total, 6),
+            "mean_s": round(total / n, 6) if n else 0.0,
+        }
+    return {
+        "enabled": enabled(),
+        "counters": {flat(n, l): v for (n, l), v in sorted(counters.items())},
+        "histograms": hist_summary,
+        "events": n_events,
+        "kernel_traces": n_traces,
+    }
+
+
+# ------------------------------------------------------------- exit-time dump
+
+import atexit as _atexit  # noqa: E402
+import os as _os  # noqa: E402
+
+
+def _dump_at_exit() -> None:  # pragma: no cover - exercised via CLI docs
+    path = _os.environ.get("TDT_TELEMETRY_DUMP")
+    if path and enabled():
+        try:
+            dump(path)
+        except Exception:
+            pass  # exit-path telemetry must never mask the real exit status
+
+
+_atexit.register(_dump_at_exit)

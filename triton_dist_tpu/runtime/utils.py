@@ -60,7 +60,45 @@ def get_int_env(name: str, default: int = 0) -> int:
         return default
 
 
+def get_choice_env(name: str, choices: tuple[str, ...], default: str) -> str:
+    """Env var restricted to an enumerated vocabulary, with the same
+    warn-once-on-garbage policy as the bool/int parsers."""
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    s = v.strip().lower()
+    if s in choices:
+        return s
+    _warn_env_once(name, v, default)
+    return default
+
+
 # ------------------------------------------------------------------ printing
+
+
+#: ``TDT_LOG`` vocabulary, ascending verbosity. "silent" drops everything
+#: (telemetry events still record — see runtime.telemetry), "warn" (default)
+#: keeps operational warnings, "debug" adds chatty per-route detail.
+LOG_LEVELS = ("silent", "warn", "debug")
+
+
+def log_level() -> str:
+    """Resolve ``TDT_LOG`` per call (cheap; honors mid-process changes in
+    tests) with warn-once parsing."""
+    return get_choice_env("TDT_LOG", LOG_LEVELS, "warn")
+
+
+def tdt_log(msg: str, level: str = "warn") -> None:
+    """The single leveled logger every runtime layer routes through
+    (``resilience._log`` etc.): prints via :func:`dist_print` when the
+    message's level is enabled by ``TDT_LOG``."""
+    lvl = log_level()
+    if lvl == "silent" or (level == "debug" and lvl != "debug"):
+        return
+    try:
+        dist_print(msg)
+    except Exception:  # printing must never be the thing that fails
+        print(msg)
 
 
 def dist_print(*args, prefix: bool = True, **kwargs) -> None:

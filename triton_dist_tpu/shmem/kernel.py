@@ -34,7 +34,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 import triton_dist_tpu.language as tpl
-from triton_dist_tpu.runtime import resilience
+from triton_dist_tpu.runtime import resilience, telemetry
 from triton_dist_tpu.runtime.platform import interpret_mode_default
 
 _collective_ids = itertools.count(0)
@@ -76,6 +76,16 @@ def kernel_key(kernel) -> str:
         kw = ",".join(f"{k}={v!r}" for k, v in sorted(kernel.keywords.items()))
         return f"{kernel_key(kernel.func)}({args};{kw})"
     return getattr(kernel, "__qualname__", None) or repr(kernel)
+
+
+def kernel_base_name(kernel) -> str:
+    """Bare function name of a (possibly ``functools.partial``-wrapped)
+    kernel — the bounded-cardinality label for per-collective telemetry
+    (``kernel_key`` embeds bound-arg reprs, whose shape/config variety
+    would explode a metric's label space)."""
+    while isinstance(kernel, functools.partial):
+        kernel = kernel.func
+    return getattr(kernel, "__name__", None) or repr(kernel)
 
 
 def collective_id_for(name: str) -> int:
@@ -121,6 +131,14 @@ def dist_pallas_call(
     ``collective=True`` marks a kernel that performs remote DMA / semaphore
     signalling: it forces ``has_side_effects`` and assigns a collective id.
     """
+    if collective:
+        # Trace-time launch counter per collective name: one tick per traced
+        # launch site (retraces included), the signal that shows WHICH
+        # collective kernels a program actually routed into (AUTO flips,
+        # degraded-mode reroutes) without per-step device overhead.
+        telemetry.inc(
+            "tdt_shmem_collective_calls_total", kernel=kernel_base_name(kernel)
+        )
     if compiler_params is None:
         if collective_id is None and collective:
             # Stable id per kernel so barrier semaphores of different kernels

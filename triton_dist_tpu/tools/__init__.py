@@ -32,9 +32,11 @@ from triton_dist_tpu.tools.perf_model import (
     overlap_efficiency,
 )
 from triton_dist_tpu.tools.profiler import (
+    TRACE_TAGS,
     ChromeTrace,
     KernelTrace,
     annotate,
+    decode_to_chrome,
     profile_op,
     trace,
 )
@@ -63,7 +65,9 @@ __all__ = [
     "overlap_fraction",
     "overlap_efficiency",
     "ChromeTrace",
+    "TRACE_TAGS",
     "annotate",
+    "decode_to_chrome",
     "profile_op",
     "trace",
     "parse_xspace",

@@ -11,7 +11,8 @@ Reference threefold:
     each named Pallas kernel — on the device timeline with sub-kernel
     DMA/compute breakdowns. ``trace()`` wraps ``jax.profiler.trace``.
   - **Intra-kernel**: ``KernelTrace`` — kernels append (seq, step, tag, aux)
-    records to an SMEM event buffer via ``prof_mark``. Mosaic exposes no
+    records to an SMEM event buffer via ``KernelTrace.mark`` (``prof_mark``
+    is the reference-named alias). Mosaic exposes no
     cycle counter to Pallas, so records carry a per-core SEQUENCE number
     instead of a wall time; because a TPU core executes its grid serially,
     the sequence IS the schedule, which is exactly what overlap claims need
@@ -112,6 +113,23 @@ def profile_op(fn, args, log_dir: str, iters: int = 3):
 
 TRACE_COLS = 3  # (step_id, tag, aux) per event; seq is the row index
 
+# Shared phase tags for the kernels wired up behind TDT_KERNEL_TRACE=1
+# (allgather, gemm_allreduce): a small fixed vocabulary so one merged trace
+# reads uniformly across kernels. Kernel-specific tags may extend upward.
+TAG_BARRIER = 1  # entry/exit rendezvous
+TAG_COMPUTE = 2  # compute step (GEMM tile / chunk) entry
+TAG_SEND = 3  # remote DMA push started
+TAG_WAIT = 4  # bounded wait entered
+TAG_RECV = 5  # bounded wait satisfied (arrival consumed)
+
+TRACE_TAGS = {
+    TAG_BARRIER: "barrier",
+    TAG_COMPUTE: "compute",
+    TAG_SEND: "send",
+    TAG_WAIT: "wait",
+    TAG_RECV: "recv",
+}
+
 
 @dataclasses.dataclass(frozen=True)
 class KernelTrace:
@@ -148,11 +166,17 @@ class KernelTrace:
 
         return pl.BlockSpec(memory_space=pltpu.SMEM)
 
-    def init(self, ev_ref):
+    def init(self, ev_ref, rank=None):
         """Zero the header. Call exactly once (guard with the first grid
-        step); SMEM outputs start uninitialized."""
+        step); SMEM outputs start uninitialized. ``rank`` (optional) is
+        stamped into the header so a host callback that sees only the
+        buffer (telemetry's kernel-trace collector) can attribute it."""
+        import jax.numpy as jnp
+
         for c in range(TRACE_COLS):
             ev_ref[0, c] = 0
+        if rank is not None:
+            ev_ref[0, 1] = jnp.asarray(rank, jnp.int32)
 
     def mark(self, ev_ref, step, tag: int, aux=0):
         """Append one (step, tag, aux) event at the next free row.
@@ -175,9 +199,14 @@ class KernelTrace:
             ev_ref[row, 1] = jnp.asarray(tag, jnp.int32)
             ev_ref[row, 2] = jnp.asarray(aux, jnp.int32)
 
+    # Reference intra-kernel profiler name (``language.py:37``): the module
+    # docstring historically advertised ``prof_mark`` — keep it callable.
+    prof_mark = mark
+
     def decode(self, events, tags: dict[int, str] | None = None) -> dict:
         """Host-side: events (cap+1, 3) int32 (one rank's buffer) →
-        {"events": [{seq, step, tag, aux}...], "n_dropped": int}."""
+        {"events": [{seq, step, tag, aux}...], "n_dropped": int, "rank":
+        int} (rank is whatever ``init`` stamped — 0 unless given)."""
         import numpy as np
 
         ev = np.asarray(events)
@@ -190,7 +219,45 @@ class KernelTrace:
                 "seq": i, "step": step,
                 "tag": tags.get(tag, tag) if tags else tag, "aux": aux,
             })
-        return {"events": out, "n_dropped": max(0, n - self.capacity)}
+        return {
+            "events": out,
+            "n_dropped": max(0, n - self.capacity),
+            "rank": int(ev[0, 1]),
+        }
+
+
+def decode_to_chrome(records, chrome: ChromeTrace | None = None) -> ChromeTrace:
+    """Merge decoded kernel-trace records into one :class:`ChromeTrace`.
+
+    ``records``: iterables shaped like ``KernelTrace.decode`` output plus a
+    ``kernel`` (and optionally ``rank``) key — exactly what
+    ``runtime.telemetry.kernel_traces()`` returns. Each in-kernel event
+    becomes a 1-unit span at ``ts = seq``: KernelTrace carries sequence
+    numbers, not wall times (a TPU core runs its grid serially, so the
+    sequence IS the schedule — see the module doc), which makes the merged
+    timeline an ORDERING view. pid = rank (one chrome row per rank, the
+    reference's merged per-rank trace), tid = 0, and host-measured
+    ``ChromeTrace.span`` events coexist in the same JSON on their own pids.
+    Overflowed buffers get one ``dropped`` marker event so a truncated
+    timeline is never mistaken for a complete one.
+    """
+    ct = chrome if chrome is not None else ChromeTrace()
+    for rec in records:
+        kernel = rec.get("kernel", "kernel")
+        pid = int(rec.get("rank", 0))
+        for e in rec.get("events", ()):
+            ct.events.append({
+                "name": f"{kernel}:{e['tag']}", "ph": "X",
+                "ts": float(e["seq"]), "dur": 1.0, "pid": pid, "tid": 0,
+                "args": {"step": e["step"], "aux": e["aux"]},
+            })
+        if rec.get("n_dropped"):
+            ct.events.append({
+                "name": f"{kernel}:dropped={rec['n_dropped']}", "ph": "X",
+                "ts": float(len(rec.get("events", ()))), "dur": 1.0,
+                "pid": pid, "tid": 0,
+            })
+    return ct
 
 
 def device_memory_stats(device=None) -> dict:

@@ -44,11 +44,8 @@ WAIVER = "# unbounded-wait-ok:"
 # Kernels that predate the status-buffer protocol and still wait raw.
 # Adopting one = thread a status output through it and delete its entry.
 ALLOWLIST = {
-    "ag_attention.py",
-    "allgather_gemm.py",
     "common_ops.py",
     "ep_fused.py",
-    "gemm_reduce_scatter.py",
     "p2p.py",
 }
 

@@ -1,0 +1,161 @@
+#!/usr/bin/env python
+"""Lint: every AUTO resolver reads its crossover through ``agreed_cfg_value``.
+
+AUTO routing constants (the ``DEFAULT_*_CROSSOVER_*`` module constants) are
+fallbacks, not the source of truth: the tuned value lives in the tune cache
+under a ``<op>_crossover|world=N`` key, and the ONLY blessed read path is
+``tools.tune.agreed_cfg_value`` — a cross-rank digest agreement, because two
+ranks resolving different crossovers route different collectives and
+deadlock (see ``allreduce.ar_crossover_bytes``). A resolver that reads the
+cache directly (``cache.get`` / ``lookup``) or compares against a bare
+constant silently reintroduces per-rank divergence the first time one rank's
+cache file differs.
+
+Enforced per module under ``triton_dist_tpu/kernels/``:
+
+* every ``get_auto_*_method`` function must REACH ``agreed_cfg_value``
+  (directly or through local helper calls, e.g. ``*_crossover_m``), unless
+  the module is in ``STATIC_ALLOWLIST`` — resolvers whose split is a
+  hardware latency regime, not a tuned value. Shrink it, never grow it;
+* every ``*_crossover_*`` getter function must call ``agreed_cfg_value``
+  itself;
+* no function may call ``.get(...)`` / ``.lookup(...)`` with a string key
+  containing ``crossover`` — that is a rank-local cache read.
+
+Usage: ``python scripts/check_tuned_defaults.py [paths...]`` (default: the
+kernels package). Exit 1 with ``file:line`` diagnostics on violations.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+DEFAULT_ROOT = REPO / "triton_dist_tpu" / "kernels"
+
+AUTO_RE = re.compile(r"^get_auto_\w+_method$")
+GETTER_RE = re.compile(r"^\w*_crossover_\w+$")
+AGREED = "agreed_cfg_value"
+
+# Resolvers whose threshold is a hardware latency-regime split (one-shot vs
+# ring), not a bench-tuned crossover: no cache entry exists to agree on.
+# Adopting one = emit a tune entry for it and delete its line.
+STATIC_ALLOWLIST = {
+    "allgather.py",  # 128 KiB one-shot/ring split, fixed by ICI latency
+}
+
+
+def _called_names(fn: ast.AST) -> set[str]:
+    """Names this function calls: bare ``f(...)`` and the attr of ``m.f(...)``
+    (so ``tune.agreed_cfg_value`` and a local ``agreed_cfg_value`` both
+    count)."""
+    names = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            if isinstance(node.func, ast.Name):
+                names.add(node.func.id)
+            elif isinstance(node.func, ast.Attribute):
+                names.add(node.func.attr)
+    return names
+
+
+def _reaches(name: str, graph: dict[str, set[str]], target: str) -> bool:
+    seen, stack = set(), [name]
+    while stack:
+        cur = stack.pop()
+        if cur in seen:
+            continue
+        seen.add(cur)
+        calls = graph.get(cur, set())
+        if target in calls:
+            return True
+        stack.extend(c for c in calls if c in graph)
+    return False
+
+
+def _raw_cache_reads(tree: ast.AST) -> list[int]:
+    """Line numbers of ``*.get(...)`` / ``*.lookup(...)`` calls whose first
+    string-ish argument mentions ``crossover`` — rank-local cache reads that
+    bypass the agreement protocol."""
+    bad = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)):
+            continue
+        if node.func.attr not in ("get", "lookup"):
+            continue
+        for arg in node.args[:1]:
+            for sub in ast.walk(arg):
+                if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+                    if "crossover" in sub.value:
+                        bad.append(node.lineno)
+    return bad
+
+
+def check_file(path: pathlib.Path, *, static: bool = False) -> list[str]:
+    """Lint one module; ``static`` (allowlisted) modules keep only the
+    raw-cache-read check — a static split still must not read the cache."""
+    try:
+        rel = str(path.relative_to(REPO))
+    except ValueError:
+        rel = str(path)
+    tree = ast.parse(path.read_text())
+    funcs = {
+        n.name: n
+        for n in tree.body
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+    graph = {name: _called_names(fn) for name, fn in funcs.items()}
+
+    errors = []
+    for lineno in _raw_cache_reads(tree):
+        errors.append(
+            f"{rel}:{lineno}: rank-local cache read of a crossover key — "
+            f"route it through tune.{AGREED} (cross-rank agreed)"
+        )
+    if static:
+        return errors
+    for name, fn in funcs.items():
+        if AUTO_RE.match(name) and not _reaches(name, graph, AGREED):
+            errors.append(
+                f"{rel}:{fn.lineno}: AUTO resolver {name!r} never reaches "
+                f"{AGREED} — its crossover is not cross-rank agreed"
+            )
+        if GETTER_RE.match(name) and AGREED not in graph.get(name, set()):
+            errors.append(
+                f"{rel}:{fn.lineno}: crossover getter {name!r} does not call "
+                f"{AGREED} directly — tuned value reads must be agreed"
+            )
+    return errors
+
+
+def main(argv: list[str]) -> int:
+    roots = [pathlib.Path(a) for a in argv] or [DEFAULT_ROOT]
+    files: list[pathlib.Path] = []
+    for root in roots:
+        if root.is_dir():
+            files.extend(sorted(root.rglob("*.py")))
+        else:
+            files.append(root)
+
+    errors = []
+    for f in files:
+        # Explicit path arguments are always fully checked (so tests can
+        # lint a fixture named like an allowlisted module); the default
+        # sweep relaxes allowlisted modules to the raw-cache-read check.
+        static = len(argv) == 0 and f.name in STATIC_ALLOWLIST
+        errors.extend(check_file(f, static=static))
+
+    if errors:
+        print(f"check_tuned_defaults: {len(errors)} violation(s)")
+        for e in errors:
+            print(e)
+        return 1
+    print(f"check_tuned_defaults: OK ({len(files)} file(s) scanned)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

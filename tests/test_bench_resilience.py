@@ -49,9 +49,14 @@ def test_bench_salvages_metrics_when_tunnel_dies_mid_run():
     # Budget big enough that the gemm section is not budget-skipped before
     # the fake hang engages; watchdog shortened independently so the test
     # doesn't wait 1.5× budget.
+    # Probe timeout pinned well under the shortened watchdog: on a host
+    # where libtpu is installed but no chip answers, the probe subprocess
+    # itself blocks in TPU init — the run must fall back to CPU and still
+    # reach the flash measurement before the watchdog fires in 'gemm'.
     r = _run_bench({"TDT_BENCH_FAKE_HANG": "gemm",
                     "TDT_BENCH_BUDGET_S": "600",
-                    "TDT_BENCH_WATCHDOG_S": "150"}, timeout=360)
+                    "TDT_BENCH_WATCHDOG_S": "150",
+                    "TDT_BENCH_PROBE_TIMEOUT_S": "30"}, timeout=360)
     assert r.returncode == 3, (r.returncode, r.stdout, r.stderr)
     last = _lines(r)[-1]
     # Salvage: the primary flash metric measured BEFORE the hang survives
@@ -65,20 +70,26 @@ def test_bench_salvages_metrics_when_tunnel_dies_mid_run():
     assert "watchdog" in last["extra"]["error"]
 
 
-@pytest.mark.timeout(300)
+@pytest.mark.timeout(420)
 def test_bench_distinguishes_dead_tunnel_at_startup():
-    """A backend whose ``jax.devices()`` never returns makes the bench exit
-    rc=4 with a 'tunnel dead at startup' line — distinguishable from an
-    in-kernel hang (rc=3, previous test). The probe subprocess is pointed at
-    code that blocks forever, exactly what a dead tunnel looks like."""
+    """A backend whose ``jax.devices()`` never returns no longer aborts the
+    run (rc=4 with a bare error line, the pre-PR-4 behavior): the bench
+    forces ``JAX_PLATFORMS=cpu`` before anything touches the backend
+    in-process and completes every section in world=1 degenerate mode,
+    rc=0. The diagnosis survives in ``probe_fallback`` so the driver knows
+    these are CPU floors, not chip numbers. The probe subprocess is pointed
+    at code that blocks forever, exactly what a dead tunnel looks like."""
     r = _run_bench({"TDT_BENCH_PROBE_CODE": "import time; time.sleep(1000)",
                     "TDT_BENCH_PROBE_TIMEOUT_S": "10",
-                    "TDT_BENCH_BUDGET_S": "60"}, timeout=180)
-    assert r.returncode == 4, (r.returncode, r.stdout, r.stderr)
+                    "TDT_BENCH_BUDGET_S": "120"}, timeout=360)
+    assert r.returncode == 0, (r.returncode, r.stdout, r.stderr)
     last = _lines(r)[-1]
-    assert "tunnel dead at startup" in last["extra"]["error"]
-    assert last["extra"]["phase"] == "device_probe"
-    assert last["value"] == 0.0
+    assert "tunnel dead at startup" in last["extra"]["probe_fallback"]
+    assert last["extra"]["probe_platform"] == "cpu"
+    assert last["metric"] == "flash_attn_causal_f32_tflops"  # cpu fallback
+    # The degraded run still measures: the primary metric really ran.
+    assert last["vs_baseline"] > 0.0
+    assert "error" not in last["extra"]
 
 
 @pytest.mark.timeout(600)

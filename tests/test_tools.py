@@ -504,6 +504,75 @@ def test_gemm_ar_crossover_agreed(tmp_path, monkeypatch):
     assert gemm_ar_crossover_m(4) == DEFAULT_GEMM_AR_CROSSOVER_M
 
 
+def test_prefill_crossovers_agreed(tmp_path, monkeypatch):
+    """The PR-4 prefill pair — AG-GEMM and GEMM-RS AUTO routing — reads its
+    M crossovers only through ``agreed_cfg_value`` from the
+    ``{ag_gemm,gemm_rs}_crossover|world=N`` entries bench.py's
+    ``prefill_overlap`` section emits, with the static defaults on miss or
+    malformed entries (same contract as ``test_gemm_ar_crossover_agreed``)."""
+    import jax.numpy as jnp
+
+    from triton_dist_tpu.kernels.allgather_gemm import (
+        DEFAULT_AG_GEMM_CROSSOVER_M,
+        AGGemmMethod,
+        ag_gemm_crossover_m,
+        get_auto_ag_gemm_method,
+    )
+    from triton_dist_tpu.kernels.gemm_reduce_scatter import (
+        DEFAULT_GEMM_RS_CROSSOVER_M,
+        GemmRSMethod,
+        gemm_rs_crossover_m,
+        get_auto_gemm_rs_method,
+    )
+    from triton_dist_tpu.tools import tune
+
+    monkeypatch.setenv("TDT_TUNE_CACHE", str(tmp_path / "cache.json"))
+    tune._default_cache = None
+
+    # Cold cache → static defaults drive both routing points.
+    assert ag_gemm_crossover_m(8) == DEFAULT_AG_GEMM_CROSSOVER_M
+    assert gemm_rs_crossover_m(8) == DEFAULT_GEMM_RS_CROSSOVER_M
+    assert (get_auto_ag_gemm_method(
+                DEFAULT_AG_GEMM_CROSSOVER_M + 8, 64, 64, jnp.float32, 8)
+            is AGGemmMethod.PALLAS_FUSED)
+    assert get_auto_gemm_rs_method(512, 8) is GemmRSMethod.PALLAS_FUSED
+
+    # The bench's emitted entries merge in and move both routing points.
+    tune.merge_entries({
+        "ag_gemm_crossover|world=8": {
+            "cfg": {"crossover_m": 128,
+                    "default_was": DEFAULT_AG_GEMM_CROSSOVER_M},
+            "time_s": 1e-5, "version": "x"},
+        "gemm_rs_crossover|world=8": {
+            "cfg": {"crossover_m": 1024,
+                    "default_was": DEFAULT_GEMM_RS_CROSSOVER_M},
+            "time_s": 1e-5, "version": "x"},
+    })
+    tune._default_cache = None  # drop the memoized miss
+    assert ag_gemm_crossover_m(8) == 128
+    assert gemm_rs_crossover_m(8) == 1024
+    assert (get_auto_ag_gemm_method(128, 64, 64, jnp.float32, 8)
+            is AGGemmMethod.XLA_RING)
+    assert (get_auto_ag_gemm_method(192, 64, 64, jnp.float32, 8)
+            is AGGemmMethod.PALLAS_FUSED)
+    assert get_auto_gemm_rs_method(1024, 8) is GemmRSMethod.XLA_RING
+    assert get_auto_gemm_rs_method(1024 + 8, 8) is GemmRSMethod.PALLAS_FUSED
+    # Other world sizes are untouched by the world=8 entries.
+    assert ag_gemm_crossover_m(4) == DEFAULT_AG_GEMM_CROSSOVER_M
+    assert gemm_rs_crossover_m(4) == DEFAULT_GEMM_RS_CROSSOVER_M
+
+    # Malformed entries (missing the field) fall back, never raise.
+    tune.merge_entries({
+        "ag_gemm_crossover|world=4": {
+            "cfg": {"wrong_field": 1}, "time_s": 1e-5, "version": "x"},
+        "gemm_rs_crossover|world=4": {
+            "cfg": {"wrong_field": 1}, "time_s": 1e-5, "version": "x"},
+    })
+    tune._default_cache = None
+    assert ag_gemm_crossover_m(4) == DEFAULT_AG_GEMM_CROSSOVER_M
+    assert gemm_rs_crossover_m(4) == DEFAULT_GEMM_RS_CROSSOVER_M
+
+
 def test_xplane_parse_and_overlap(tmp_path):
     """The dependency-free .xplane.pb parser (r4 verdict missing #4's
     unexplored alternative — XProf duration rows wired into an overlap
@@ -545,3 +614,69 @@ def test_xplane_parse_and_overlap(tmp_path):
     assert overlap_ps(comp2, dma) == 100
     # Disjoint → zero.
     assert overlap_ps([Event("c", 0, 10)], [Event("d", 20, 10)]) == 0
+
+
+# ------------------------------------------------- tuned-defaults lint
+
+
+def test_tuned_defaults_lint_repo_is_clean():
+    import subprocess
+    import sys
+
+    r = subprocess.run(
+        [sys.executable, "scripts/check_tuned_defaults.py"],
+        capture_output=True, text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_tuned_defaults_lint_flags_violations(tmp_path):
+    """A resolver that reads the cache rank-locally, a getter that skips
+    ``agreed_cfg_value``, and an AUTO resolver that never reaches it are
+    each flagged with file:line diagnostics."""
+    import subprocess
+    import sys
+
+    bad = tmp_path / "bad_resolver.py"
+    bad.write_text(
+        "DEFAULT_FOO_CROSSOVER_M = 8\n"
+        "\n"
+        "def foo_crossover_m(world):\n"
+        "    cache = get_cache()\n"
+        "    return cache.get('foo_crossover|world=8', DEFAULT_FOO_CROSSOVER_M)\n"
+        "\n"
+        "def get_auto_foo_method(m, world):\n"
+        "    return 'fused' if m > foo_crossover_m(world) else 'll'\n"
+    )
+    r = subprocess.run(
+        [sys.executable, "scripts/check_tuned_defaults.py", str(bad)],
+        capture_output=True, text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert r.returncode == 1
+    assert "rank-local cache read" in r.stdout
+    assert "foo_crossover_m" in r.stdout
+    assert "get_auto_foo_method" in r.stdout
+
+    # The blessed shape passes: getter calls agreed_cfg_value, resolver
+    # reaches it through the getter.
+    good = tmp_path / "good_resolver.py"
+    good.write_text(
+        "DEFAULT_FOO_CROSSOVER_M = 8\n"
+        "\n"
+        "def foo_crossover_m(world):\n"
+        "    from triton_dist_tpu.tools.tune import agreed_cfg_value\n"
+        "    return agreed_cfg_value(\n"
+        "        f'foo_crossover|world={world}', 'crossover_m',\n"
+        "        DEFAULT_FOO_CROSSOVER_M)\n"
+        "\n"
+        "def get_auto_foo_method(m, world):\n"
+        "    return 'fused' if m > foo_crossover_m(world) else 'll'\n"
+    )
+    r = subprocess.run(
+        [sys.executable, "scripts/check_tuned_defaults.py", str(good)],
+        capture_output=True, text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert r.returncode == 0, r.stdout + r.stderr

@@ -61,6 +61,24 @@ if not hasattr(_pltpu, "CompilerParams"):  # pragma: no cover - version-dependen
     # Renamed upstream (TPUCompilerParams -> CompilerParams); same fields.
     _pltpu.CompilerParams = _pltpu.TPUCompilerParams
 
+import dataclasses as _dataclasses
+
+if "has_side_effects" not in {
+    f.name for f in _dataclasses.fields(_pltpu.CompilerParams)
+}:  # pragma: no cover - version-dependent
+    # Older jax predates CompilerParams.has_side_effects (the DCE guard for
+    # kernels whose outputs may go unused). Accept-and-drop the kwarg via a
+    # subclass so every call site works on both; the subclass keeps the
+    # dataclass fields and isinstance identity pallas lowering relies on.
+    class _CompilerParamsCompat(_pltpu.CompilerParams):
+        def __init__(self, *args, has_side_effects=None, **kwargs):
+            del has_side_effects  # not modeled on this jax version
+            super().__init__(*args, **kwargs)
+
+    _CompilerParamsCompat.__name__ = "CompilerParams"
+    _CompilerParamsCompat.__qualname__ = "CompilerParams"
+    _pltpu.CompilerParams = _CompilerParamsCompat
+
 from triton_dist_tpu.runtime.mesh import (
     DistContext,
     initialize_distributed,

@@ -40,6 +40,8 @@ from jax.experimental.pallas import tpu as pltpu
 
 import triton_dist_tpu.language as tpl
 from triton_dist_tpu.kernels.flash_attn import LANES, NEG_INF
+from triton_dist_tpu.runtime import resilience
+from triton_dist_tpu.shmem import kernel as sk
 from triton_dist_tpu.shmem.kernel import collective_id_for, dist_pallas_call
 
 
@@ -62,6 +64,7 @@ def _ag_attn_kernel(
 ):
     it = iter(rest)
     lse_ref = next(it) if with_lse else None  # VMEM (BHkv, gS, LANES) f32
+    status_ref = next(it)  # SMEM (STATUS_WORDS,) bounded-wait abort record
     ev_ref = next(it) if trace is not None else None
     q_vmem = next(it)
     k_vmem = next(it)
@@ -83,6 +86,7 @@ def _ag_attn_kernel(
 
     @pl.when(s == 0)
     def _():
+        sk.init_status(status_ref, axis=axis)
         if trace is not None:
             trace.init(ev_ref)
         # q resident for the whole sweep; local KV into its landing slot.
@@ -94,8 +98,12 @@ def _ag_attn_kernel(
             cp.start()
         for cp in copies:
             cp.wait()
-        # Peers may still read their landing zones from a previous step.
-        tpl.barrier_all(axis, mesh_axes=mesh_axes)
+        # Peers may still read their landing zones from a previous step —
+        # bounded, so a dead peer aborts with a named phase instead of
+        # hanging the sweep before it starts.
+        sk.bounded_barrier_all(
+            status_ref, axis, mesh_axes=mesh_axes, phase="entry_barrier"
+        )
 
         def send(i, _):
             peer = jax.lax.rem(me + i, world)
@@ -119,10 +127,18 @@ def _ag_attn_kernel(
 
     @pl.when(s > 0)
     def _():
-        # Wait THIS source's two arrivals (k + v bytes on its slot), and
-        # retire two of our outbound sends (byte-counting semaphores).
-        tpl.wait_recv(recv_sem.at[src], krecv_ref.at[src])
-        tpl.wait_recv(recv_sem.at[src], vrecv_ref.at[src])
+        # Wait THIS source's two arrivals (k + v bytes on its slot) —
+        # bounded with the status protocol, naming the starved source —
+        # and retire two of our outbound sends (byte-counting semaphores;
+        # LOCAL completion, unbounded by design).
+        sk.bounded_wait_recv(
+            recv_sem.at[src], krecv_ref.at[src], status_ref,
+            phase="ag_kv_recv", peer=src,
+        )
+        sk.bounded_wait_recv(
+            recv_sem.at[src], vrecv_ref.at[src], status_ref,
+            phase="ag_kv_recv", peer=src,
+        )
         pltpu.make_async_copy(k_ref, k_ref, send_sem).wait()
         pltpu.make_async_copy(v_ref, v_ref, send_sem).wait()
         _mark(1, src)  # TAG_ARRIVE
@@ -289,6 +305,9 @@ def ag_flash_attention_shard(
     if return_residuals:
         out_specs.append(pl.BlockSpec((bhkv, gs, LANES), lambda s: (0, 0, 0)))
         out_shape.append(jax.ShapeDtypeStruct((bhkv, gs, LANES), jnp.float32))
+    status_idx = len(out_specs)
+    out_specs.append(sk.status_out_spec())
+    out_shape.append(sk.status_out_shape())
     if trace is not None:
         out_specs.append(trace.out_spec())
         out_shape.append(trace.out_shape)
@@ -325,6 +344,10 @@ def ag_flash_attention_shard(
         ),
     )(qf, kf, vf)
     o = res[0].reshape(b, hkv, group, s_loc, d).reshape(b, hq, s_loc, d)
+    resilience.consume_status(
+        res[status_idx], feature="ag_attn", kernel="_ag_attn_kernel"
+    )
+    ev = res[status_idx + 1] if trace is not None else None
     if return_residuals:
         # Unfold: lanes are replicated, take lane 0; shard-major landing
         # zones concatenate in rank order = global sequence order.
@@ -337,8 +360,8 @@ def ag_flash_attention_shard(
                   .reshape(bhkv, world * s_loc, d)
                   .reshape(b, hkv, world * s_loc, d))
         if trace is not None:
-            return o, (lse, k_full, v_full), res[4]
+            return o, (lse, k_full, v_full), ev
         return o, (lse, k_full, v_full)
     if trace is not None:
-        return o, res[3]
+        return o, ev
     return o

@@ -19,11 +19,32 @@ overlap engines:
 * **pallas_fused** — one grid-tiled kernel: ring-forward remote DMA of A
   chunks through an HBM workspace, while the MXU consumes the chunk in hand
   tile-by-tile — B tiles and output tiles stream through HBM via BlockSpec
-  pipelining, A row-panels double-buffer HBM→VMEM, and the per-chunk arrival
-  wait is the semaphore analog of ``dl.wait`` + ``consume_token``
-  (reference persistent consumer ``allgather_gemm.py:165-270``, wait :242).
-  Covers decode (Mt=Nt=1) through prefill (8k×4k×4k per chip) without any
-  whole-panel VMEM residency requirement.
+  pipelining, and A row-panels double-buffer HBM→VMEM on a GLOBAL panel
+  counter, so the prefetch pipeline runs across chunk-step boundaries: the
+  first panel of chunk ``s+1`` is staged while the last panel of chunk ``s``
+  computes (v2 — the v1 kernel re-primed the panel pipeline synchronously at
+  every step, a one-panel HBM→VMEM bubble per chunk). The per-chunk arrival
+  wait is the bounded-wait analog of ``dl.wait`` + ``consume_token``
+  (reference persistent consumer ``allgather_gemm.py:165-270``, wait :242),
+  carrying the SMEM status-buffer abort protocol from ``shmem/kernel.py``.
+  A ``fuse_swiglu`` variant streams TWO weight operands (gate/up) through the
+  same ring and applies ``silu(g) * u`` in the epilogue — gather → matmul →
+  gate in one kernel (the TP-MLP prefill fusion).
+
+Backpressure in the fused ring is credit-by-construction: every chunk owns a
+dedicated workspace slot and a dedicated per-step semaphore slot (no slot is
+ever contested within a launch), the two VMEM panel slots are recycled only
+after their byte-counting copy semaphore retires, and reuse of the workspace
+ACROSS launches is gated by the bounded entry/exit barriers — every
+cross-rank wait goes through the status-buffer protocol, so a dead neighbour
+aborts with a named phase + peer instead of hanging the chip.
+
+AUTO routing is tuned: the XLA-ring↔fused crossover (rows of the local M
+shard) is a tune-cache entry (``ag_gemm_crossover|world=N``, emitted by
+``bench.py``'s ``prefill_overlap`` section) read through
+``tools.tune.agreed_cfg_value`` — cross-rank agreement, because the two sides
+of the crossover are different collective programs and a rank-local read of a
+stale cache would deadlock the mesh.
 
 Also returns the gathered A when requested (reference ``ag_gemm`` returns the
 AG result for reuse in later layers, ``allgather_gemm.py:534``).
@@ -42,8 +63,11 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import PartitionSpec as P
 
 import triton_dist_tpu.language as tpl
+from triton_dist_tpu.runtime import resilience, telemetry
 from triton_dist_tpu.runtime.mesh import DistContext
+from triton_dist_tpu.shmem import kernel as sk
 from triton_dist_tpu.shmem.kernel import collective_id_for, dist_pallas_call
+from triton_dist_tpu.tools import profiler
 
 
 class AGGemmMethod(enum.Enum):
@@ -69,10 +93,12 @@ def create_ag_gemm_context(
     return AGGemmContext(ctx=ctx, axis=axis, method=method)
 
 
-def _fused_tiles(m: int, k: int, n: int, dtype, config=None):
+def _fused_tiles(m: int, k: int, n: int, dtype, config=None, *, n_mats: int = 1):
     """Pick (bm, bn, bk) for the fused kernel, shrinking bm until the VMEM
-    working set (A panel ×2, B tile ×2, out tile ×2, fp32 acc) fits. Returns
-    None when no tiling fits (pathologically large k) — caller falls back."""
+    working set (A panel ×2, B tile ×2 per weight operand, out tile ×2, fp32
+    acc per weight operand) fits. ``n_mats=2`` sizes the SwiGLU variant
+    (gate + up weights stream together). Returns None when no tiling fits
+    (pathologically large k) — caller falls back."""
     from triton_dist_tpu.kernels.gemm import fit_block
 
     itemsize = jnp.dtype(dtype).itemsize
@@ -92,9 +118,9 @@ def _fused_tiles(m: int, k: int, n: int, dtype, config=None):
     while True:
         need = (
             2 * bm * k * itemsize  # double-buffered A row panel
-            + 2 * bk * bn * itemsize  # pipelined B tile
+            + n_mats * 2 * bk * bn * itemsize  # pipelined B tile(s)
             + 2 * bm * bn * itemsize  # pipelined out tile
-            + bm * bn * 4  # fp32 accumulator
+            + n_mats * bm * bn * 4  # fp32 accumulator(s)
         )
         if need <= budget:
             return bm, bn, bk
@@ -106,17 +132,57 @@ def _fused_tiles(m: int, k: int, n: int, dtype, config=None):
             return None
 
 
-def _resolve_method(
-    method: AGGemmMethod, m_shard: int, k: int, n: int, dtype
+#: Static fallback crossover (rows of the LOCAL M shard): at or below it the
+#: XLA ring wins (collective-permute latency hides under the chunk-GEMM and
+#: the fused kernel's launch + workspace traffic dominates); above it the
+#: one-sided ring's tile-granular overlap takes over. 32 rows is the analytic
+#: guess the bench's ``prefill_overlap`` section refines.
+DEFAULT_AG_GEMM_CROSSOVER_M = 32
+
+
+def ag_gemm_crossover_m(world: int) -> int:
+    """xla_ring↔pallas_fused routing threshold (rows of the local M shard),
+    fed from the tune cache (``ag_gemm_crossover|world=<w>``, emitted by
+    bench.py's ``prefill_overlap`` section) through ``agreed_cfg_value`` —
+    resolved once per process and gated by cross-rank agreement, because the
+    two sides of the crossover are different collective programs (see
+    ``allreduce.ar_crossover_bytes`` for the deadlock argument)."""
+    from triton_dist_tpu.tools.tune import agreed_cfg_value
+
+    return agreed_cfg_value(
+        f"ag_gemm_crossover|world={world}", "crossover_m",
+        DEFAULT_AG_GEMM_CROSSOVER_M,
+    )
+
+
+def get_auto_ag_gemm_method(
+    m_shard: int, k: int, n: int, dtype, world: int, *, config=None,
+    n_mats: int = 1,
 ) -> AGGemmMethod:
-    if method is not AGGemmMethod.AUTO:
-        return method
-    # The tiled fused kernel streams B and the output through HBM, so it
-    # covers decode through prefill; fall back to the XLA ring only when no
-    # tiling fits VMEM (see _fused_tiles).
-    if _fused_tiles(m_shard, k, n, dtype) is not None:
-        return AGGemmMethod.PALLAS_FUSED
-    return AGGemmMethod.XLA_RING
+    """Reference ``get_auto_method`` analog for AG-GEMM: decode-sized shards
+    → the XLA ring (compiler-scheduled overlap, no workspace), prefill-sized
+    shards above the tuned crossover → the fused one-sided ring; shapes with
+    no VMEM-fitting tiling fall back to the ring regardless.
+
+    Degradation check FIRST — before the crossover lookup, which is itself
+    a collective (``agreed_cfg_value``) that must not be dispatched once
+    the process is degraded. Sticky: AUTO keeps routing the XLA ring until
+    ``resilience.reset_degradation()``."""
+    if resilience.is_degraded("ag_gemm"):
+        resilience.note_fallback_once(
+            "ag_gemm.auto", "routing AUTO allgather+gemm to the XLA ring"
+        )
+        method = AGGemmMethod.XLA_RING
+    elif _fused_tiles(m_shard, k, n, dtype, config, n_mats=n_mats) is None:
+        method = AGGemmMethod.XLA_RING
+    elif m_shard <= ag_gemm_crossover_m(world):
+        method = AGGemmMethod.XLA_RING
+    else:
+        method = AGGemmMethod.PALLAS_FUSED
+    telemetry.inc(
+        "tdt_kernels_auto_route_total", collective="ag_gemm", method=method.value
+    )
+    return method
 
 
 # ------------------------------------------------------------------- xla ring
@@ -168,66 +234,117 @@ def _ag_gemm_xla_ring(a, b, *, axis, accum_dtype=jnp.float32, return_gathered=Fa
 def _ag_gemm_fused_kernel(
     order_ref,  # SMEM (world,) int32 — order[s] = (me - s) % world
     a_ref,  # (m, k) ANY — local shard
-    b_ref,  # (bk, bn) VMEM — pipelined B tile
-    out_ref,  # (bm, bn) VMEM — pipelined out tile at rows order[s]*m + im*bm
-    a_buf,  # (world, m, k) ANY dummy output — symmetric gather workspace
-    a_panel,  # VMEM (2, bm, k) — A row panels, double-buffered
-    acc,  # VMEM (bm, bn) f32
-    panel_sem,  # DMA (2,)
-    send_sem,  # DMA (world-1,)
-    recv_sem,  # DMA (world-1,)
-    *,
+    b_ref,  # (bk, bn) VMEM — pipelined B tile (gate weight when fuse_swiglu)
+    # With ``fuse_swiglu``, the up-projection tile follows:
+    #   b2_ref,     (bk, bn) VMEM — pipelined up-weight tile
+    # then the outputs:
+    #   out_ref,    (bm, bn) VMEM — pipelined out tile at rows order[s]*m + im*bm
+    #   a_buf,      (world, m, k) ANY dummy output — symmetric gather workspace
+    #   status_ref, SMEM (STATUS_WORDS,) bounded-wait abort record
+    # with ``trace`` set, its SMEM event buffer follows (the last output);
+    # then the scratch operands:
+    #   a_panel,    VMEM (2, bm, k) — A row panels, double-buffered GLOBALLY
+    #   acc,        VMEM (bm, bn) f32 (gate accumulator when fuse_swiglu)
+    #   acc2,       VMEM (bm, bn) f32 — up accumulator (fuse_swiglu only)
+    #   panel_sem,  DMA (2,)
+    #   send_sem,   DMA (world-1,)
+    #   recv_sem,   DMA (world-1,)
+    *rest,
     axis,
     mesh_axes,
     n_m: int,
     n_n: int,
     n_k: int,
     block_k: int,
+    fuse_swiglu: bool = False,
+    trace=None,
 ):
-    """Grid-tiled ring-AG producer fused with a streaming GEMM consumer.
+    """Grid-tiled ring-AG producer fused with a streaming GEMM consumer, v2.
 
     Grid ``(world, Mt, Nt, Kt)``: chunk step ``s`` computes on shard
     ``order[s] = (me - s) % world`` (rank-swizzle — step 0 is the local
-    shard) while the ring DMA for the next chunk is in flight. The per-chunk
-    arrival wait at each step's first tile is the ``dl.wait`` analog of the
-    reference's persistent consumer (``allgather_gemm.py:242-243``); B and
-    output tiles stream through HBM via BlockSpec pipelining, so nothing
-    requires whole-panel VMEM residency — this covers the prefill regime.
+    shard) while the ring DMA for the next chunk is in flight. A row panels
+    double-buffer on the GLOBAL panel counter ``g = s*Mt + im``, so the
+    prefetch pipeline crosses chunk boundaries: during chunk ``s``'s last
+    panel, the arrival of chunk ``s+1`` is (bounded-)waited and its first
+    panel staged into the free slot — the only synchronous panel stage left
+    is pipeline priming at ``g == 0``. The per-chunk arrival wait is the
+    ``dl.wait`` analog of the reference's persistent consumer
+    (``allgather_gemm.py:242-243``), bounded with the SMEM status protocol;
+    B and output tiles stream through HBM via BlockSpec pipelining, so
+    nothing requires whole-panel VMEM residency — this covers the prefill
+    regime. With ``fuse_swiglu``, two weight operands stream per K-tile and
+    the epilogue applies ``silu(g) * u`` on the fp32 accumulators.
     """
+    rest = list(rest)
+    b2_ref = rest.pop(0) if fuse_swiglu else None
+    out_ref = rest.pop(0)
+    a_buf = rest.pop(0)
+    status_ref = rest.pop(0)
+    ev_ref = rest.pop(0) if trace is not None else None
+    a_panel = rest.pop(0)
+    acc = rest.pop(0)
+    acc2 = rest.pop(0) if fuse_swiglu else None
+    panel_sem, send_sem, recv_sem = rest
     s, im, jn, kk = (pl.program_id(i) for i in range(4))
     me = tpl.rank(axis)
     world = tpl.num_ranks(axis)
     right = tpl.ring_neighbor(axis, +1, mesh_axes=mesh_axes)
+    # Peer attribution is by rank index along `axis` (not logical device id):
+    # the chunk arrivals ride the ring from the left, so a starved recv names
+    # the left neighbour in the abort record.
+    left_rank = jax.lax.rem(me - 1 + world, world)
     bm = a_panel.shape[1]
     src = order_ref[s]
+    g = s * n_m + im  # global panel counter — slots recycle ACROSS chunks
+    slot = jax.lax.rem(g, 2)
 
-    def stage_panel(row, slot):
+    def stage_panel(chunk_idx, row, pslot):
         return pltpu.make_async_copy(
-            a_buf.at[src, pl.ds(row * bm, bm)], a_panel.at[slot], panel_sem.at[slot]
+            a_buf.at[chunk_idx, pl.ds(row * bm, bm)],
+            a_panel.at[pslot],
+            panel_sem.at[pslot],
         )
 
-    @pl.when(jnp.logical_and(im == 0, jnp.logical_and(jn == 0, kk == 0)))
-    def _step_start():
-        @pl.when(s == 0)
+    @pl.when(jnp.logical_and(jn == 0, kk == 0))
+    def _panel_start():
+        @pl.when(g == 0)
         def _():
+            sk.init_status(status_ref, axis=axis)
+            if trace is not None:
+                trace.init(ev_ref, rank=me)
+                trace.mark(ev_ref, 0, profiler.TAG_BARRIER, 0)
             # Publish my shard into the gather workspace; barrier so ring
             # sends never race a peer still writing its own shard.
             cp = pltpu.make_async_copy(a_ref, a_buf.at[me], panel_sem.at[0])
             cp.start()
             cp.wait()
-            tpl.barrier_all(axis, mesh_axes=mesh_axes)
+            sk.bounded_barrier_all(
+                status_ref, axis, mesh_axes=mesh_axes, phase="entry_barrier"
+            )
+            if trace is not None:
+                trace.mark(ev_ref, 0, profiler.TAG_BARRIER, 1)
+            # Pipeline priming: the ONLY synchronous panel stage (v1 paid one
+            # per chunk step; v2's cross-step prefetch removes the rest).
+            p = stage_panel(src, 0, 0)
+            p.start()
+            p.wait()
 
-        @pl.when(s > 0)
+        @pl.when(jnp.logical_and(im == 0, s > 0))
         def _():
-            # Arrival of this step's chunk (dl.wait analog) + completion of
-            # the previous ring send before its semaphore slot retires.
-            tpl.wait_recv(recv_sem.at[s - 1], a_buf.at[src])
+            # Completion of the previous ring send before its semaphore slot
+            # retires — a LOCAL DMA drain, unbounded by design.
             tpl.wait_send(send_sem.at[s - 1], a_buf.at[src])
 
-        @pl.when(s < world - 1)
+        @pl.when(jnp.logical_and(im == 0, s < world - 1))
         def _():
-            # Ring-forward the chunk just consumed-from to the right neighbor
-            # (per-step semaphore slots: ranks drift through steps together).
+            # Ring-forward the chunk being consumed this step to the right
+            # neighbor (per-step semaphore slots: ranks drift through steps
+            # together). Its arrival was already waited — at s==0 by the
+            # entry barrier after publishing, at s>0 by the cross-step
+            # prefetch wait during step s-1's last panel.
+            if trace is not None:
+                trace.mark(ev_ref, s, profiler.TAG_SEND, src)
             pltpu.make_async_remote_copy(
                 src_ref=a_buf.at[src],
                 dst_ref=a_buf.at[src],
@@ -237,38 +354,61 @@ def _ag_gemm_fused_kernel(
                 device_id_type=pltpu.DeviceIdType.LOGICAL,
             ).start()
 
-        # First A panel of the step: synchronous stage (a one-panel HBM→VMEM
-        # bubble per chunk step; the inter-step ring DMA itself is hidden).
-        p = stage_panel(0, 0)
-        p.start()
-        p.wait()
+        @pl.when(g > 0)
+        def _():
+            # This panel was prefetched while panel g-1 computed (possibly
+            # across a chunk boundary) — retire its copy semaphore.
+            stage_panel(src, im, slot).wait()
 
-    @pl.when(jnp.logical_and(im > 0, jnp.logical_and(jn == 0, kk == 0)))
-    def _panel_start():
-        # The panel was prefetched while the previous panel computed.
-        pltpu.make_async_copy(
-            a_buf.at[src, pl.ds(im * bm, bm)],
-            a_panel.at[jax.lax.rem(im, 2)],
-            panel_sem.at[jax.lax.rem(im, 2)],
-        ).wait()
+        @pl.when(im + 1 < n_m)
+        def _():
+            # Prefetch the next panel of THIS chunk into the free slot.
+            stage_panel(src, im + 1, jax.lax.rem(g + 1, 2)).start()
 
-    @pl.when(jnp.logical_and(im + 1 < n_m, jnp.logical_and(jn == 0, kk == 0)))
-    def _prefetch_next_panel():
-        stage_panel(im + 1, jax.lax.rem(im + 1, 2)).start()
+        @pl.when(jnp.logical_and(im == n_m - 1, s < world - 1))
+        def _():
+            # Cross-step prefetch: chunk s+1 must have fully arrived before
+            # its first panel stages — the bounded arrival wait (dl.wait
+            # analog). It had chunk s's whole compute to land, so in steady
+            # state this is a no-op poll.
+            nsrc = order_ref[s + 1]
+            if trace is not None:
+                trace.mark(ev_ref, s + 1, profiler.TAG_WAIT, nsrc)
+            sk.bounded_wait_recv(
+                recv_sem.at[s], a_buf.at[nsrc], status_ref,
+                phase="ag_chunk_recv", peer=left_rank,
+            )
+            if trace is not None:
+                trace.mark(ev_ref, s + 1, profiler.TAG_RECV, nsrc)
+            stage_panel(nsrc, 0, jax.lax.rem(g + 1, 2)).start()
+
+        if trace is not None:
+            trace.mark(ev_ref, g, profiler.TAG_COMPUTE, im)
 
     @pl.when(kk == 0)
     def _():
         acc[...] = jnp.zeros_like(acc)
+        if fuse_swiglu:
+            acc2[...] = jnp.zeros_like(acc2)
 
-    slot = jax.lax.rem(im, 2)
     a_tile = a_panel[slot, :, pl.ds(kk * block_k, block_k)]
     acc[...] += jax.lax.dot_general(
         a_tile, b_ref[...], (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
     )
+    if fuse_swiglu:
+        acc2[...] += jax.lax.dot_general(
+            a_tile, b2_ref[...], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
 
     @pl.when(kk == n_k - 1)
     def _():
-        out_ref[...] = acc[...].astype(out_ref.dtype)
+        if fuse_swiglu:
+            # Fused epilogue on the fp32 accumulators: gather → matmul → gate
+            # in one kernel (parity with the XLA ring's chunk_swiglu).
+            out_ref[...] = (jax.nn.silu(acc[...]) * acc2[...]).astype(out_ref.dtype)
+        else:
+            out_ref[...] = acc[...].astype(out_ref.dtype)
 
     is_last = jnp.logical_and(
         s == world - 1,
@@ -278,21 +418,66 @@ def _ag_gemm_fused_kernel(
     @pl.when(is_last)
     def _():
         # No rank leaves while a peer might still read its workspace.
-        tpl.barrier_all(axis, mesh_axes=mesh_axes)
+        if trace is not None:
+            trace.mark(ev_ref, world, profiler.TAG_BARRIER, 0)
+        sk.bounded_barrier_all(
+            status_ref, axis, mesh_axes=mesh_axes, phase="exit_barrier"
+        )
+        if trace is not None:
+            trace.mark(ev_ref, world, profiler.TAG_BARRIER, 1)
 
 
-def _ag_gemm_pallas(a, b, *, axis, mesh_axes, config=None):
+def _ag_gemm_pallas_core(a, bs, *, axis, mesh_axes, config=None):
+    """Shared host wrapper for the fused kernel: ``bs`` is ``(b,)`` for the
+    plain AG-GEMM or ``(w_gate, w_up)`` for the SwiGLU variant. Returns
+    ``(out, gathered_a)``."""
+    fuse_swiglu = len(bs) == 2
     world = jax.lax.axis_size(axis)
     me = jax.lax.axis_index(axis)
     m, k = a.shape
-    n = b.shape[1]
-    tiles = _fused_tiles(m, k, n, a.dtype, config)
+    n = bs[0].shape[1]
+    tiles = _fused_tiles(m, k, n, a.dtype, config, n_mats=len(bs))
     assert tiles is not None, "no VMEM-fitting tiling; use XLA_RING"
     bm, bn, bk = tiles
     n_m, n_n, n_k = m // bm, n // bn, k // bk
     order = jnp.mod(me - jnp.arange(world, dtype=jnp.int32), world).astype(jnp.int32)
+    kernel_name = (
+        "_ag_gemm_swiglu_fused_kernel" if fuse_swiglu else "_ag_gemm_fused_kernel"
+    )
 
-    out, a_buf = dist_pallas_call(
+    trace = telemetry.maybe_kernel_trace()
+    b_spec = pl.BlockSpec((bk, bn), lambda s, im, jn, kk, order: (kk, jn))
+    in_specs = [pl.BlockSpec(memory_space=pl.ANY), b_spec]
+    if fuse_swiglu:
+        in_specs.append(b_spec)
+    out_specs = [
+        pl.BlockSpec(
+            (bm, bn), lambda s, im, jn, kk, order: (order[s] * (a.shape[0] // bm) + im, jn)
+        ),
+        pl.BlockSpec(memory_space=pl.ANY),
+        sk.status_out_spec(),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((world * m, n), a.dtype),
+        jax.ShapeDtypeStruct((world, m, k), a.dtype),
+        sk.status_out_shape(),
+    ]
+    if trace is not None:
+        out_specs.append(trace.out_spec())
+        out_shape.append(trace.out_shape)
+    scratch_shapes = [
+        pltpu.VMEM((2, bm, k), a.dtype),
+        pltpu.VMEM((bm, bn), jnp.float32),
+    ]
+    if fuse_swiglu:
+        scratch_shapes.append(pltpu.VMEM((bm, bn), jnp.float32))
+    scratch_shapes += [
+        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.SemaphoreType.DMA((max(world - 1, 1),)),
+        pltpu.SemaphoreType.DMA((max(world - 1, 1),)),
+    ]
+
+    out, a_buf, status, *ev = dist_pallas_call(
         functools.partial(
             _ag_gemm_fused_kernel,
             axis=axis,
@@ -301,39 +486,38 @@ def _ag_gemm_pallas(a, b, *, axis, mesh_axes, config=None):
             n_n=n_n,
             n_k=n_k,
             block_k=bk,
+            fuse_swiglu=fuse_swiglu,
+            trace=trace,
         ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(world, n_m, n_n, n_k),
-            in_specs=[
-                pl.BlockSpec(memory_space=pl.ANY),
-                pl.BlockSpec((bk, bn), lambda s, im, jn, kk, order: (kk, jn)),
-            ],
-            out_specs=(
-                pl.BlockSpec(
-                    (bm, bn), lambda s, im, jn, kk, order: (order[s] * (a.shape[0] // bm) + im, jn)
-                ),
-                pl.BlockSpec(memory_space=pl.ANY),
-            ),
-            scratch_shapes=[
-                pltpu.VMEM((2, bm, k), a.dtype),
-                pltpu.VMEM((bm, bn), jnp.float32),
-                pltpu.SemaphoreType.DMA((2,)),
-                pltpu.SemaphoreType.DMA((max(world - 1, 1),)),
-                pltpu.SemaphoreType.DMA((max(world - 1, 1),)),
-            ],
+            in_specs=in_specs,
+            out_specs=tuple(out_specs),
+            scratch_shapes=scratch_shapes,
         ),
-        out_shape=(
-            jax.ShapeDtypeStruct((world * m, n), a.dtype),
-            jax.ShapeDtypeStruct((world, m, k), a.dtype),
-        ),
+        out_shape=tuple(out_shape),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary", "arbitrary", "arbitrary"),
             has_side_effects=True,
-            collective_id=collective_id_for("_ag_gemm_fused_kernel"),
+            collective_id=collective_id_for(kernel_name),
         ),
-    )(order, a, b)
+    )(order, a, *bs)
+    resilience.consume_status(status, feature="ag_gemm", kernel=kernel_name)
+    if trace is not None:
+        telemetry.consume_kernel_trace(trace, ev[0], kernel=kernel_name)
     return out, a_buf.reshape(world * m, k)
+
+
+def _ag_gemm_pallas(a, b, *, axis, mesh_axes, config=None):
+    return _ag_gemm_pallas_core(a, (b,), axis=axis, mesh_axes=mesh_axes, config=config)
+
+
+def _ag_gemm_swiglu_pallas(x, w_gate, w_up, *, axis, mesh_axes, config=None):
+    out, _ = _ag_gemm_pallas_core(
+        x, (w_gate, w_up), axis=axis, mesh_axes=mesh_axes, config=config
+    )
+    return out
 
 
 def ag_gemm_swiglu_shard(
@@ -342,22 +526,40 @@ def ag_gemm_swiglu_shard(
     w_up: jax.Array,  # (k, n_shard) — up column-shard
     *,
     axis: str = "tp",
+    mesh_axes=None,
+    method: AGGemmMethod = AGGemmMethod.AUTO,
+    config=None,
 ) -> jax.Array:
     """Fused AllGather → gate/up GEMMs → SwiGLU in one overlapped ring:
     ``silu(AG(x) @ w_gate) * (AG(x) @ w_up)`` → (world·m, n_shard).
 
-    The TP-MLP gate+up pair shares one AG pass — both chunk-GEMMs of step
-    ``s`` hide the ``ppermute`` bringing chunk ``s+1``, and the SwiGLU runs
-    on the fp32 accumulators (reference ``TP_MLP`` gate_up AG-GEMM + fused
-    swiglu, ``layers/nvidia/tp_mlp.py:143-204``)."""
+    The TP-MLP gate+up pair shares one AG pass. ``PALLAS_FUSED`` runs the
+    one-kernel gather→matmul→gate epilogue variant of the fused AG-GEMM
+    (both weight operands stream through the same ring pass, SwiGLU on the
+    fp32 accumulators); the XLA ring chunk-GEMMs of step ``s`` hide the
+    ``ppermute`` bringing chunk ``s+1`` (reference ``TP_MLP`` gate_up
+    AG-GEMM + fused swiglu, ``layers/nvidia/tp_mlp.py:143-204``). AUTO picks
+    by the tuned ``ag_gemm_crossover|world=N`` threshold."""
 
     def chunk_swiglu(xc):
         g = jnp.dot(xc, w_gate, preferred_element_type=jnp.float32)
         u = jnp.dot(xc, w_up, preferred_element_type=jnp.float32)
         return (jax.nn.silu(g) * u).astype(x.dtype)
 
-    if jax.lax.axis_size(axis) == 1:
+    world = jax.lax.axis_size(axis)
+    if world == 1:
         return chunk_swiglu(x)
+    if method is AGGemmMethod.AUTO:
+        method = get_auto_ag_gemm_method(
+            x.shape[0], x.shape[1], w_gate.shape[1], x.dtype, world,
+            config=config, n_mats=2,
+        )
+    if method is AGGemmMethod.PALLAS_FUSED:
+        return _ag_gemm_swiglu_pallas(
+            x, w_gate, w_up, axis=axis, mesh_axes=mesh_axes, config=config
+        )
+    if method is AGGemmMethod.XLA_AG_THEN_GEMM:
+        return chunk_swiglu(jax.lax.all_gather(x, axis, tiled=True))
     parts = [chunk_swiglu(xc) for xc in ring_ag_chunks(x, axis)]
     return ring_ag_concat(parts, axis)
 
@@ -382,10 +584,13 @@ def ag_gemm_shard(
     ``ag_gemm`` (``allgather_gemm.py:534``).
     """
     world = jax.lax.axis_size(axis)
-    method = _resolve_method(method, a.shape[0], a.shape[1], b.shape[1], a.dtype)
     if world == 1:
         out = jnp.dot(a, b, preferred_element_type=jnp.float32).astype(a.dtype)
         return (out, a) if return_gathered else out
+    if method is AGGemmMethod.AUTO:
+        method = get_auto_ag_gemm_method(
+            a.shape[0], a.shape[1], b.shape[1], a.dtype, world, config=config
+        )
 
     if method is AGGemmMethod.XLA_AG_THEN_GEMM:
         ag = jax.lax.all_gather(a, axis, tiled=True)

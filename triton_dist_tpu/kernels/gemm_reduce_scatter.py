@@ -39,10 +39,13 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import PartitionSpec as P
 
 import triton_dist_tpu.language as tpl
+from triton_dist_tpu.runtime import resilience, telemetry
 from triton_dist_tpu.runtime.mesh import DistContext
 from triton_dist_tpu.kernels.gemm import gemm, GemmConfig
 from triton_dist_tpu.kernels.reduce_scatter import reduce_scatter_shard
+from triton_dist_tpu.shmem import kernel as sk
 from triton_dist_tpu.shmem.kernel import collective_id_for, dist_pallas_call
+from triton_dist_tpu.tools import profiler
 
 
 class GemmRSMethod(enum.Enum):
@@ -67,6 +70,54 @@ def create_gemm_rs_context(
     ctx: DistContext, axis: str = "tp", method: GemmRSMethod = GemmRSMethod.AUTO
 ) -> GemmRSContext:
     return GemmRSContext(ctx=ctx, axis=axis, method=method)
+
+
+#: Static fallback crossover (rows of the FULL M): at or below it the XLA
+#: ring wins (per-chunk GEMMs are too small to hide the fused kernel's
+#: workspace traffic and launch cost); above it the fused ring's tile-granular
+#: overlap takes over. 256 rows is the analytic guess the bench's
+#: ``prefill_overlap`` section refines.
+DEFAULT_GEMM_RS_CROSSOVER_M = 256
+
+
+def gemm_rs_crossover_m(world: int) -> int:
+    """xla_ring↔pallas_fused routing threshold (rows of M), fed from the
+    tune cache (``gemm_rs_crossover|world=<w>``, emitted by bench.py's
+    ``prefill_overlap`` section) through ``agreed_cfg_value`` — resolved once
+    per process and gated by cross-rank agreement, because the two sides of
+    the crossover are different collective programs (see
+    ``allreduce.ar_crossover_bytes`` for the deadlock argument)."""
+    from triton_dist_tpu.tools.tune import agreed_cfg_value
+
+    return agreed_cfg_value(
+        f"gemm_rs_crossover|world={world}", "crossover_m",
+        DEFAULT_GEMM_RS_CROSSOVER_M,
+    )
+
+
+def get_auto_gemm_rs_method(m: int, world: int) -> GemmRSMethod:
+    """Reference ``get_auto_method`` analog for GEMM-RS: ragged M (the fused
+    ring chunks rows over ranks) or small M → the XLA ring's
+    compiler-scheduled overlap; prefill-sized M above the tuned crossover →
+    the tile-granular fused ring.
+
+    Degradation check FIRST — before the crossover lookup, which is itself
+    a collective (``agreed_cfg_value``) that must not be dispatched once
+    the process is degraded. Sticky: AUTO keeps routing ``dot +
+    psum_scatter`` until ``resilience.reset_degradation()``."""
+    if resilience.is_degraded("gemm_rs"):
+        resilience.note_fallback_once(
+            "gemm_rs.auto", "routing AUTO gemm+reduce_scatter to XLA dot+psum_scatter"
+        )
+        method = GemmRSMethod.XLA
+    elif m % world != 0 or m <= gemm_rs_crossover_m(world):
+        method = GemmRSMethod.XLA_RING
+    else:
+        method = GemmRSMethod.PALLAS_FUSED
+    telemetry.inc(
+        "tdt_kernels_auto_route_total", collective="gemm_rs", method=method.value
+    )
+    return method
 
 
 def _gemm_rs_xla_ring(a, b, *, axis, accum_dtype=jnp.float32):
@@ -99,49 +150,85 @@ def _gemm_rs_fused_kernel(
     o_ref,  # (chunk, n) ANY — final reduced chunk, tile-DMA'd at s==world-1
     send_buf,  # (2, chunk, n) f32 ANY — outgoing partial chunk, per-slot
     recv_buf,  # (2, chunk, n) f32 ANY — incoming partial chunk, per-slot
-    acc,  # VMEM (bm, bn) f32
-    recv_tile,  # VMEM (bm, bn) f32 — staged incoming tile
-    send_stage,  # VMEM (2, bm, bn) f32 — outgoing tile, double-buffered
-    out_stage,  # VMEM (2, bm, bn) out dtype — final tile, double-buffered
-    recv_sem,  # DMA (2,)
-    send_sem,  # DMA (2,) — remote send completion
-    tile_out_sem,  # DMA (2,) — local copies into send_buf (byte-counted)
-    tile_in_sem,  # DMA (1,) — recv tile staging
-    out_sem,  # DMA (2,) — final tile copies into o_ref
-    credit_sem,  # REGULAR (2,) — receiver → left: slot consumed
-    *,
+    status_ref,  # SMEM (STATUS_WORDS,) bounded-wait abort record
+    # With ``trace`` set, its SMEM event buffer follows status_ref (the last
+    # output); then the scratch operands below in order:
+    #   acc,          VMEM (bm, bn) f32
+    #   recv_tile,    VMEM (bm, bn) f32 — staged incoming tile
+    #   send_stage,   VMEM (2, bm, bn) f32 — outgoing tile, double-buffered
+    #   out_stage,    VMEM (2, bm, bn) out dtype — final tile, double-buffered
+    #   recv_sem,     DMA (2,)
+    #   send_sem,     DMA (2,) — remote send completion
+    #   tile_out_sem, DMA (2,) — local copies into send_buf (byte-counted)
+    #   tile_in_sem,  DMA (1,) — recv tile staging
+    #   out_sem,      DMA (2,) — final tile copies into o_ref
+    #   credit_sem,   REGULAR (2,) — receiver → left: slot consumed
+    *rest,
     axis,
     mesh_axes,
     n_m: int,
     n_n: int,
     n_k: int,
+    trace=None,
 ):
     """Fused ring reduce-scatter matmul (see module doc). Step ``s`` computes
     the chunk-GEMM for chunk ``sched[s]``, adding the partial received from
     the left neighbor; every finished tile is DMA'd into the outgoing buffer
     immediately (K-loop-interleaved ring traffic), and the chunk-complete
-    remote send overlaps the next step's GEMM."""
+    remote send overlaps the next step's GEMM. Cross-rank waits are bounded
+    and carry the SMEM status-buffer abort protocol (phase + peer named on
+    timeout); LOCAL DMA drains stay unbounded by design."""
+    rest = list(rest)
+    ev_ref = rest.pop(0) if trace is not None else None
+    (acc, recv_tile, send_stage, out_stage, recv_sem, send_sem, tile_out_sem,
+     tile_in_sem, out_sem, credit_sem) = rest
     s, im, jn, kk = (pl.program_id(i) for i in range(4))
+    me = tpl.rank(axis)
     world = tpl.num_ranks(axis)
     right = tpl.ring_neighbor(axis, +1, mesh_axes=mesh_axes)
     left = tpl.ring_neighbor(axis, -1, mesh_axes=mesh_axes)
+    # Peer attribution is by rank index along `axis` (not logical device id):
+    # this kernel has NO entry barrier, so the first wait that a dead left
+    # neighbour starves (rs_recv) names the exact peer in the abort record.
+    left_rank = jax.lax.rem(me - 1 + world, world)
+    right_rank = jax.lax.rem(me + 1, world)
     bm, bn = acc.shape
     cur = jax.lax.rem(s, 2)  # outgoing slot of this step
     prev = jax.lax.rem(s - 1 + 2, 2)  # incoming slot (left's step s-1)
 
     @pl.when(jnp.logical_and(im == 0, jnp.logical_and(jn == 0, kk == 0)))
     def _step_start():
+        @pl.when(s == 0)
+        def _():
+            sk.init_status(status_ref, axis=axis)
+            if trace is not None:
+                trace.init(ev_ref, rank=me)
+
+        if trace is not None:
+            trace.mark(ev_ref, s, profiler.TAG_COMPUTE, 0)
+
         @pl.when(s > 0)
         def _():
             # Incoming partial chunk fully arrived (dl.wait analog).
-            tpl.wait_recv(recv_sem.at[prev], recv_buf.at[prev])
+            if trace is not None:
+                trace.mark(ev_ref, s, profiler.TAG_WAIT, prev)
+            sk.bounded_wait_recv(
+                recv_sem.at[prev], recv_buf.at[prev], status_ref,
+                phase="rs_recv", peer=left_rank,
+            )
+            if trace is not None:
+                trace.mark(ev_ref, s, profiler.TAG_RECV, prev)
 
         @pl.when(s >= 2)
         def _():
-            # Slot reuse: our send of step s-2 completed locally, and the
-            # right neighbor consumed it (credit backpressure).
+            # Slot reuse: our send of step s-2 completed locally (LOCAL DMA
+            # completion — unbounded by design), and the right neighbor
+            # consumed it (credit backpressure — bounded).
             tpl.wait_send(send_sem.at[cur], send_buf.at[cur])
-            tpl.wait(credit_sem.at[cur], 1)
+            sk.bounded_wait(
+                credit_sem.at[cur], status_ref,
+                phase="rs_credit", peer=right_rank,
+            )
 
     # Stage the incoming tile for this (im, jn) early — overlaps the K-loop.
     @pl.when(jnp.logical_and(s > 0, kk == 0))
@@ -234,6 +321,8 @@ def _gemm_rs_fused_kernel(
             pltpu.make_async_copy(
                 send_stage.at[t_last], send_stage.at[t_last], tile_out_sem.at[t_last]
             ).wait()
+            if trace is not None:
+                trace.mark(ev_ref, s, profiler.TAG_SEND, cur)
             pltpu.make_async_remote_copy(
                 src_ref=send_buf.at[cur],
                 dst_ref=recv_buf.at[cur],
@@ -256,9 +345,10 @@ def _gemm_rs_fused_kernel(
     @pl.when(is_last)
     def _():
         # Drain: outstanding output-tile copies, our last send (step
-        # world-2), and the credit the right neighbor signalled when
-        # consuming it (its step world-1 chunk end runs before this wait on
-        # every rank — signal-before-wait, no cycle).
+        # world-2; LOCAL completion — unbounded by design), and the credit
+        # the right neighbor signalled when consuming it (its step world-1
+        # chunk end runs before this wait on every rank —
+        # signal-before-wait, no cycle).
         t_last = (n_m * n_n - 1) % 2
         if n_m * n_n >= 2:
             pltpu.make_async_copy(
@@ -269,8 +359,15 @@ def _gemm_rs_fused_kernel(
             out_stage.at[t_last], out_stage.at[t_last], out_sem.at[t_last]
         ).wait()
         tpl.wait_send(send_sem.at[(world - 2) % 2], send_buf.at[0])
-        tpl.wait(credit_sem.at[(world - 2) % 2], 1)
-        tpl.barrier_all(axis, mesh_axes=mesh_axes)
+        sk.bounded_wait(
+            credit_sem.at[(world - 2) % 2], status_ref,
+            phase="rs_credit_drain", peer=right_rank,
+        )
+        # Peers must not start a next launch that reuses these buffers while
+        # stragglers still forward chunks.
+        sk.bounded_barrier_all(
+            status_ref, axis, mesh_axes=mesh_axes, phase="exit_barrier"
+        )
 
 
 def _gemm_rs_fused(a, b, *, axis, mesh_axes, config=None):
@@ -296,7 +393,23 @@ def _gemm_rs_fused(a, b, *, axis, mesh_axes, config=None):
     n_m, n_n, n_k = chunk // bm, n // bn, k // bk
     sched = jnp.mod(me - 1 - jnp.arange(world, dtype=jnp.int32), world).astype(jnp.int32)
 
-    out, _, _ = dist_pallas_call(
+    trace = telemetry.maybe_kernel_trace()
+    out_specs = [
+        pl.BlockSpec(memory_space=pl.ANY),
+        pl.BlockSpec(memory_space=pl.ANY),
+        pl.BlockSpec(memory_space=pl.ANY),
+        sk.status_out_spec(),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((chunk, n), a.dtype),
+        jax.ShapeDtypeStruct((2, chunk, n), jnp.float32),
+        jax.ShapeDtypeStruct((2, chunk, n), jnp.float32),
+        sk.status_out_shape(),
+    ]
+    if trace is not None:
+        out_specs.append(trace.out_spec())
+        out_shape.append(trace.out_shape)
+    out, _, _, status, *ev = dist_pallas_call(
         functools.partial(
             _gemm_rs_fused_kernel,
             axis=axis,
@@ -304,6 +417,7 @@ def _gemm_rs_fused(a, b, *, axis, mesh_axes, config=None):
             n_m=n_m,
             n_n=n_n,
             n_k=n_k,
+            trace=trace,
         ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
@@ -314,11 +428,7 @@ def _gemm_rs_fused(a, b, *, axis, mesh_axes, config=None):
                 ),
                 pl.BlockSpec((bk, bn), lambda s, im, jn, kk, sched: (kk, jn)),
             ],
-            out_specs=(
-                pl.BlockSpec(memory_space=pl.ANY),
-                pl.BlockSpec(memory_space=pl.ANY),
-                pl.BlockSpec(memory_space=pl.ANY),
-            ),
+            out_specs=tuple(out_specs),
             scratch_shapes=[
                 pltpu.VMEM((bm, bn), jnp.float32),
                 pltpu.VMEM((bm, bn), jnp.float32),
@@ -332,17 +442,18 @@ def _gemm_rs_fused(a, b, *, axis, mesh_axes, config=None):
                 pltpu.SemaphoreType.REGULAR((2,)),
             ],
         ),
-        out_shape=(
-            jax.ShapeDtypeStruct((chunk, n), a.dtype),
-            jax.ShapeDtypeStruct((2, chunk, n), jnp.float32),
-            jax.ShapeDtypeStruct((2, chunk, n), jnp.float32),
-        ),
+        out_shape=tuple(out_shape),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary", "arbitrary", "arbitrary"),
             has_side_effects=True,
             collective_id=collective_id_for("_gemm_rs_fused_kernel"),
         ),
     )(sched, a, b)
+    resilience.consume_status(
+        status, feature="gemm_rs", kernel="_gemm_rs_fused_kernel"
+    )
+    if trace is not None:
+        telemetry.consume_kernel_trace(trace, ev[0], kernel="_gemm_rs_fused_kernel")
     return out
 
 
@@ -362,7 +473,7 @@ def gemm_rs_shard(
     if world == 1:
         return jnp.dot(a, b, preferred_element_type=jnp.float32).astype(a.dtype)
     if method is GemmRSMethod.AUTO:
-        method = GemmRSMethod.XLA_RING
+        method = get_auto_gemm_rs_method(a.shape[0], world)
 
     if method is GemmRSMethod.XLA:
         partial = jnp.dot(a, b, preferred_element_type=jnp.float32)

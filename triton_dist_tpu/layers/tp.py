@@ -129,8 +129,12 @@ class TP_MLP:
         if mode == "dist":
             # One AG pass feeding BOTH gate and up chunk-GEMMs with a fused
             # SwiGLU (x seq-sharded), then GEMM-RS down — no unoverlapped
-            # matmul anywhere in the MLP.
-            h = ag_gemm_swiglu_shard(x, self.w_gate, self.w_up, axis=axis)
+            # matmul anywhere in the MLP. Both AUTO-route by their tuned
+            # crossovers (ag_gemm_crossover / gemm_rs_crossover): prefill
+            # shards take the one-kernel gather→matmul→gate fused path.
+            h = ag_gemm_swiglu_shard(
+                x, self.w_gate, self.w_up, axis=axis, mesh_axes=self.mesh_axes
+            )
             return gemm_rs_shard(h, self.w_down, axis=axis, mesh_axes=self.mesh_axes)
         if mode == "dist_ar":
             g = jnp.dot(x, self.w_gate, preferred_element_type=jnp.float32)

@@ -91,6 +91,25 @@ class Engine:
         prefill_mode = {"xla": "xla", "dist": "dist", "dist_ar": "dist_ar", "mega": "dist_ar"}[backend]
         decode_mode = {"xla": "xla", "dist": "dist_ar", "dist_ar": "dist_ar", "mega": "mega"}[backend]
 
+        if backend == "dist":
+            # Resolve the prefill routing crossovers ONCE at build time:
+            # agreed_cfg_value's digest allgather is a host collective that
+            # must not fire mid-trace on a cold cache, and surfacing the
+            # resolved thresholds as gauges makes the AUTO routing the
+            # compiled prefill will take auditable before the first serve.
+            from triton_dist_tpu.kernels.allgather_gemm import ag_gemm_crossover_m
+            from triton_dist_tpu.kernels.gemm_reduce_scatter import gemm_rs_crossover_m
+
+            world = ctx.mesh.shape[axis]
+            telemetry.set_gauge(
+                "tdt_engine_prefill_crossover_rows",
+                float(ag_gemm_crossover_m(world)), op="ag_gemm",
+            )
+            telemetry.set_gauge(
+                "tdt_engine_prefill_crossover_rows",
+                float(gemm_rs_crossover_m(world)), op="gemm_rs",
+            )
+
         p_specs = jax.tree.map(
             lambda s: s, modelspecs(model), is_leaf=lambda x: isinstance(x, P) or x is None
         )

@@ -171,12 +171,16 @@ def dist_pallas_call(
 # --------------------------------------------------- status buffer protocol
 #
 # Every adopted collective kernel appends one small SMEM int32 output (LAST
-# in its out_shape tuple) holding [0]=code (STATUS_OK/STATUS_ABORT),
-# [1]=phase id (resilience.phase_name), [2]=peer rank along the collective
-# axis (-1 when unattributable, e.g. a barrier), [3]=polls spent. Bounded
-# waits write an abort record instead of spinning forever; the host surfaces
-# it via resilience.consume_status. SMEM outputs start uninitialized — call
-# init_status() first thing in the kernel (once per launch under a grid).
+# in its out_shape tuple, except that a TDT_KERNEL_TRACE event buffer — when
+# threaded — follows it as the final output) holding [0]=code
+# (STATUS_OK/STATUS_ABORT), [1]=phase id (resilience.phase_name), [2]=peer
+# rank along the collective axis (-1 when unattributable, e.g. a barrier),
+# [3]=polls spent. Bounded waits write an abort record instead of spinning
+# forever; the host surfaces it via resilience.consume_status. SMEM outputs
+# start uninitialized — call init_status() first thing in the kernel (once
+# per launch under a grid). Adopters: allgather / allreduce / reduce_scatter
+# / gemm_allreduce / ep_a2a (PR 2) + allgather_gemm / gemm_reduce_scatter /
+# ag_attention (prefill overlap v2).
 
 #: Number of int32 words in a collective status buffer.
 STATUS_WORDS = 4

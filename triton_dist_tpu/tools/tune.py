@@ -22,10 +22,14 @@ _CACHE_ENV = "TDT_TUNE_CACHE"
 _DEFAULT_DIR = pathlib.Path(__file__).parent / "tuned"
 
 #: Cache-file schema version. v2 adds resolved-at-init crossover entries
-#: (``ar_crossover|world=N``, ``gemm_ar_crossover|world=N``) whose values
-#: steer COLLECTIVE routing and therefore must never be half-read: a file
-#: from an older schema is ignored wholesale (treated as a cold cache)
-#: rather than partially interpreted with drifted key/field meanings.
+#: (``ar_crossover|world=N``, ``gemm_ar_crossover|world=N``, and the prefill
+#: pair ``ag_gemm_crossover|world=N`` / ``gemm_rs_crossover|world=N`` —
+#: additive, same schema) whose values steer COLLECTIVE routing and
+#: therefore must never be half-read: a file from an older schema is ignored
+#: wholesale (treated as a cold cache) rather than partially interpreted
+#: with drifted key/field meanings. Every AUTO resolver reads its crossover
+#: through :func:`agreed_cfg_value` — ``scripts/check_tuned_defaults.py``
+#: lints that no resolver falls back to a bare rank-local ``cache.get``.
 SCHEMA_VERSION = 2
 _SCHEMA_KEY = "__schema__"
 

@@ -770,6 +770,66 @@ def bench_prefill_overlap(on_tpu):
     return out
 
 
+def bench_serving(on_tpu):
+    """Continuous-batching offered-load sweep (the serving/ subsystem):
+    drives an ``InferenceServer`` over 16 mixed prompt/gen requests at two
+    load points — "burst" (all requests offered at t=0, pure batching
+    throughput) and "steady" (20 ms inter-arrival gap, joins landing
+    mid-decode) — and reports aggregate generated tokens/s plus p50/p99
+    TTFT. Runs the tiny test-dense model on ONE device in both smoke and
+    TPU modes: the section measures the serving loop (join/chunk
+    interleave, fixed-shape compile reuse), not model FLOPs — the per-chip
+    kernel sections above already cover those."""
+    import time
+
+    from triton_dist_tpu.models import PRESETS, DenseLLM, Engine
+    from triton_dist_tpu.runtime.mesh import initialize_distributed
+    from triton_dist_tpu.serving import InferenceServer
+
+    ctx = initialize_distributed(
+        devices=jax.devices()[:1], axis_names=("tp",), set_default=False
+    )
+    model = DenseLLM(PRESETS["test-dense"], ctx, key=jax.random.PRNGKey(1))
+    eng = Engine(model, backend="xla", max_len=64)
+
+    slots, chunk = 4, 8
+    reqs = [
+        ([(7 * i + j) % 256 for j in range(4 + (3 * i) % 8)], 6 + (5 * i) % 8)
+        for i in range(16)
+    ]
+    out = {
+        "serving_requests": len(reqs),
+        "serving_slots": slots,
+        "serving_chunk": chunk,
+    }
+
+    # Warmup compiles every distinct prefill shape (jit keys off prompt
+    # length) plus the decode-chunk program, so the timed sweeps measure
+    # the serving loop rather than compilation.
+    warm = InferenceServer(eng, num_slots=slots, chunk=chunk)
+    for plen in sorted({len(p) for p, _ in reqs}):
+        warm.submit(list(range(plen)), 2)
+    warm.run()
+
+    for label, gap in (("burst", 0.0), ("steady", 0.02)):
+        srv = InferenceServer(eng, num_slots=slots, chunk=chunk)
+        handles = [
+            srv.submit(p, g, arrival_time_s=i * gap)
+            for i, (p, g) in enumerate(reqs)
+        ]
+        t0 = time.perf_counter()
+        srv.run()
+        wall = time.perf_counter() - t0
+        toks = sum(len(h.tokens) for h in handles)
+        ttfts = sorted(h.ttft_s for h in handles if h.ttft_s is not None)
+        out[f"serving_{label}_tokens_per_s"] = round(toks / wall, 1)
+        out[f"serving_{label}_ttft_p50_ms"] = round(1e3 * ttfts[len(ttfts) // 2], 2)
+        out[f"serving_{label}_ttft_p99_ms"] = round(
+            1e3 * ttfts[min(len(ttfts) - 1, int(len(ttfts) * 0.99))], 2
+        )
+    return out
+
+
 def bench_dma_overlap_capture(on_tpu):
     """DURATION-overlap evidence in the driver record (r4 verdict missing
     #4's on-chip half): capture an XProf trace of the fused AG-GEMM kernel
@@ -1331,6 +1391,15 @@ def main():
         emit()
     else:
         extra["prefill_overlap_skipped"] = "budget"
+    if remaining() > 45:
+        phase("serving")
+        try:
+            absorb(bench_serving(on_tpu))
+        except Exception as e:  # noqa: BLE001
+            extra["serving_error"] = f"{type(e).__name__}"
+        emit()
+    else:
+        extra["serving_skipped"] = "budget"
     if remaining() > 60:
         phase("dma_overlap")
         try:

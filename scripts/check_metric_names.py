@@ -21,10 +21,11 @@ offending line — for a call site that genuinely needs to forward a
 caller-supplied name (none exist today; keep it that way).
 
 Usage: ``python scripts/check_metric_names.py [paths...]`` (default:
-``triton_dist_tpu/`` and ``bench.py``). Exit 1 with ``file:line``
-diagnostics on violations. Scans by AST, so aliased imports
-(``from ... import telemetry as t``) are caught too, as long as the module
-is bound to a name containing ``telemetry``.
+``triton_dist_tpu/`` — which includes the ``serving/`` package and its
+``tdt_serving_*`` series — plus ``bench.py`` and ``scripts/``). Exit 1
+with ``file:line`` diagnostics on violations. Scans by AST, so aliased
+imports (``from ... import telemetry as t``) are caught too, as long as
+the module is bound to a name containing ``telemetry``.
 """
 
 from __future__ import annotations
@@ -35,7 +36,7 @@ import re
 import sys
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
-DEFAULT_ROOTS = (REPO / "triton_dist_tpu", REPO / "bench.py")
+DEFAULT_ROOTS = (REPO / "triton_dist_tpu", REPO / "bench.py", REPO / "scripts")
 
 WAIVER = "# metric-name-ok:"
 

@@ -212,29 +212,141 @@ class Engine:
 
         self._generate = generate
 
-    # ------------------------------------------------------------------ kv
-    def _pad_fn(self, pad: int):
-        """One compiled pad-concat per pad size (jit caches key off the
-        function object — a fresh lambda per call would recompile every
-        serve())."""
-        fns = self.__dict__.setdefault("_pad_fns", {})
-        if pad not in fns:
-            fns[pad] = jax.jit(
-                lambda k, v: (
-                    jnp.concatenate([k, jnp.zeros(k.shape[:3] + (pad, k.shape[4]), k.dtype)], axis=3),
-                    jnp.concatenate([v, jnp.zeros(v.shape[:3] + (pad, v.shape[4]), v.dtype)], axis=3),
-                ),
-                out_shardings=(self._kv_sharding, self._kv_sharding),
-            )
-        return fns[pad]
+        # ---- step-granular serving programs (serving/ subsystem) ----------
+        # Everything below stays FIXED-SHAPE: slot index and prompt length
+        # are traced scalars, the KV update operand is always the full
+        # padded (L, 1, Hkv, max_len, D) buffer, and the decode chunk is one
+        # compiled program per chunk size — batch composition (which slots
+        # are live, how long each prompt was) never recompiles. Defined in
+        # _build so a degraded-mode rebuild refreshes them alongside
+        # prefill/generate (fresh closures retrace with the new backend).
+        max_len = self.max_len
+        len_sharding = ctx.sharding(*len_spec)
 
+        def pad_to_max(k, v):
+            shape = k.shape[:3] + (max_len,) + k.shape[4:]
+            return (
+                jax.lax.dynamic_update_slice(jnp.zeros(shape, k.dtype), k, (0, 0, 0, 0, 0)),
+                jax.lax.dynamic_update_slice(jnp.zeros(shape, v.dtype), v, (0, 0, 0, 0, 0)),
+            )
+
+        self._pad_to_max = jax.jit(
+            pad_to_max, out_shardings=(self._kv_sharding, self._kv_sharding)
+        )
+
+        def scatter_slot(kb, vb, kn, vn, lengths, slot, seq):
+            return (
+                jax.lax.dynamic_update_slice(kb, kn, (0, slot, 0, 0, 0)),
+                jax.lax.dynamic_update_slice(vb, vn, (0, slot, 0, 0, 0)),
+                jax.lax.dynamic_update_slice(lengths, seq[None], (slot,)),
+            )
+
+        self._scatter_slot = jax.jit(
+            scatter_slot, donate_argnums=(0, 1),
+            out_shardings=(self._kv_sharding, self._kv_sharding, len_sharding),
+        )
+
+        @partial(jax.jit, static_argnums=(7,), donate_argnums=(3, 4))
+        def decode_chunk(params, extra, token, ks, vs, lengths, remaining, chunk, key):
+            bsz = token.shape[0]
+            out0 = jnp.full((bsz, chunk), -1, jnp.int32)
+
+            def body(i, carry):
+                out, token, ks, vs, lengths, remaining, key = carry
+                active = remaining > 0
+                logits, ks, vs = self._decode_shard(params, extra, token, ks, vs, lengths)
+                key, sub = jax.random.split(key)
+                nxt = sample_token(
+                    logits, sub, self.sample_method, self.temperature, self.top_p
+                )
+                # Inactive slots keep re-feeding their last token: their row
+                # still flows through the fixed-shape batch, but the junk it
+                # produces is masked out of the output, their lengths freeze
+                # (the KVCache.inc_offset active-mask rule), and the only KV
+                # it writes lands at the frozen `lengths` position — the
+                # slot's next unwritten row, fully overwritten by the next
+                # tenant's prefill scatter.
+                nxt = jnp.where(active, nxt, token)
+                out = out.at[:, i].set(jnp.where(active, nxt, jnp.int32(-1)))
+                step = active.astype(lengths.dtype)
+                return (out, nxt, ks, vs, lengths + step, remaining - step, key)
+
+            carry = (out0, token, ks, vs, lengths, remaining, key)
+            out, token, ks, vs, lengths, remaining, _ = jax.lax.fori_loop(
+                0, chunk, body, carry
+            )
+            return out, token, ks, vs, lengths, remaining
+
+        self._decode_chunk = decode_chunk
+
+    # ------------------------------------------------------------------ kv
     def _make_cache(self, ks: jax.Array, vs: jax.Array, seq: int) -> KVCache:
-        """Pad prefill caches to max_len into a KVCache handle."""
-        pad = self.max_len - ks.shape[3]
-        if pad > 0:
-            ks, vs = self._pad_fn(pad)(ks, vs)
+        """Pad prefill caches to max_len into a KVCache handle.
+
+        ONE jitted ``dynamic_update_slice`` into a preallocated max_len
+        buffer (``_pad_to_max``) — jit's own shape cache keys off the
+        prefill seq, so serving many distinct prompt lengths reuses a single
+        function object instead of the old per-pad-size concat-lambda dict
+        that minted (and kept) a fresh executable per distinct pad."""
+        if ks.shape[3] < self.max_len:
+            ks, vs = self._pad_to_max(ks, vs)
         lengths = jnp.full((ks.shape[1],), seq, jnp.int32)
         return KVCache(k=ks, v=vs, lengths=lengths)
+
+    # ------------------------------------------------- serving (slot-granular)
+    def alloc_slots(self, num_slots: int) -> KVCache:
+        """Fresh zeroed KV for a fixed batch of ``num_slots`` serving slots
+        (each slot owns a full max_len row — the scheduler's KV budget)."""
+        c = self.model.config
+        return KVCache.create(
+            c.num_layers, num_slots, c.num_kv_heads, self.max_len, c.head_dim,
+            dtype=jnp.dtype(c.dtype), sharding=self._kv_sharding,
+        )
+
+    def prefill_into_slot(self, cache: KVCache, slot: int, input_ids: jax.Array,
+                          key: jax.Array | None = None):
+        """Prefill ONE request (bsz=1) and scatter its KV into slot ``slot``
+        of the serving cache — the join step of continuous batching.
+
+        Returns ``(token0, cache')``: token0 is the request's first
+        generated token, sampled from the prefill logits exactly as
+        ``serve`` does, and cache' has the slot's lengths set to the prompt
+        length. The scatter writes the full padded max_len row, so slot
+        reuse never sees a previous tenant's KV. The slot index is a traced
+        scalar — joining into a different slot never recompiles."""
+        bsz, seq = input_ids.shape
+        assert bsz == 1, "prefill_into_slot joins one request at a time"
+        assert seq <= self.max_len
+        if key is None:
+            key = jax.random.PRNGKey(0)
+        logits, ks, vs = self._prefill(self.model.params, input_ids)
+        if seq < self.max_len:
+            ks, vs = self._pad_to_max(ks, vs)
+        k2, v2, lengths = self._scatter_slot(
+            cache.k, cache.v, ks, vs, cache.lengths,
+            jnp.int32(slot), jnp.int32(seq),
+        )
+        key, sub = jax.random.split(key)
+        token0 = sample_token(logits, sub, self.sample_method, self.temperature, self.top_p)
+        return token0[0], KVCache(k=k2, v=v2, lengths=lengths)
+
+    def decode_steps(self, cache: KVCache, tokens: jax.Array, remaining: jax.Array,
+                     chunk: int, key: jax.Array | None = None):
+        """Run ``chunk`` decode steps over the slot batch with a per-slot
+        active mask (``remaining > 0``): finished/free slots neither advance
+        their lengths nor contribute sampled tokens (their output cells hold
+        -1). One compiled program per chunk size.
+
+        Returns ``(out (B, chunk) int32, last_tokens (B,), cache',
+        remaining')``. ``cache.k``/``cache.v`` are donated — callers must
+        replace their handle with cache'."""
+        if key is None:
+            key = jax.random.PRNGKey(0)
+        out, tok, k2, v2, lengths, rem = self._decode_chunk(
+            self.model.params, self._decode_extra, tokens, cache.k, cache.v,
+            cache.lengths, remaining, int(chunk), key,
+        )
+        return out, tok, KVCache(k=k2, v=v2, lengths=lengths), rem
 
     # ----------------------------------------------------------------- serve
     def serve(self, input_ids: jax.Array, gen_len: int, key: jax.Array | None = None,
@@ -243,15 +355,6 @@ class Engine:
         ``profile_dir`` wraps the run in an XProf capture (the reference's
         ``trace_static.json`` export hook, ``engine.py:153-179``).
         Reference ``Engine.serve`` (``engine.py:113``)."""
-        if profile_dir is not None:
-            from triton_dist_tpu.tools.profiler import trace
-
-            with trace(profile_dir):
-                out = self.serve(input_ids, gen_len, key=key)
-                # Dispatch is async: realize inside the capture or the trace
-                # stops before the device work runs.
-                jax.block_until_ready(out)
-                return out
         from triton_dist_tpu.runtime import resilience
 
         telemetry.inc("tdt_engine_serve_total", backend=self.backend)
@@ -259,17 +362,36 @@ class Engine:
             feature="collectives", name=f"engine.serve[{self.backend}]"
         )
 
+        serve_once = self._serve_once
+        if profile_dir is not None:
+            from triton_dist_tpu.tools.profiler import trace
+
+            def serve_once(ids, n, k):
+                # The trace wraps ONLY the serve work; the serve counter and
+                # the watchdog live outside, exactly once (the old recursive
+                # profiled path re-entered serve(), nesting a second
+                # watchdog inside the capture).
+                with trace(profile_dir):
+                    out = self._serve_once(ids, n, k)
+                    # Dispatch is async: realize inside the capture or the
+                    # trace stops before the device work runs.
+                    jax.block_until_ready(out)
+                    return out
+
         def fallback(ids, n, k):
             # The watchdog has already marked "collectives" degraded; rebuild
             # on the xla backend and serve the same request. Prefill re-runs
             # from input_ids, so the donated caches of the wedged attempt
             # are not needed.
             self._degrade_to_xla("serve timed out under the collective watchdog")
+            # Plain re-serve: a timed-out attempt's abandoned thread may
+            # still hold the profiler capture open, so the retry must not
+            # try to start a second trace into the same directory.
             return self._serve_once(ids, n, k)
 
         try:
             return watchdog.call(
-                self._serve_once, input_ids, gen_len, key, fallback=fallback
+                serve_once, input_ids, gen_len, key, fallback=fallback
             )
         except Exception:
             # A bounded-wait abort surfaced mid-serve (CollectiveAbortError

@@ -35,9 +35,17 @@ class KVCache:
     def max_len(self) -> int:
         return self.k.shape[3]
 
-    def inc_offset(self, n: int = 1) -> "KVCache":
-        """Reference ``kv_cache.inc_offset`` (``engine.py:170``)."""
-        return dataclasses.replace(self, lengths=self.lengths + n)
+    def inc_offset(self, n: int = 1, active: jax.Array | None = None) -> "KVCache":
+        """Reference ``kv_cache.inc_offset`` (``engine.py:170``).
+
+        With ``active`` — a (B,) bool/int mask — only active slots advance
+        (``lengths + n·active``): a finished or padded slot must not grow
+        past its real content, or the next tenant of the slot inherits a
+        phantom prefix (the serving layer's slot reuse depends on this)."""
+        if active is None:
+            return dataclasses.replace(self, lengths=self.lengths + n)
+        step = jnp.asarray(active).astype(self.lengths.dtype) * n
+        return dataclasses.replace(self, lengths=self.lengths + step)
 
 
 jax.tree_util.register_dataclass(

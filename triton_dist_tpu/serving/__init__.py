@@ -1,0 +1,23 @@
+"""Continuous-batching serving layer: scheduler + streaming server loop.
+
+See ``docs/serving.md`` for the state machines, the admission contract,
+and the ``tdt_serving_*`` metrics reference.
+"""
+
+from triton_dist_tpu.serving.scheduler import (
+    Request,
+    RequestState,
+    Scheduler,
+    Slot,
+    SlotState,
+)
+from triton_dist_tpu.serving.server import InferenceServer
+
+__all__ = [
+    "InferenceServer",
+    "Request",
+    "RequestState",
+    "Scheduler",
+    "Slot",
+    "SlotState",
+]

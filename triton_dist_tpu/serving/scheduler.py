@@ -1,0 +1,249 @@
+"""Request scheduler: admission control + slot-based continuous batching.
+
+Iteration-level (Orca-style, Yu et al. OSDI'22) scheduling over a FIXED
+batch of B slots: requests join the running batch whenever a slot frees up
+instead of waiting for the whole batch to drain, and short requests stop
+consuming decode steps the moment they finish. The KV side is the TPU
+analog of vLLM's slot management (Kwon et al., SOSP'23) flattened to fixed
+shapes: every slot owns one full ``max_len`` KV row (no paging — XLA/jit
+wants static shapes), so admission is a per-request budget check rather
+than a block-allocator walk.
+
+State machines::
+
+    slot     FREE → PREFILL → DECODE → DONE → FREE       (join/evict cycle)
+    request  QUEUED → RUNNING → DONE   |   REJECTED      (admission verdicts)
+
+Scheduling policy: FCFS by arrival. The pending queue keeps submission
+order; :meth:`Scheduler.join_free_slots` walks it in order and admits every
+request whose arrival time has passed into the lowest-indexed free slot —
+a request whose (synthetic) arrival lies in the future never blocks one
+behind it that has already arrived.
+
+Admission contract (KV-budget aware): a request is admitted only when
+``len(prompt) + max_new <= max_len`` — the whole generation must fit the
+slot's fixed KV row, so a running request can NEVER run out of cache
+mid-decode (no preemption-by-eviction; the only preemption in the system is
+the degraded-mode rebuild, see ``serving/server.py``). Oversized requests
+are rejected at submit time with ``reason="kv_budget"``; a full bounded
+queue rejects with ``reason="queue_full"``.
+
+The scheduler is pure host-side bookkeeping — it never touches jax. The
+device work (prefill scatter, masked decode chunks) lives in
+``models/engine.py``; the loop that drives both is ``InferenceServer``.
+Telemetry: ``tdt_serving_queue_depth`` / ``tdt_serving_slot_occupancy``
+gauges track every transition, counters are listed in ``docs/serving.md``.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import enum
+import itertools
+import threading
+import time
+from typing import Callable
+
+from triton_dist_tpu.runtime import telemetry
+
+
+class SlotState(enum.Enum):
+    FREE = "free"
+    PREFILL = "prefill"
+    DECODE = "decode"
+    DONE = "done"
+
+
+class RequestState(enum.Enum):
+    QUEUED = "queued"
+    RUNNING = "running"
+    DONE = "done"
+    REJECTED = "rejected"
+
+
+@dataclasses.dataclass
+class Request:
+    """One served generation request (host-side handle).
+
+    ``tokens`` accumulates every streamed token in order — it is the
+    request's durable history, and the recovery path re-prefills a slot
+    from ``prompt + tokens[:-1]`` (see ``InferenceServer._prefill_slot``),
+    so completed streams survive an engine rebuild with zero drops or
+    duplicates."""
+
+    req_id: int
+    prompt: list[int]
+    max_new: int
+    #: Offered-load arrival time, seconds relative to the server clock's
+    #: zero. The scheduler will not join the request before it "arrives".
+    arrival_time_s: float = 0.0
+    #: ``on_token(request, token, index)`` — called once per streamed token.
+    on_token: Callable[["Request", int, int], None] | None = None
+    #: ``on_finish(request)`` — called once when the stream completes.
+    on_finish: Callable[["Request"], None] | None = None
+
+    state: RequestState = RequestState.QUEUED
+    reject_reason: str | None = None
+    tokens: list[int] = dataclasses.field(default_factory=list)
+    submitted_at: float = 0.0
+    arrived_at: float = 0.0
+    first_token_at: float | None = None
+    finished_at: float | None = None
+
+    @property
+    def done(self) -> bool:
+        return self.state is RequestState.DONE
+
+    @property
+    def ttft_s(self) -> float | None:
+        """Wall seconds from (effective) arrival to the first streamed token."""
+        if self.first_token_at is None:
+            return None
+        return self.first_token_at - self.arrived_at
+
+    @property
+    def tpot_s(self) -> float | None:
+        """Mean wall seconds per token after the first (None until finished
+        or when only one token was generated)."""
+        if self.finished_at is None or self.first_token_at is None:
+            return None
+        steps = len(self.tokens) - 1
+        if steps <= 0:
+            return None
+        return (self.finished_at - self.first_token_at) / steps
+
+
+@dataclasses.dataclass
+class Slot:
+    """One fixed batch position: its state and current tenant."""
+
+    idx: int
+    state: SlotState = SlotState.FREE
+    request: Request | None = None
+
+
+class Scheduler:
+    """FCFS admission + join-on-free-slot over ``num_slots`` fixed slots.
+
+    Thread-safe on the submit side (a server thread may accept requests
+    while the serving loop runs); the slot-transition methods are meant to
+    be called from the single serving loop."""
+
+    def __init__(self, num_slots: int, max_len: int, queue_limit: int = 0):
+        assert num_slots >= 1 and max_len >= 2
+        self.num_slots = num_slots
+        self.max_len = max_len
+        self.queue_limit = queue_limit  # 0 = unbounded
+        self.slots = [Slot(idx=i) for i in range(num_slots)]
+        self._pending: collections.deque[Request] = collections.deque()
+        self._ids = itertools.count()
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------- admission
+    def submit(self, prompt, max_new: int, arrival_time_s: float = 0.0,
+               on_token=None, on_finish=None, now_s: float | None = None) -> Request:
+        """Admission-check and enqueue one request (FCFS). Returns the
+        request handle; a rejected request comes back with
+        ``state=REJECTED`` and ``reject_reason`` set — it is NOT queued."""
+        prompt = [int(t) for t in prompt]
+        req = Request(
+            req_id=next(self._ids), prompt=prompt, max_new=int(max_new),
+            arrival_time_s=float(arrival_time_s),
+            on_token=on_token, on_finish=on_finish,
+        )
+        now = time.monotonic() if now_s is None else now_s
+        req.submitted_at = now
+        telemetry.inc("tdt_serving_requests_total")
+        if not prompt or req.max_new < 1:
+            return self._reject(req, "empty")
+        if len(prompt) + req.max_new > self.max_len:
+            # KV budget: the whole generation must fit the slot's fixed
+            # max_len KV row — admitting anything larger would guarantee an
+            # out-of-cache abort mid-decode.
+            return self._reject(req, "kv_budget")
+        with self._lock:
+            if self.queue_limit and len(self._pending) >= self.queue_limit:
+                return self._reject(req, "queue_full")
+            self._pending.append(req)
+            depth = len(self._pending)
+        telemetry.set_gauge("tdt_serving_queue_depth", float(depth))
+        return req
+
+    def _reject(self, req: Request, reason: str) -> Request:
+        req.state = RequestState.REJECTED
+        req.reject_reason = reason
+        telemetry.inc("tdt_serving_admission_rejects_total", reason=reason)
+        telemetry.emit("serving_reject", req_id=req.req_id, reason=reason)
+        return req
+
+    # ------------------------------------------------------------------ joins
+    def join_free_slots(self, now_s: float) -> list[Slot]:
+        """Admit arrived requests (FCFS) into free slots; each admitted
+        request's slot moves FREE→PREFILL. Returns the slots to prefill."""
+        joined: list[Slot] = []
+        free = [s for s in self.slots if s.state is SlotState.FREE]
+        if not free:
+            return joined
+        with self._lock:
+            deferred: collections.deque[Request] = collections.deque()
+            while self._pending and free:
+                req = self._pending.popleft()
+                if req.arrival_time_s > now_s:
+                    deferred.append(req)  # not offered yet — keep its order
+                    continue
+                slot = free.pop(0)
+                req.state = RequestState.RUNNING
+                req.arrived_at = max(req.submitted_at, req.arrival_time_s)
+                slot.state = SlotState.PREFILL
+                slot.request = req
+                joined.append(slot)
+            deferred.extend(self._pending)
+            self._pending = deferred
+            depth = len(self._pending)
+        if joined:
+            telemetry.set_gauge("tdt_serving_queue_depth", float(depth))
+            self._occupancy_gauge()
+        return joined
+
+    # ------------------------------------------------------------ transitions
+    def start_decode(self, slot: Slot) -> None:
+        assert slot.state is SlotState.PREFILL, slot.state
+        slot.state = SlotState.DECODE
+
+    def finish(self, slot: Slot) -> None:
+        assert slot.state in (SlotState.PREFILL, SlotState.DECODE), slot.state
+        slot.state = SlotState.DONE
+
+    def release(self, slot: Slot) -> Request:
+        """Evict a finished slot: DONE→FREE, detach and return the tenant."""
+        assert slot.state is SlotState.DONE, slot.state
+        req = slot.request
+        slot.state = SlotState.FREE
+        slot.request = None
+        self._occupancy_gauge()
+        return req
+
+    # --------------------------------------------------------------- queries
+    def decoding_slots(self) -> list[Slot]:
+        return [s for s in self.slots if s.state is SlotState.DECODE]
+
+    def occupied_slots(self) -> list[Slot]:
+        return [s for s in self.slots if s.request is not None]
+
+    def occupancy(self) -> int:
+        return len(self.occupied_slots())
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    def next_arrival_s(self) -> float | None:
+        """Earliest pending arrival time (None when the queue is empty)."""
+        with self._lock:
+            if not self._pending:
+                return None
+            return min(r.arrival_time_s for r in self._pending)
+
+    def _occupancy_gauge(self) -> None:
+        telemetry.set_gauge("tdt_serving_slot_occupancy", float(self.occupancy()))

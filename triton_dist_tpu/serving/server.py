@@ -1,0 +1,278 @@
+"""Streaming inference server: the host loop of continuous batching.
+
+``InferenceServer`` drives one :class:`~triton_dist_tpu.models.engine.Engine`
+with the step-granular programs it exposes (``prefill_into_slot``,
+``decode_steps``) under a :class:`~triton_dist_tpu.serving.scheduler.Scheduler`:
+
+* **join** — every loop iteration first admits arrived requests (FCFS) into
+  free slots: per-request prefill, scatter into the slot's KV row, stream
+  the first sampled token (TTFT is measured to this point);
+* **decode chunk** — then runs ``TDT_SERVE_CHUNK`` decode steps over the
+  whole slot batch as ONE device dispatch with a per-slot active mask, and
+  streams each slot's newly valid tokens to its ``on_token`` callback.
+  Chunking is the host/device trade: larger chunks amortize dispatch,
+  smaller chunks tighten join latency for requests arriving mid-decode.
+
+Everything the device sees is fixed-shape (one compile per chunk size, one
+prefill compile per distinct prompt length, one scatter program total), so
+a slot batch whose composition changes every chunk never recompiles — the
+jit analog of the reference engine's per-token CUDA-graph replay, lifted to
+iteration-level scheduling.
+
+**Degraded-mode recovery without dropping the queue**: a bounded-wait abort
+(``CollectiveAbortError`` via ``resilience.consume_status``) or a
+``CollectiveWatchdog`` timeout surfacing from a join or a decode chunk
+triggers :meth:`InferenceServer._recover`: the engine rebuilds on the
+``xla`` backend (sticky degradation, same contract as ``Engine.serve``),
+a fresh slot cache is allocated (the aborted dispatch may have poisoned or
+consumed the donated buffers), and every in-flight slot re-prefills from
+its token history ``prompt + tokens[:-1]`` — the re-prefill's sampled token
+is discarded (it was already streamed), so recovery produces **zero
+dropped and zero duplicated** stream tokens. Queued requests are untouched.
+
+Env knobs::
+
+    TDT_SERVE_SLOTS   fixed slot-batch size B (default 4)
+    TDT_SERVE_CHUNK   decode steps per device dispatch (default 8)
+
+Metrics (``tdt_serving_*``, see ``docs/serving.md`` and
+``docs/observability.md``): request/completion/reject/preemption/recovery
+counters, queue-depth and slot-occupancy gauges, TTFT and per-request TPOT
+histograms.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from triton_dist_tpu.runtime import telemetry
+from triton_dist_tpu.runtime.utils import get_int_env
+from triton_dist_tpu.serving.scheduler import (
+    Request,
+    Scheduler,
+    Slot,
+    SlotState,
+)
+
+
+class InferenceServer:
+    """Continuous-batching server over one engine (host-side loop)."""
+
+    def __init__(self, engine, num_slots: int | None = None,
+                 chunk: int | None = None, queue_limit: int = 0,
+                 key: jax.Array | None = None, watchdog=None):
+        from triton_dist_tpu.runtime import resilience
+
+        self.engine = engine
+        self.num_slots = (
+            get_int_env("TDT_SERVE_SLOTS", 4) if num_slots is None else int(num_slots)
+        )
+        self.chunk = (
+            get_int_env("TDT_SERVE_CHUNK", 8) if chunk is None else int(chunk)
+        )
+        assert self.num_slots >= 1 and self.chunk >= 1
+        self.scheduler = Scheduler(self.num_slots, engine.max_len, queue_limit)
+        self.cache = engine.alloc_slots(self.num_slots)
+        # Host-authoritative per-slot decode state (tiny, synced per chunk).
+        self._last = np.zeros((self.num_slots,), np.int32)
+        self._remaining = np.zeros((self.num_slots,), np.int32)
+        self._key = jax.random.PRNGKey(0) if key is None else key
+        # retries=0: decode_steps donates the slot cache, so a timed-out
+        # attempt must NOT be re-dispatched on the same (now consumed)
+        # buffers — recovery reallocates instead.
+        self._watchdog = watchdog if watchdog is not None else (
+            resilience.CollectiveWatchdog(
+                feature="collectives", name="serving.decode", retries=0
+            )
+        )
+        self._t0 = time.monotonic()
+
+    # ------------------------------------------------------------------ clock
+    def _now(self) -> float:
+        """Server-relative clock: request arrival times are offsets on it."""
+        return time.monotonic() - self._t0
+
+    # ----------------------------------------------------------------- submit
+    def submit(self, prompt, max_new: int, arrival_time_s: float = 0.0,
+               on_token=None, on_finish=None) -> Request:
+        """Admission-check and enqueue one request; returns its handle
+        (``state=REJECTED`` + ``reject_reason`` when not admitted)."""
+        return self.scheduler.submit(
+            prompt, max_new, arrival_time_s=arrival_time_s,
+            on_token=on_token, on_finish=on_finish, now_s=self._now(),
+        )
+
+    # ------------------------------------------------------------------- loop
+    def step(self) -> bool:
+        """One scheduler iteration: join arrived requests into free slots
+        (prefill + first token), then one masked decode chunk over the slot
+        batch. Returns True when any work was done."""
+        worked = self._join_ready()
+        if not self.scheduler.decoding_slots():
+            return worked
+        self._guarded(self._decode_once, what="decode chunk")
+        return True
+
+    def run(self, poll_s: float = 0.05) -> None:
+        """Serve until the queue is drained and every slot is free.
+        Requests submitted from other threads while running are picked up;
+        with synthetic ``arrival_time_s`` offsets the loop sleeps (bounded
+        by ``poll_s``) until the next arrival is due."""
+        while True:
+            if self.step():
+                continue
+            nxt = self.scheduler.next_arrival_s()
+            if nxt is None:
+                if self.scheduler.queue_depth() == 0 and not self.scheduler.occupancy():
+                    return
+                continue
+            wait = nxt - self._now()
+            if wait > 0:
+                time.sleep(min(wait, poll_s))
+
+    # ------------------------------------------------------------------ joins
+    def _join_ready(self) -> bool:
+        joined = self.scheduler.join_free_slots(self._now())
+        for slot in joined:
+            # A recovery triggered by an EARLIER slot's failed prefill
+            # already re-prefilled every occupied slot, this one included
+            # (or finished+released it) — do not stream its first token twice.
+            if slot.request is None or slot.request.tokens:
+                continue
+            self._guarded(lambda s=slot: self._prefill_slot(s),
+                          what=f"join of request {slot.request.req_id}")
+        return bool(joined)
+
+    def _prefill_slot(self, slot: Slot) -> None:
+        """Prefill ``slot``'s tenant from its token history and arm decode.
+
+        Fresh join: history is just the prompt — sample + stream token0.
+        Recovery re-prefill: history is ``prompt + tokens[:-1]`` (the last
+        streamed token's KV is pending, exactly like a resumed decode) —
+        the prefill-sampled token is discarded, nothing streams twice."""
+        req = slot.request
+        ids = req.prompt + req.tokens[:-1]
+        self._key, sub = jax.random.split(self._key)
+        token0, self.cache = self.engine.prefill_into_slot(
+            self.cache, slot.idx, jnp.asarray([ids], jnp.int32), key=sub
+        )
+        if req.tokens:
+            self._last[slot.idx] = req.tokens[-1]
+            if slot.state is SlotState.PREFILL:
+                self.scheduler.start_decode(slot)
+            return
+        tok = int(token0)
+        self._last[slot.idx] = tok
+        self._remaining[slot.idx] = req.max_new - 1
+        self.scheduler.start_decode(slot)
+        self._stream(req, tok)
+        if self._remaining[slot.idx] == 0:
+            self._finish(slot)
+
+    # ----------------------------------------------------------------- decode
+    def _decode_once(self) -> None:
+        decoding = self.scheduler.decoding_slots()
+        pre = {s.idx: int(self._remaining[s.idx]) for s in decoding}
+        self._key, sub = jax.random.split(self._key)
+        t0 = time.perf_counter()
+        out, tok, cache, _ = self._watchdog.call(
+            self.engine.decode_steps, self.cache,
+            jnp.asarray(self._last), jnp.asarray(self._remaining),
+            self.chunk, sub,
+        )
+        self.cache = cache
+        out_np = np.asarray(out)
+        self._last = np.asarray(tok, dtype=np.int32).copy()
+        wall = time.perf_counter() - t0
+        telemetry.inc("tdt_serving_decode_chunks_total")
+        n_streamed = 0
+        for slot in decoding:
+            req = slot.request
+            n_valid = min(pre[slot.idx], self.chunk)
+            for j in range(n_valid):
+                self._stream(req, int(out_np[slot.idx, j]))
+            self._remaining[slot.idx] -= n_valid
+            n_streamed += n_valid
+            if self._remaining[slot.idx] == 0:
+                self._finish(slot)
+        if n_streamed:
+            telemetry.inc("tdt_serving_tokens_total", float(n_streamed))
+            telemetry.observe("tdt_serving_chunk_token_seconds", wall / n_streamed)
+
+    # -------------------------------------------------------------- streaming
+    def _stream(self, req: Request, token: int) -> None:
+        req.tokens.append(token)
+        now = self._now()
+        if req.first_token_at is None:
+            req.first_token_at = now
+            telemetry.observe(
+                "tdt_serving_ttft_seconds", max(now - req.arrived_at, 0.0)
+            )
+        if req.on_token is not None:
+            try:
+                req.on_token(req, token, len(req.tokens) - 1)
+            except Exception:  # a user callback must never kill the loop
+                telemetry.inc("tdt_serving_callback_errors_total", kind="token")
+
+    def _finish(self, slot: Slot) -> None:
+        from triton_dist_tpu.serving.scheduler import RequestState
+
+        req = slot.request
+        req.state = RequestState.DONE
+        req.finished_at = self._now()
+        tpot = req.tpot_s
+        if tpot is not None:
+            telemetry.observe("tdt_serving_tpot_seconds", tpot)
+        telemetry.inc("tdt_serving_requests_completed_total")
+        self.scheduler.finish(slot)
+        self.scheduler.release(slot)
+        self._remaining[slot.idx] = 0
+        if req.on_finish is not None:
+            try:
+                req.on_finish(req)
+            except Exception:
+                telemetry.inc("tdt_serving_callback_errors_total", kind="finish")
+
+    # --------------------------------------------------------------- recovery
+    def _guarded(self, fn, what: str):
+        """Run one serving step; on a degraded-mode failure (bounded-wait
+        abort or watchdog timeout), rebuild on xla WITHOUT dropping the
+        queue or any in-flight stream, then resume. Anything else raises."""
+        from triton_dist_tpu.runtime import resilience
+
+        try:
+            return fn()
+        except Exception as e:
+            recoverable = self.engine.backend != "xla" and (
+                resilience.any_degraded()
+                or isinstance(e, (resilience.CollectiveAbortError,
+                                  resilience.CollectiveTimeoutError))
+            )
+            if not recoverable:
+                raise
+            self._recover(f"{type(e).__name__} during {what}")
+            return None
+
+    def _recover(self, why: str) -> None:
+        eng = self.engine
+        occupied = self.scheduler.occupied_slots()
+        telemetry.inc("tdt_serving_recoveries_total", from_backend=eng.backend)
+        if occupied:
+            # Each in-flight slot's decode is preempted by the rebuild (the
+            # only preemption in the system) and re-prefilled from history.
+            telemetry.inc("tdt_serving_preemptions_total", float(len(occupied)))
+        telemetry.emit(
+            "serving_recovery", from_backend=eng.backend, why=why,
+            in_flight=len(occupied), queued=self.scheduler.queue_depth(),
+        )
+        eng._degrade_to_xla(why)
+        # The aborted dispatch consumed (donated) or may have poisoned the
+        # old slot cache — rebuild it whole from each tenant's durable
+        # token history. Queued requests ride along untouched.
+        self.cache = eng.alloc_slots(self.num_slots)
+        for slot in occupied:
+            self._prefill_slot(slot)

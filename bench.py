@@ -1063,6 +1063,25 @@ def main():
         except Exception:  # noqa: BLE001
             pass
         line = json.dumps({**primary, "extra": ex})
+        # Schema-versioned snapshot file alongside the BENCH line: the
+        # machine-diffable input for scripts/check_bench_regression.py
+        # (the stdout line is the driver's; the file is CI's). Written
+        # atomically on every emit so a mid-bench death still leaves the
+        # last completed sections on disk — and never allowed to break
+        # the one contract (a final well-formed stdout line).
+        try:
+            snap_path = os.environ.get(
+                "TDT_BENCH_SNAPSHOT",
+                os.path.join(bench_root, "bench_snapshot.json"),
+            )
+            if snap_path:
+                tmp = snap_path + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump({"schema": 1, "primary": primary, "extra": ex}, f,
+                              indent=1)
+                os.replace(tmp, snap_path)
+        except Exception:  # noqa: BLE001
+            pass
         if locked:
             with emit_lock:
                 print(line, flush=True)

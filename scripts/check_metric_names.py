@@ -14,7 +14,13 @@ the convention (see ``docs/observability.md``) machine-enforced:
   ``[a-z0-9_]``, at least three underscore-separated segments, ``tdt_``
   prefix;
 * ``telemetry.emit`` kinds must be literal snake-case strings (the event
-  ring is grep'd by kind; a dynamic kind is un-greppable).
+  ring is grep'd by kind; a dynamic kind is un-greppable);
+* SPAN names (``runtime.tracing``) follow the exact same registry
+  discipline: ``tracing.start_trace`` / ``root_span`` / ``point_current``
+  and ``<anything>trace<anything>.span`` / ``.record`` / ``.point`` (the
+  ``req.trace.span(...)`` call shape) must pass a literal
+  ``tdt_<subsystem>_<name>`` — a trace timeline is queried by name just
+  like a metric, so span names must not drift from metric names.
 
 Escape hatch: a trailing ``# metric-name-ok: <reason>`` comment on the
 offending line — for a call site that genuinely needs to forward a
@@ -41,9 +47,14 @@ DEFAULT_ROOTS = (REPO / "triton_dist_tpu", REPO / "bench.py", REPO / "scripts")
 WAIVER = "# metric-name-ok:"
 
 #: Registry entry points whose first argument is a METRIC name.
-METRIC_FNS = {"inc", "observe", "set_gauge", "counter_value"}
+METRIC_FNS = {"inc", "observe", "set_gauge", "counter_value", "counter_total"}
 #: Entry point whose first argument is an event KIND.
 EVENT_FNS = {"emit", "events"}
+#: Tracing entry points whose first argument is a SPAN name, recognized on
+#: receivers whose name mentions trace/tracing (``tracing.start_trace``,
+#: ``req.trace.span``, ``self._trace.record``).
+TRACING_FNS = {"span", "record", "point", "start_trace", "root_span",
+               "point_current"}
 
 METRIC_NAME = re.compile(r"^tdt_[a-z0-9]+_[a-z0-9_]+$")
 EVENT_KIND = re.compile(r"^[a-z][a-z0-9_]*$")
@@ -60,6 +71,21 @@ def _is_telemetry_call(node: ast.Call) -> str | None:
         return fn.attr
     # runtime.telemetry.inc(...) style: Attribute receiver named telemetry.
     if isinstance(recv, ast.Attribute) and recv.attr == "telemetry":
+        return fn.attr
+    return None
+
+
+def _is_tracing_call(node: ast.Call) -> str | None:
+    """Return the called function name when this is a span-name-taking call
+    on a receiver whose name mentions trace/tracing (``tracing.start_trace``,
+    ``req.trace.span``, ``self._trace.record``), else None."""
+    fn = node.func
+    if not isinstance(fn, ast.Attribute) or fn.attr not in TRACING_FNS:
+        return None
+    recv = fn.value
+    if isinstance(recv, ast.Name) and "trac" in recv.id:
+        return fn.attr
+    if isinstance(recv, ast.Attribute) and "trac" in recv.attr:
         return fn.attr
     return None
 
@@ -86,6 +112,16 @@ def check_file(path: pathlib.Path) -> list[str]:
 
     for node in ast.walk(tree):
         if not isinstance(node, ast.Call):
+            continue
+        tname = _is_tracing_call(node)
+        if tname is not None and node.args:
+            first = node.args[0]
+            if not (isinstance(first, ast.Constant) and isinstance(first.value, str)):
+                err(node, "dynamic span name — span names must be string "
+                          "literals (put dynamic dimensions in span attrs)")
+            elif not METRIC_NAME.match(first.value):
+                err(node, f"span name {first.value!r} does not match "
+                          "tdt_<subsystem>_<name> (lowercase, >=3 segments)")
             continue
         fname = _is_telemetry_call(node)
         if fname is None or not node.args:

@@ -1,15 +1,22 @@
 #!/usr/bin/env python
 """Render triton_dist_tpu telemetry snapshots.
 
-There is no in-process scrape endpoint (serving runs are batch jobs, not
-daemons): a process dumps its registry to JSON — either explicitly via
+A process exposes its registry two ways: as a JSON file — explicitly via
 ``telemetry.dump(path)`` or automatically at exit with
-``TDT_TELEMETRY_DUMP=/path/snap.json`` — and this CLI renders the file.
+``TDT_TELEMETRY_DUMP=/path/snap.json`` — or live over HTTP when
+``TDT_HTTP_PORT`` is set (``runtime/introspect.py``). Every subcommand
+takes either: a path, or an ``http://host:port`` base URL (the CLI fetches
+``/snapshot`` from it).
 
 Usage::
 
-    python scripts/tdt_metrics.py show snap.json    # human-readable summary
-    python scripts/tdt_metrics.py prom snap.json    # Prometheus exposition
+    python scripts/tdt_metrics.py show SRC          # human-readable summary
+    python scripts/tdt_metrics.py prom SRC          # Prometheus exposition
+    python scripts/tdt_metrics.py trace <id|last> SRC   # span tree of one
+                                                        # request trace
+    python scripts/tdt_metrics.py watch SRC [-n SECS] [-c COUNT]
+                                                    # poll + render counter
+                                                    # deltas between polls
     python scripts/tdt_metrics.py demo [out.json]   # tiny CPU serve -> live
                                                     # snapshot (smoke check)
 
@@ -22,13 +29,24 @@ from __future__ import annotations
 import json
 import os
 import sys
+import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 
-def _load(path: str) -> dict:
-    with open(path) as f:
+def _load(src: str) -> dict:
+    """Snapshot dict from a file path or an introspection endpoint base URL
+    (``http://127.0.0.1:8080`` → fetches ``/snapshot``)."""
+    if src.startswith(("http://", "https://")):
+        import urllib.request
+
+        url = src.rstrip("/")
+        if not url.endswith("/snapshot"):
+            url += "/snapshot"
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return json.load(r)
+    with open(src) as f:
         return json.load(f)
 
 
@@ -88,6 +106,15 @@ def cmd_show(path: str) -> int:
                 f"{len(t.get('events', []))} events, "
                 f"{t.get('n_dropped', 0)} dropped"
             )
+    tr = snap.get("traces", {})
+    if tr.get("traces"):
+        print(f"\nspan traces: {len(tr['traces'])} trace(s), "
+              f"{tr.get('n_open', 0)} open span(s) — "
+              f"`trace <id|last>` for the tree")
+        for t in tr["traces"][-10:]:
+            root = next((s for s in t["spans"] if s["parent_id"] is None), None)
+            print(f"  trace {t['trace_id']}: "
+                  f"{root['name'] if root else '?'}, {len(t['spans'])} span(s)")
     return 0
 
 
@@ -95,6 +122,100 @@ def cmd_prom(path: str) -> int:
     from triton_dist_tpu.runtime import telemetry
 
     sys.stdout.write(telemetry.to_prometheus(_load(path)))
+    return 0
+
+
+def cmd_trace(which: str, src: str) -> int:
+    """Render one trace's span tree (durations in ms, parent-indented)."""
+    snap = _load(src)
+    traces = snap.get("traces", {}).get("traces", [])
+    if not traces:
+        print(f"no span traces in {src}", file=sys.stderr)
+        return 1
+    if which == "last":
+        entry = traces[-1]
+    else:
+        try:
+            tid = int(which)
+        except ValueError:
+            print(f"trace id must be an integer or 'last', got {which!r}",
+                  file=sys.stderr)
+            return 2
+        match = [t for t in traces if t["trace_id"] == tid]
+        if not match:
+            known = [t["trace_id"] for t in traces]
+            print(f"unknown trace {tid} (known: {known})", file=sys.stderr)
+            return 1
+        entry = match[0]
+    spans = entry["spans"]
+    by_parent: dict[int | None, list[dict]] = {}
+    ids = {s["span_id"] for s in spans}
+    for s in spans:
+        # A span whose parent fell off the bounded ring renders as a root.
+        parent = s["parent_id"] if s["parent_id"] in ids else None
+        by_parent.setdefault(parent, []).append(s)
+    t0 = min(s["start_s"] for s in spans)
+
+    def render(parent: int | None, depth: int) -> None:
+        for s in sorted(by_parent.get(parent, []), key=lambda x: x["start_s"]):
+            end = s["end_s"]
+            dur = "open" if end is None else f"{(end - s['start_s']) * 1e3:.2f}ms"
+            attrs = {k: v for k, v in s["attrs"].items()}
+            at = f" {attrs}" if attrs else ""
+            print(
+                f"  {'  ' * depth}{s['name']} [+{(s['start_s'] - t0) * 1e3:.2f}ms "
+                f"{dur}]{at}"
+            )
+            render(s["span_id"], depth + 1)
+
+    print(f"trace {entry['trace_id']}: {len(spans)} span(s)")
+    render(None, 0)
+    return 0
+
+
+def cmd_watch(src: str, interval_s: float, count: int) -> int:
+    """Poll ``src`` and print counter/gauge deltas between polls — the
+    poor-operator's rate() for a live endpoint or a re-dumped file."""
+
+    def flat(snap: dict, kind: str) -> dict[str, float]:
+        out = {}
+        for name, entries in snap.get(kind, {}).items():
+            for e in entries:
+                out[name + _fmt_labels(e["labels"])] = e["value"]
+        return out
+
+    prev = None
+    for i in range(count):
+        try:
+            snap = _load(src)
+        except Exception as e:  # endpoint not up yet / file mid-write
+            print(f"[watch] poll failed: {type(e).__name__}: {e}")
+            time.sleep(interval_s)
+            continue
+        counters = flat(snap, "counters")
+        gauges = flat(snap, "gauges")
+        tr = snap.get("traces", {})
+        stamp = time.strftime("%H:%M:%S")
+        if prev is None:
+            print(f"[{stamp}] baseline: {len(counters)} counters, "
+                  f"{len(gauges)} gauges, {tr.get('n_open', 0)} open span(s)")
+        else:
+            deltas = {
+                k: v - prev.get(k, 0.0)
+                for k, v in counters.items()
+                if v != prev.get(k, 0.0)
+            }
+            if deltas:
+                print(f"[{stamp}] deltas over {interval_s:g}s:")
+                for k, d in sorted(deltas.items()):
+                    print(f"  {k} +{d:g}")
+            else:
+                print(f"[{stamp}] no counter movement")
+            for k, v in sorted(gauges.items()):
+                print(f"  {k} = {v:g}")
+        prev = counters
+        if i + 1 < count:
+            time.sleep(interval_s)
     return 0
 
 
@@ -141,6 +262,21 @@ def main(argv: list[str]) -> int:
         return cmd_show(argv[1])
     if len(argv) >= 2 and argv[0] == "prom":
         return cmd_prom(argv[1])
+    if len(argv) >= 3 and argv[0] == "trace":
+        return cmd_trace(argv[1], argv[2])
+    if len(argv) >= 2 and argv[0] == "watch":
+        interval, count = 2.0, 10
+        rest = argv[2:]
+        i = 0
+        while i < len(rest):
+            if rest[i] == "-n" and i + 1 < len(rest):
+                interval = float(rest[i + 1]); i += 2
+            elif rest[i] == "-c" and i + 1 < len(rest):
+                count = int(rest[i + 1]); i += 2
+            else:
+                print(f"unknown watch arg {rest[i]!r}", file=sys.stderr)
+                return 2
+        return cmd_watch(argv[1], interval, count)
     if argv and argv[0] == "demo":
         return cmd_demo(argv[1] if len(argv) > 1 else None)
     print(__doc__, file=sys.stderr)

@@ -93,13 +93,15 @@ def test_bench_distinguishes_dead_tunnel_at_startup():
 
 
 @pytest.mark.timeout(600)
-def test_bench_full_run_streams_lines_cpu():
+def test_bench_full_run_streams_lines_cpu(tmp_path):
     """A healthy CPU run prints MULTIPLE well-formed lines (streamed after
     each section) and the last one is the complete result."""
     # Budget sized so the CPU run completes the probe/mega/flash sections and
     # budget-skips the slow interpret-mode extras rather than risking the
     # watchdog mid-extra.
-    r = _run_bench({"TDT_BENCH_BUDGET_S": "120"}, timeout=540)
+    snap_path = tmp_path / "bench_snapshot.json"
+    r = _run_bench({"TDT_BENCH_BUDGET_S": "120",
+                    "TDT_BENCH_SNAPSHOT": str(snap_path)}, timeout=540)
     assert r.returncode == 0, (r.stdout, r.stderr)
     lines = _lines(r)
     assert len(lines) >= 3  # probe, mega-skip, flash, extras..., final
@@ -110,3 +112,19 @@ def test_bench_full_run_streams_lines_cpu():
     # the final line's (keys never disappear on a healthy run).
     for l in lines:
         assert set(l["extra"]).issubset(set(last["extra"]) | {"error", "phase"})
+    # The schema-versioned snapshot landed next to the BENCH line and agrees
+    # with the final stdout line — the machine-diffable input for
+    # scripts/check_bench_regression.py.
+    snap = json.loads(snap_path.read_text())
+    assert snap["schema"] == 1
+    assert snap["primary"]["metric"] == last["metric"]
+    assert snap["primary"]["value"] == last["value"]
+    assert set(last["extra"]) == set(snap["extra"])
+    # And the regression gate accepts it against itself: identical inputs
+    # must be rc=0 with zero regressions.
+    g = subprocess.run(
+        [sys.executable, "scripts/check_bench_regression.py",
+         str(snap_path), str(snap_path)],
+        capture_output=True, text=True, cwd=BENCH_ROOT,
+    )
+    assert g.returncode == 0, g.stdout + g.stderr

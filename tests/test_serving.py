@@ -117,6 +117,29 @@ def test_admission_rejects():
     assert ok.state is RequestState.QUEUED
 
 
+def test_queue_wait_histogram_records_arrival_to_admission():
+    """Queue delay is its own histogram (TTFT no longer has to conflate
+    queueing with prefill): wait = join time - effective arrival."""
+    sched = Scheduler(num_slots=1, max_len=MAX_LEN)
+    a = sched.submit([1, 2], max_new=2, arrival_time_s=0.0, now_s=0.0)
+    b = sched.submit([3, 4], max_new=2, arrival_time_s=1.0, now_s=0.0)
+    # a joins at t=0.5 after waiting 0.5s; b hasn't arrived yet.
+    (s,) = sched.join_free_slots(now_s=0.5)
+    assert s.request is a
+    snap = telemetry.snapshot()["histograms"]["tdt_serving_queue_wait_seconds"]
+    assert snap[0]["count"] == 1
+    assert abs(snap[0]["sum"] - 0.5) < 1e-9
+    # b joins at t=3.0 after "arriving" at t=1.0: wait is 2.0s, measured
+    # from the synthetic arrival, not from submit.
+    sched.finish(s)
+    sched.release(s)
+    (s2,) = sched.join_free_slots(now_s=3.0)
+    assert s2.request is b
+    snap = telemetry.snapshot()["histograms"]["tdt_serving_queue_wait_seconds"]
+    assert snap[0]["count"] == 2
+    assert abs(snap[0]["sum"] - 2.5) < 1e-9
+
+
 def test_fcfs_join_evict_ordering():
     sched = Scheduler(num_slots=2, max_len=MAX_LEN)
     reqs = [sched.submit([1, 2], max_new=3) for _ in range(4)]

@@ -373,3 +373,95 @@ def test_metric_name_lint_flags_violations(tmp_path):
         assert f"bad_site.py:{line}" in r.stdout, r.stdout
     for line in (8, 9):
         assert f"bad_site.py:{line}" not in r.stdout, r.stdout
+
+
+def test_span_name_lint_flags_violations(tmp_path):
+    """Span names ride the same registry discipline as metric names: the
+    lint recognizes tracing call shapes (module fns and req.trace.span)."""
+    bad = tmp_path / "bad_spans.py"
+    bad.write_text(
+        "from triton_dist_tpu.runtime import tracing\n"
+        "def f(req, name):\n"
+        "    t = tracing.start_trace('serving_request')\n"  # no tdt_ prefix
+        "    with req.trace.span(name):\n"  # dynamic span name
+        "        pass\n"
+        "    req.trace.record('tdt_ok_span_name', 0.0, 1.0)\n"  # OK
+        "    tracing.point_current('tdt_bad')\n"  # too few segments
+        "    t.finish()\n"  # not a span-name call: ignored
+    )
+    r = subprocess.run([sys.executable, LINT, str(bad)], capture_output=True, text=True)
+    assert r.returncode == 1
+    for line in (3, 4, 7):
+        assert f"bad_spans.py:{line}" in r.stdout, r.stdout
+    for line in (6, 8):
+        assert f"bad_spans.py:{line}" not in r.stdout, r.stdout
+
+
+# ------------------------------------------------------- concurrent readers
+
+
+def test_snapshot_paths_survive_concurrent_writes():
+    """The introspection endpoint reads the registry and the span rings from
+    a second thread while the serving loop writes — every reader must see a
+    consistent copy (the thread-safety contract in telemetry's module doc).
+    Hammer all reader paths against parallel writers and require zero
+    exceptions and parseable output throughout."""
+    import threading
+
+    from triton_dist_tpu.runtime import tracing
+
+    stop = threading.Event()
+    errors: list[BaseException] = []
+
+    def writer(tag: str):
+        i = 0
+        try:
+            while not stop.is_set():
+                telemetry.inc("tdt_test_stress_total", worker=tag)
+                telemetry.set_gauge("tdt_test_stress_depth", float(i % 5))
+                telemetry.observe("tdt_test_stress_seconds", 1e-3 * (i % 7 + 1))
+                telemetry.emit("stress_tick", worker=tag, i=i)
+                t = tracing.start_trace("tdt_test_stress_trace", worker=tag)
+                with t.span("tdt_test_stress_child"):
+                    tracing.point_current("tdt_test_stress_mark")
+                t.finish()
+                i += 1
+        except BaseException as e:  # noqa: BLE001 - collected for the assert
+            errors.append(e)
+
+    def reader():
+        try:
+            while not stop.is_set():
+                snap = telemetry.snapshot()
+                json.dumps(snap)  # JSON-safe all the way down
+                telemetry.to_prometheus(snap)
+                telemetry.summary()
+                telemetry.events("stress_tick")
+                telemetry.counter_total("tdt_test_stress_total")
+                json.dumps(tracing.snapshot_traces())
+                tracing.to_chrome()
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer, args=(f"w{k}",)) for k in range(2)]
+    threads += [threading.Thread(target=reader) for _ in range(3)]
+    for t in threads:
+        t.start()
+    import time as _time
+
+    _time.sleep(0.6)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    assert not any(t.is_alive() for t in threads)
+    assert not errors, errors
+    # The writers actually wrote (the stress was real).
+    assert telemetry.counter_total("tdt_test_stress_total") > 0
+
+
+def test_counter_total_sums_across_label_sets():
+    telemetry.inc("tdt_test_multi_total", peer=0)
+    telemetry.inc("tdt_test_multi_total", peer=1)
+    telemetry.inc("tdt_test_multi_total", 3.0, peer=1)
+    assert telemetry.counter_total("tdt_test_multi_total") == 5.0
+    assert telemetry.counter_total("tdt_test_absent_total") == 0.0

@@ -680,3 +680,125 @@ def test_tuned_defaults_lint_flags_violations(tmp_path):
         cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     )
     assert r.returncode == 0, r.stdout + r.stderr
+
+
+# ---------------------------------------------------- bench regression gate
+
+
+def _run_gate(*args):
+    import subprocess
+    import sys
+
+    return subprocess.run(
+        [sys.executable, "scripts/check_bench_regression.py", *args],
+        capture_output=True, text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+
+
+def _write_bench(tmp_path, name, shape, metrics):
+    """One BENCH fixture in any of the three accepted shapes."""
+    primary_name, primary_value = "flash_attn_causal_bf16_tflops", metrics.pop(
+        "flash_attn_causal_bf16_tflops"
+    )
+    if shape == "snapshot":
+        doc = {"schema": 1,
+               "primary": {"metric": primary_name, "value": primary_value},
+               "extra": metrics}
+    elif shape == "driver":
+        doc = {"n": 6, "cmd": "python bench.py", "rc": 0, "tail": "...",
+               "parsed": {"metric": primary_name, "value": primary_value,
+                          "extra": metrics}}
+    else:  # raw BENCH line
+        doc = {"metric": primary_name, "value": primary_value,
+               "unit": "TFLOP/s", "extra": metrics}
+    p = tmp_path / name
+    p.write_text(json.dumps(doc))
+    return str(p)
+
+
+BASE_METRICS = {
+    "flash_attn_causal_bf16_tflops": 100.0,
+    "serving_burst_tokens_per_s": 50.0,
+    "serving_burst_ttft_p99_ms": 20.0,
+    "gdn_speedup_vs_scan": 3.0,
+    "dead_section_tflops": 0.0,   # dead-tunnel artifact: never gated
+    "serving_requests": 16,        # informational: never gated
+}
+
+
+def test_bench_regression_gate_passes_unchanged_pair(tmp_path):
+    """Acceptance: an unchanged pair exits 0 — across all three accepted
+    input shapes, including a shape-mixed comparison."""
+    a = _write_bench(tmp_path, "a.json", "snapshot", dict(BASE_METRICS))
+    b = _write_bench(tmp_path, "b.json", "driver", dict(BASE_METRICS))
+    c = _write_bench(tmp_path, "c.json", "raw", dict(BASE_METRICS))
+    for base, cand in ((a, a), (a, b), (b, c)):
+        r = _run_gate(base, cand)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "0 regression(s)" in r.stdout
+
+
+def test_bench_regression_gate_catches_tokens_per_s_drop(tmp_path):
+    """Acceptance: a >10% tokens/s regression exits non-zero and names the
+    regressed metric; jitter inside the band stays green."""
+    base = _write_bench(tmp_path, "base.json", "snapshot", dict(BASE_METRICS))
+    regressed = dict(BASE_METRICS)
+    regressed["serving_burst_tokens_per_s"] = 40.0   # -20% < -10% band
+    cand = _run_gate(
+        base, _write_bench(tmp_path, "regr.json", "driver", regressed)
+    )
+    assert cand.returncode == 1, cand.stdout + cand.stderr
+    assert "REGRESSION" in cand.stdout
+    assert "serving_burst_tokens_per_s" in cand.stdout
+
+    jitter = dict(BASE_METRICS)
+    jitter["serving_burst_tokens_per_s"] = 46.0      # -8% inside the band
+    jitter["flash_attn_causal_bf16_tflops"] = 108.0  # +8% improvement
+    r = _run_gate(base, _write_bench(tmp_path, "jit.json", "snapshot", jitter))
+    assert r.returncode == 0, r.stdout
+
+
+def test_bench_regression_gate_directions_and_skips(tmp_path):
+    """Lower-is-better metrics gate on INCREASES; zero-baseline and
+    informational metrics never gate."""
+    base = _write_bench(tmp_path, "base.json", "snapshot", dict(BASE_METRICS))
+    worse = dict(BASE_METRICS)
+    worse["serving_burst_ttft_p99_ms"] = 40.0   # latency doubled -> bad
+    worse["dead_section_tflops"] = 999.0        # 0.0 baseline: skipped
+    worse["serving_requests"] = 99              # informational: skipped
+    r = _run_gate(base, _write_bench(tmp_path, "w.json", "snapshot", worse))
+    assert r.returncode == 1
+    assert "serving_burst_ttft_p99_ms" in r.stdout
+    assert "zero-baseline" in r.stdout
+    out_lines = [l for l in r.stdout.splitlines() if "REGRESSION" in l]
+    assert not any("dead_section" in l or "serving_requests" in l
+                   for l in out_lines)
+
+
+def test_bench_regression_gate_tolerance_flags(tmp_path):
+    base = _write_bench(tmp_path, "base.json", "snapshot", dict(BASE_METRICS))
+    cand_metrics = dict(BASE_METRICS)
+    cand_metrics["serving_burst_tokens_per_s"] = 42.0  # -16%
+    cand = _write_bench(tmp_path, "cand.json", "snapshot", cand_metrics)
+    # Default band (10%): regression. Widened band: green — globally or
+    # for that one metric.
+    assert _run_gate(base, cand).returncode == 1
+    assert _run_gate(base, cand, "--tol", "0.25").returncode == 0
+    assert _run_gate(
+        base, cand, "--tol-metric", "serving_burst_tokens_per_s=0.25"
+    ).returncode == 0
+
+
+def test_bench_regression_gate_error_paths(tmp_path):
+    base = _write_bench(tmp_path, "base.json", "snapshot", dict(BASE_METRICS))
+    assert _run_gate().returncode == 2                      # usage
+    assert _run_gate(base).returncode == 2                  # one file only
+    bad = tmp_path / "bad.json"
+    bad.write_text("not json {")
+    assert _run_gate(base, str(bad)).returncode == 2        # parse error
+    assert _run_gate(base, str(tmp_path / "nope.json")).returncode == 2
+    # Vacuous diffs can be rejected: no common gateable metrics.
+    empty = tmp_path / "empty.json"
+    empty.write_text(json.dumps({"schema": 1, "primary": {}, "extra": {}}))
+    assert _run_gate(base, str(empty), "--require-common", "1").returncode == 2

@@ -1,0 +1,438 @@
+"""Tracing tests: span-model semantics, the serving-stack thread-through
+(the chrome-chain acceptance bar), chaos recovery spans, and the live
+introspection endpoint exercised against a real serving loop.
+
+Same substrate rules as ``test_serving.py``: CPU world=1 (collectives
+short-circuit to XLA), generic-interpreter fallback for the single-device
+Pallas kernels. The span ring and the sampling accumulator are
+process-global like the telemetry registry, so every test resets both.
+"""
+
+import json
+import os
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from triton_dist_tpu.runtime import introspect, resilience, telemetry, tracing
+from triton_dist_tpu.runtime.platform import tpu_interpret_available
+from triton_dist_tpu.serving import InferenceServer
+
+MAX_LEN = 32
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _single_device_kernels():
+    if tpu_interpret_available():
+        yield
+        return
+    prev = os.environ.get("TDT_INTERPRET_FALLBACK")
+    os.environ["TDT_INTERPRET_FALLBACK"] = "1"
+    jax.clear_caches()
+    yield
+    if prev is None:
+        os.environ.pop("TDT_INTERPRET_FALLBACK", None)
+    else:
+        os.environ["TDT_INTERPRET_FALLBACK"] = prev
+    jax.clear_caches()
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    telemetry.reset()
+    tracing.reset()
+    resilience.reset_degradation()
+    yield
+    telemetry.reset()
+    tracing.reset()
+    resilience.reset_degradation()
+
+
+@pytest.fixture(scope="module")
+def model1():
+    from triton_dist_tpu.models import PRESETS, DenseLLM
+    from triton_dist_tpu.runtime.mesh import initialize_distributed
+    from triton_dist_tpu.runtime.platform import cpu_mesh
+
+    m = cpu_mesh((1,), ("tp",))
+    ctx = initialize_distributed(
+        devices=list(m.devices.flat), axis_names=("tp",), set_default=False
+    )
+    return DenseLLM(PRESETS["test-dense"], ctx, key=jax.random.PRNGKey(1))
+
+
+def make_engine(model1, backend="xla"):
+    from triton_dist_tpu.models import Engine
+
+    return Engine(model1, backend=backend, max_len=MAX_LEN)
+
+
+# ================================================================ span model
+
+
+def test_span_nesting_and_ambient_parenting():
+    t = tracing.start_trace("tdt_test_trace", req_id=1)
+    assert t.sampled
+    assert tracing.current_span() is None
+    with t.span("tdt_test_outer") as outer:
+        assert tracing.current_span() is outer
+        assert tracing.current_correlation() == (t.trace_id, outer["span_id"])
+        with t.span("tdt_test_inner") as inner:
+            assert inner["parent_id"] == outer["span_id"]
+    assert tracing.current_span() is None
+    t.finish()
+    spans = {s["name"]: s for s in tracing.spans(t.trace_id)}
+    assert spans["tdt_test_outer"]["parent_id"] == t.root_id
+    assert spans["tdt_test_inner"]["parent_id"] == spans["tdt_test_outer"]["span_id"]
+    # Every span closed with end >= start, all in one trace.
+    for s in spans.values():
+        assert s["end_s"] >= s["start_s"]
+        assert s["trace_id"] == t.trace_id
+
+
+def test_retroactive_record_and_points():
+    t = tracing.start_trace("tdt_test_trace")
+    t0 = tracing.now_s()
+    sid = t.record("tdt_test_retro", t0 - 0.5, t0 - 0.25, slot=3)
+    assert isinstance(sid, int)
+    # point_current outside any live span is a no-op, not an error.
+    tracing.point_current("tdt_test_orphan", x=1)
+    with t.span("tdt_test_live"):
+        tracing.point_current("tdt_test_mark", peer=2)
+    t.finish()
+    spans = {s["name"]: s for s in tracing.spans(t.trace_id)}
+    assert "tdt_test_orphan" not in spans
+    retro = spans["tdt_test_retro"]
+    assert retro["span_id"] == sid and retro["attrs"]["slot"] == 3
+    assert abs((retro["end_s"] - retro["start_s"]) - 0.25) < 1e-6
+    mark = spans["tdt_test_mark"]
+    assert mark["parent_id"] == spans["tdt_test_live"]["span_id"]
+    assert mark["end_s"] == mark["start_s"]  # zero-duration
+
+
+def test_name_stays_usable_as_attribute_key():
+    """Span names are positional-only, so ``name=...`` lands in attrs —
+    the watchdog's timeout point labels which collective timed out."""
+    t = tracing.start_trace("tdt_test_trace", name="outer")
+    with t.span("tdt_test_live", name="inner"):
+        tracing.point_current("tdt_test_mark", name="_ring_ag_kernel")
+    t.point("tdt_test_point", name="p")
+    t.finish()
+    spans = {s["name"]: s for s in tracing.spans(t.trace_id)}
+    assert spans["tdt_test_trace"]["attrs"]["name"] == "outer"
+    assert spans["tdt_test_live"]["attrs"]["name"] == "inner"
+    assert spans["tdt_test_mark"]["attrs"]["name"] == "_ring_ag_kernel"
+    assert spans["tdt_test_point"]["attrs"]["name"] == "p"
+
+
+def test_finish_emits_ring_event_and_is_idempotent():
+    t = tracing.start_trace("tdt_test_trace")
+    with t.span("tdt_test_child"):
+        pass
+    t.finish(status="ok")
+    t.finish(status="ok")  # second finish: no-op, no duplicate event
+    evs = telemetry.events("trace")
+    assert len(evs) == 1
+    assert evs[0]["trace_id"] == t.trace_id
+    assert evs[0]["name"] == "tdt_test_trace"
+    assert evs[0]["dur_s"] >= 0
+
+
+def test_sampling_is_deterministic(monkeypatch):
+    monkeypatch.setenv("TDT_TRACE_SAMPLE", "0.5")
+    tracing.reset()  # restart the error-feedback accumulator
+    traces = [tracing.start_trace("tdt_test_trace", i=i) for i in range(6)]
+    sampled = [t.sampled for t in traces]
+    assert sampled == [False, True, False, True, False, True]
+    # Unsampled handles are the shared no-op: every method safe, no spans.
+    t = traces[0]
+    with t.span("tdt_test_child") as sp:
+        assert sp is None
+    assert t.record("tdt_test_retro", 0.0, 1.0) is None
+    t.finish()
+    assert len(tracing.trace_ids()) == 3
+
+
+def test_disabled_telemetry_disables_tracing():
+    telemetry.reset(enabled_override=False)
+    t = tracing.start_trace("tdt_test_trace")
+    assert t is tracing.NOOP_TRACE and not t.sampled
+    with t.span("tdt_test_child"):
+        pass
+    t.finish()
+    assert tracing.spans() == []
+    assert not tracing.enabled()
+
+
+def test_span_ring_is_bounded(monkeypatch):
+    monkeypatch.setenv("TDT_SPAN_RING", "8")
+    tracing.reset()
+    t = tracing.start_trace("tdt_test_trace")
+    for i in range(30):
+        t.record("tdt_test_retro", float(i), float(i) + 0.5, i=i)
+    spans = tracing.spans()
+    assert len(spans) == 8
+    # Oldest evicted first: the survivors are the newest 8.
+    assert [s["attrs"]["i"] for s in spans] == list(range(22, 30))
+
+
+def test_chrome_export_shape(tmp_path):
+    t = tracing.start_trace("tdt_serving_request", req_id=9)
+    with t.span("tdt_test_child", slot=1):
+        pass
+    # Leave the trace OPEN: the root must export with a running duration.
+    path = tracing.export_chrome(str(tmp_path / "trace.json"))
+    doc = json.loads((tmp_path / "trace.json").read_text())
+    events = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    meta = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    assert path.endswith("trace.json")
+    assert meta and f"req=9" in meta[0]["args"]["name"]
+    assert all(e["ts"] >= 0 for e in events)  # normalized to the earliest
+    root = next(e for e in events if e["args"]["parent_id"] is None)
+    assert root["args"].get("open") is True and root["dur"] > 0
+    child = next(e for e in events if e["name"] == "tdt_test_child")
+    assert child["args"]["parent_id"] == root["args"]["span_id"]
+    assert child["pid"] == root["pid"] == t.trace_id
+
+
+def test_snapshot_traces_and_dump_integration(tmp_path):
+    t = tracing.start_trace("tdt_test_trace")
+    with t.span("tdt_test_child"):
+        snap = tracing.snapshot_traces()
+        assert snap["n_open"] == 2  # root + live child
+    t.finish()
+    out = telemetry.dump(str(tmp_path / "snap.json"))
+    doc = json.loads(open(out).read())
+    assert doc["traces"]["n_spans"] == 2 and doc["traces"]["n_open"] == 0
+    assert doc["traces"]["traces"][0]["trace_id"] == t.trace_id
+
+
+# ===================================================== serving thread-through
+
+
+def _span_names(trace_id):
+    return [s["name"] for s in tracing.spans(trace_id)]
+
+
+def test_engine_build_gets_a_trace(model1):
+    make_engine(model1)
+    builds = [
+        tid for tid in tracing.trace_ids()
+        if "tdt_engine_build" in _span_names(tid)
+    ]
+    assert len(builds) == 1
+    (root,) = tracing.spans(builds[0])
+    assert root["parent_id"] is None
+    assert root["attrs"]["backend"] == "xla"
+    assert root["end_s"] > root["start_s"]
+
+
+def test_staggered_serving_chrome_chain(model1, tmp_path):
+    """Acceptance: every request's trace carries the complete
+    queue→prefill→decode→done chain, decode-chunk spans name the slot the
+    request actually occupied, and the shared dispatch attribution points
+    into the server trace."""
+    eng = make_engine(model1)
+    srv = InferenceServer(eng, num_slots=2, chunk=2)
+    handles = [
+        srv.submit(p, g, arrival_time_s=i * 0.01)
+        for i, (p, g) in enumerate(
+            [([3, 17, 42], 5), ([8, 1], 4), ([5, 5, 5, 5], 3), ([9], 4)]
+        )
+    ]
+    srv.run()
+    assert all(h.done for h in handles)
+
+    server_span_ids = {s["span_id"] for s in tracing.spans(srv._trace.trace_id)}
+    for h in handles:
+        spans = tracing.spans(h.trace.trace_id)
+        by_name: dict[str, list[dict]] = {}
+        for s in spans:
+            by_name.setdefault(s["name"], []).append(s)
+        # Complete chain, in timeline order.
+        for name in ("tdt_serving_queue_wait", "tdt_serving_prefill",
+                     "tdt_serving_decode_chunk", "tdt_serving_stream",
+                     "tdt_serving_finish", "tdt_serving_request"):
+            assert name in by_name, (h.req_id, sorted(by_name))
+        root = by_name["tdt_serving_request"][0]
+        assert root["parent_id"] is None
+        assert root["attrs"]["req_id"] == h.req_id
+        ids = {s["span_id"] for s in spans}
+        assert all(s["parent_id"] in ids for s in spans if s is not root)
+        # Slot attribution: every decode chunk ran in the slot this request
+        # was prefilled into.
+        slot = by_name["tdt_serving_prefill"][0]["attrs"]["slot"]
+        chunks = by_name["tdt_serving_decode_chunk"]
+        assert chunks and all(c["attrs"]["slot"] == slot for c in chunks)
+        # Streamed token counts across chunks equal the post-TTFT tokens.
+        assert sum(c["attrs"]["n_tokens"] for c in chunks) == len(h.tokens) - 1
+        # Shared-dispatch attribution: each chunk references a span in the
+        # SERVER trace (the one device dispatch it rode).
+        assert all(c["attrs"]["dispatch"] in server_span_ids for c in chunks)
+        # The chain is causally ordered.
+        t_queue = by_name["tdt_serving_queue_wait"][0]["end_s"]
+        t_prefill = by_name["tdt_serving_prefill"][0]["start_s"]
+        assert t_prefill >= t_queue - 1e-6
+        assert by_name["tdt_serving_finish"][0]["start_s"] >= t_prefill
+
+    # The chrome export holds one process row per trace with the chain
+    # machine-checkable via args.span_id/parent_id.
+    doc = json.loads(
+        open(tracing.export_chrome(str(tmp_path / "serve.json"))).read()
+    )
+    by_pid: dict[int, list[dict]] = {}
+    for e in doc["traceEvents"]:
+        if e["ph"] == "X":
+            by_pid.setdefault(e["pid"], []).append(e)
+    for h in handles:
+        names = {e["name"] for e in by_pid[h.trace.trace_id]}
+        assert {"tdt_serving_request", "tdt_serving_prefill",
+                "tdt_serving_decode_chunk", "tdt_serving_finish"} <= names
+
+    # Queue-wait satellite: one histogram observation per admitted request.
+    hist = telemetry.snapshot()["histograms"]["tdt_serving_queue_wait_seconds"]
+    assert hist[0]["count"] == len(handles)
+
+
+def test_rejected_request_trace_closes():
+    from triton_dist_tpu.serving import Scheduler
+
+    sched = Scheduler(num_slots=1, max_len=8)
+    r = sched.submit([1] * 8, max_new=8)  # kv_budget reject
+    assert r.reject_reason == "kv_budget"
+    (root,) = tracing.spans(r.trace.trace_id)
+    assert root["name"] == "tdt_serving_request"
+    assert root["attrs"]["status"] == "rejected"
+    assert root["attrs"]["reason"] == "kv_budget"
+    assert root["end_s"] is not None
+
+
+@pytest.mark.chaos
+def test_chaos_recovery_span_parented_under_affected_traces(model1):
+    """Acceptance: a mid-serving abort shows up in each affected request's
+    trace as a recovery span parented at its root, covering the rebuild +
+    re-prefill window."""
+    eng = make_engine(model1, backend="dist_ar")
+    srv = InferenceServer(eng, num_slots=2, chunk=2)
+
+    orig = eng._decode_chunk
+    calls = {"n": 0}
+
+    def boom(*args, **kwargs):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            resilience.mark_degraded("collectives", "injected abort (test)")
+            raise resilience.CollectiveAbortError("injected abort (test)")
+        return orig(*args, **kwargs)
+
+    eng._decode_chunk = boom
+    handles = [srv.submit([3, 17, 42], 6), srv.submit([8, 1], 5)]
+    srv.run()
+    assert calls["n"] == 2 and eng.backend == "xla"
+    assert all(h.done for h in handles)
+
+    affected = 0
+    for h in handles:
+        spans = {s["name"]: s for s in tracing.spans(h.trace.trace_id)}
+        rec = spans.get("tdt_serving_recovery")
+        if rec is None:
+            continue  # finished before the abort — legitimately unaffected
+        affected += 1
+        assert rec["parent_id"] == h.trace.root_id
+        assert rec["attrs"]["from_backend"] == "dist_ar"
+        # The recovery window contains the re-prefill.
+        re_prefills = [
+            s for s in tracing.spans(h.trace.trace_id)
+            if s["name"] == "tdt_serving_prefill" and s["attrs"]["recovery"]
+        ]
+        assert re_prefills
+        assert all(
+            rec["start_s"] - 1e-6 <= s["start_s"] and s["end_s"] <= rec["end_s"] + 1e-6
+            for s in re_prefills
+        )
+    assert affected >= 1
+    # The server trace carries the recovery too, and a second engine-build
+    # trace exists (the degraded rebuild on xla).
+    assert "tdt_serving_recovery" in _span_names(srv._trace.trace_id)
+    builds = [
+        s for tid in tracing.trace_ids() for s in tracing.spans(tid)
+        if s["name"] == "tdt_engine_build"
+    ]
+    assert [b["attrs"]["backend"] for b in builds] == ["dist_ar", "xla"]
+
+
+# ======================================================== live introspection
+
+
+def _get(url: str):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.status, r.read().decode()
+
+
+def test_endpoint_live_against_serving_loop(model1, monkeypatch):
+    """Acceptance: /metrics and /healthz answer correctly WHILE the serving
+    loop is running — fetched from inside an on_token callback, i.e. with
+    requests genuinely in flight."""
+    monkeypatch.setenv("TDT_HTTP_PORT", "0")  # ephemeral port
+    eng = make_engine(model1)
+    srv = InferenceServer(eng, num_slots=2, chunk=2)
+    assert srv._introspect is not None
+    base = srv._introspect.url()
+    live: dict[str, object] = {}
+
+    def on_token(req, token, index):
+        if live:
+            return  # one mid-serve scrape is enough
+        code, body = _get(base + "metrics")
+        live["metrics"] = (code, body)
+        live["healthz"] = _get(base + "healthz")
+        live["snapshot"] = _get(base + "snapshot")
+
+    handles = [srv.submit([3, 17, 42], 5, on_token=on_token),
+               srv.submit([8, 1], 4, on_token=on_token)]
+    try:
+        srv.run()
+        assert all(h.done for h in handles)
+
+        code, body = live["metrics"]
+        assert code == 200
+        assert "# TYPE tdt_serving_requests_total counter" in body
+        code, body = live["healthz"]
+        assert code == 200
+        health = json.loads(body)
+        assert health["status"] == "ok" and health["uptime_s"] >= 0
+        code, body = live["snapshot"]
+        snap = json.loads(body)
+        assert snap["traces"]["n_open"] >= 1  # requests were mid-flight
+
+        # After the run: trace routes, 404s, and the degraded healthz.
+        code, body = _get(base + "traces")
+        ids = json.loads(body)["trace_ids"]
+        assert handles[0].trace.trace_id in ids
+        code, body = _get(base + f"traces/{handles[0].trace.trace_id}")
+        names = {e["name"] for e in json.loads(body)["traceEvents"]}
+        assert "tdt_serving_request" in names
+        code, body = _get(base + "traces/last")
+        assert code == 200
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(base + "traces/424242")
+        assert ei.value.code == 404
+        resilience.mark_degraded("collectives", "test degradation")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(base + "healthz")
+        assert ei.value.code == 503
+        assert json.loads(ei.value.read().decode())["status"] == "degraded"
+    finally:
+        srv._introspect.stop()
+
+
+def test_maybe_start_disabled_by_default(monkeypatch):
+    monkeypatch.delenv("TDT_HTTP_PORT", raising=False)
+    assert introspect.maybe_start() is None
+    monkeypatch.setenv("TDT_HTTP_PORT", "")
+    assert introspect.maybe_start() is None
+    monkeypatch.setenv("TDT_HTTP_PORT", "not-a-port")
+    assert introspect.maybe_start() is None  # logged, never raises

@@ -28,7 +28,7 @@ from jax.sharding import PartitionSpec as P
 
 from triton_dist_tpu.models.dense import DenseLLM
 from triton_dist_tpu.models.kv_cache import KVCache
-from triton_dist_tpu.runtime import telemetry
+from triton_dist_tpu.runtime import telemetry, tracing
 
 
 _BACKENDS = ("xla", "dist", "dist_ar", "mega")
@@ -80,6 +80,12 @@ class Engine:
         engine on "xla" (fresh jit functions retrace, so the sticky
         degradation flags and the backend switch take effect) and serving
         continues on the same model/caches."""
+        # Build cost dominates cold TTFT and dwarfs a recovery window — it
+        # gets its own trace so a degraded rebuild shows up timed.
+        with tracing.root_span("tdt_engine_build", backend=backend):
+            self._build_impl(backend)
+
+    def _build_impl(self, backend: str) -> None:
         assert backend in _BACKENDS, backend
         telemetry.inc("tdt_engine_rebuilds_total", backend=backend)
         model = self.model

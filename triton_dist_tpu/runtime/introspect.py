@@ -1,0 +1,184 @@
+"""Live introspection HTTP endpoint: scrape metrics and traces from a
+running process, stdlib only.
+
+``TDT_TELEMETRY_DUMP`` gives a post-mortem snapshot; production debugging
+needs the LIVE view — "is this server degraded right now", "what is this
+stuck request doing" — without attaching a debugger to the serving loop.
+This module serves that over plain HTTP (``http.server``; no new deps, per
+the runtime's stdlib-only observability rule):
+
+======================  =====================================================
+route                   body
+======================  =====================================================
+``/metrics``            Prometheus text exposition (``telemetry.to_prometheus``)
+``/healthz``            JSON health verdict: ``status`` ``ok``/``degraded``,
+                        sticky degradation reasons, last collective abort,
+                        watchdog timeout total, uptime — 200 when ok, 503
+                        when degraded (load-balancer friendly)
+``/snapshot``           full JSON ``telemetry.snapshot()`` + span-trace
+                        section (``tracing.snapshot_traces()``)
+``/traces``             JSON list of known trace ids
+``/traces/<id>``        chrome://tracing JSON for that trace (``last`` picks
+                        the newest; append ``?kernel=1`` to merge
+                        correlated KernelTrace records)
+======================  =====================================================
+
+Threading: the endpoint runs a daemon ``ThreadingHTTPServer`` — requests
+are served OFF the serving loop's thread, which is exactly why
+``telemetry``/``tracing`` readers copy state under their locks (see the
+thread-safety contract in ``runtime/telemetry.py``). Handlers only ever
+READ; the only write anywhere is the process's own instrumentation.
+
+Enable with ``TDT_HTTP_PORT=<port>`` (``InferenceServer`` calls
+:func:`maybe_start` at construction; unset/empty means disabled — the
+default, since an open debug port is opt-in). Port 0 binds an ephemeral
+port (tests); the bound port is on the returned handle and in the startup
+log line. One endpoint per process: repeated starts return the first.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import threading
+import time
+
+from triton_dist_tpu.runtime import telemetry, tracing
+from triton_dist_tpu.runtime.utils import tdt_log
+
+_LOCK = threading.Lock()
+_SERVER: "IntrospectionServer | None" = None
+
+
+def _healthz() -> tuple[int, dict]:
+    from triton_dist_tpu.runtime import resilience
+
+    reasons = resilience.degraded_reasons()
+    last = resilience.last_abort()
+    body = {
+        "status": "degraded" if reasons else "ok",
+        "degraded": reasons,
+        "last_abort": None if last is None else {
+            "feature": last.feature, "kernel": last.kernel,
+            "phase": last.phase, "peer": last.peer,
+        },
+        "watchdog_timeouts": telemetry.counter_total(
+            "tdt_resilience_watchdog_timeouts_total"
+        ),
+        "aborts": telemetry.counter_total("tdt_resilience_aborts_total"),
+        "uptime_s": round(time.monotonic() - _MONO0, 3),
+    }
+    return (503 if reasons else 200), body
+
+
+_MONO0 = time.monotonic()
+
+
+class _Handler(http.server.BaseHTTPRequestHandler):
+    server_version = "tdt-introspect"
+
+    def log_message(self, fmt, *args):  # route access logs through TDT_LOG
+        tdt_log(f"[introspect] {fmt % args}", level="debug")
+
+    def _send(self, code: int, body: str, ctype: str) -> None:
+        data = body.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _send_json(self, code: int, obj) -> None:
+        self._send(code, json.dumps(obj, indent=1), "application/json")
+
+    def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler contract
+        path, _, query = self.path.partition("?")
+        try:
+            if path == "/metrics":
+                self._send(200, telemetry.to_prometheus(), "text/plain; version=0.0.4")
+            elif path == "/healthz":
+                self._send_json(*_healthz())
+            elif path == "/snapshot":
+                snap = telemetry.snapshot()
+                snap["traces"] = tracing.snapshot_traces()
+                self._send_json(200, snap)
+            elif path == "/traces":
+                self._send_json(200, {"trace_ids": tracing.trace_ids()})
+            elif path.startswith("/traces/"):
+                which = path[len("/traces/"):]
+                tid = tracing.last_trace_id() if which == "last" else (
+                    int(which) if which.isdigit() else None
+                )
+                if tid is None or tid not in tracing.trace_ids():
+                    self._send_json(404, {"error": f"unknown trace {which!r}"})
+                    return
+                self._send_json(
+                    200, tracing.to_chrome(tid, kernel_traces="kernel=1" in query)
+                )
+            else:
+                self._send_json(404, {
+                    "error": f"unknown route {path!r}",
+                    "routes": ["/metrics", "/healthz", "/snapshot",
+                               "/traces", "/traces/<id|last>"],
+                })
+        except Exception as e:  # a debug endpoint must never kill its thread
+            try:
+                self._send_json(500, {"error": f"{type(e).__name__}: {e}"})
+            except Exception:
+                pass
+
+
+class IntrospectionServer:
+    """Handle for one running endpoint: ``.port`` and ``.stop()``."""
+
+    def __init__(self, port: int):
+        self._httpd = http.server.ThreadingHTTPServer(("127.0.0.1", port), _Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="tdt-introspect", daemon=True
+        )
+        self._thread.start()
+        tdt_log(f"[introspect] serving on http://127.0.0.1:{self.port}")
+
+    def url(self, path: str = "/") -> str:
+        return f"http://127.0.0.1:{self.port}{path}"
+
+    def stop(self) -> None:
+        global _SERVER
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        with _LOCK:
+            if _SERVER is self:
+                _SERVER = None
+
+
+def start(port: int) -> IntrospectionServer:
+    """Start (or return the already-running) endpoint. ``port=0`` binds an
+    ephemeral port — the test-friendly mode."""
+    global _SERVER
+    with _LOCK:
+        if _SERVER is None:
+            _SERVER = IntrospectionServer(port)
+        return _SERVER
+
+
+def maybe_start() -> IntrospectionServer | None:
+    """Env-gated start: ``TDT_HTTP_PORT`` set and non-empty → :func:`start`.
+    Disabled by default — an open debug port is opt-in. A bind failure logs
+    and returns None (introspection must never take down serving)."""
+    import os
+
+    v = os.environ.get("TDT_HTTP_PORT", "").strip()
+    if not v:
+        return None
+    try:
+        return start(int(v))
+    except (ValueError, OSError) as e:
+        tdt_log(f"[introspect] not started (TDT_HTTP_PORT={v!r}): {e}")
+        return None
+
+
+def running() -> IntrospectionServer | None:
+    with _LOCK:
+        return _SERVER

@@ -352,6 +352,16 @@ def record_status(words, *, feature: str, kernel: str) -> None:
         feature=feature, kernel=kernel, phase=info.phase,
         peer=info.peer, polls=info.polls,
     )
+    # Pin the abort onto whatever request/server span is live (no-op when
+    # none is) — the chrome timeline then shows WHICH request's dispatch hit
+    # the stalled peer. Lazy import: tracing pulls telemetry which this
+    # module also feeds.
+    from triton_dist_tpu.runtime import tracing
+
+    tracing.point_current(
+        "tdt_resilience_abort", feature=feature, kernel=kernel,
+        phase=info.phase, peer=info.peer,
+    )
     mark_degraded(feature, reason)
     raise CollectiveAbortError(reason)
 
@@ -444,6 +454,12 @@ class CollectiveWatchdog:
                 "watchdog_timeout",
                 name=self.name, attempt=attempt + 1,
                 attempts=self.retries + 1, timeout_ms=timeout_s * 1e3,
+            )
+            from triton_dist_tpu.runtime import tracing
+
+            tracing.point_current(
+                "tdt_resilience_watchdog_timeout",
+                name=self.name, attempt=attempt + 1,
             )
             _log(
                 f"[resilience] {self.name}: attempt {attempt + 1}/"

@@ -29,6 +29,18 @@ single cached-bool check and early return — no allocation, no lock, no
 string formatting. The flag is resolved once per process (first call);
 :func:`reset` re-reads it, which is how tests flip it.
 
+Thread-safety contract (audited for the ``runtime/introspect.py`` HTTP
+thread reading concurrently with the serving loop writing): every mutation
+and every reader (:func:`snapshot`, :func:`events`, :func:`summary`,
+:func:`kernel_traces`, :func:`counter_value`) copies shared state under
+``_LOCK``, so readers always see a consistent point-in-time view and never
+iterate a deque mid-append. Two races are tolerated by design: (a) the
+:func:`enabled` lazy resolve is an unlocked read-then-write of a bool —
+two threads may both resolve it, converging on the same env-derived value
+(benign); (b) a reader racing :func:`reset` may observe either the old or
+the empty registry, never a torn one. ``tests/test_telemetry.py`` has a
+threaded stress test pinning this contract.
+
 Counting semantics on this runtime: jit means most call sites run at TRACE
 time, so counters like ``tdt_shmem_collective_calls`` count *traced
 launches* (one per compilation), not per-step executions — which is exactly
@@ -117,9 +129,10 @@ def reset(enabled_override: bool | None = None) -> None:
         _KTRACES.clear()
         _EVENT_SEQ = 0
         _EVENTS = None
-        _ENABLED = None
-    if enabled_override is not None:
-        _ENABLED = bool(enabled_override)
+        # Override assignment stays under the lock: a concurrent enabled()
+        # between "None" and the override would re-resolve from the env and
+        # clobber a forced-off test gate.
+        _ENABLED = None if enabled_override is None else bool(enabled_override)
 
 
 # ---------------------------------------------------------------- instruments
@@ -192,6 +205,14 @@ def counter_value(name: str, /, **labels) -> float:
         return _COUNTERS.get(_key(name, labels), 0.0)
 
 
+def counter_total(name: str) -> float:
+    """Sum of a counter across ALL label sets — the ``/healthz`` view of
+    e.g. ``tdt_resilience_watchdog_timeout_total`` regardless of which
+    feature/peer labels it accrued under."""
+    with _LOCK:
+        return sum(v for (n, _), v in _COUNTERS.items() if n == name)
+
+
 # ------------------------------------------------------ kernel-trace collector
 
 
@@ -213,9 +234,16 @@ def consume_kernel_trace(kt, events_arr, *, kernel: str) -> None:
     import jax
     import numpy as np
 
+    # Correlation id captured NOW — at jit-trace time, which under serving
+    # happens inside the request span that forced this compile. The span
+    # tracer merges correlated records onto that trace's chrome row.
+    from triton_dist_tpu.runtime import tracing
+
+    corr = tracing.current_correlation()
+
     def _cb(ev):
         e = np.asarray(ev)
-        rec = {"kernel": kernel, "rank": int(e[0, 1]), **kt.decode(e)}
+        rec = {"kernel": kernel, "rank": int(e[0, 1]), "corr": corr, **kt.decode(e)}
         with _LOCK:
             _KTRACES.append(rec)
 
@@ -271,9 +299,16 @@ def snapshot() -> dict:
 
 
 def dump(path: str) -> str:
-    """Write :func:`snapshot` as JSON; returns the path."""
+    """Write :func:`snapshot` as JSON (plus the span-trace section when any
+    spans were recorded — one file tells the whole story); returns the path."""
+    snap = snapshot()
+    from triton_dist_tpu.runtime import tracing  # circular-at-import otherwise
+
+    traces = tracing.snapshot_traces()
+    if traces["n_spans"] or traces["n_open"]:
+        snap["traces"] = traces
     with open(path, "w") as f:
-        json.dump(snapshot(), f, indent=1)
+        json.dump(snap, f, indent=1)
     return path
 
 

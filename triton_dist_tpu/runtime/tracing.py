@@ -1,0 +1,435 @@
+"""Request-scoped span tracing: trace/span ids, a bounded span ring, and
+Chrome-trace export.
+
+``runtime.telemetry`` answers "what is this process doing" in aggregate;
+nothing answers "where did *this request's* 400 ms go" — the per-request
+visibility serving systems treat as table stakes (vLLM's request metrics,
+Orca's iteration timeline). This module is that answer:
+
+* **Spans** — named intervals with monotonic timestamps, a ``trace_id``
+  grouping one request's (or one process activity's) spans, a ``span_id``,
+  and a ``parent_id`` link. Span *names* follow the same
+  ``tdt_<subsystem>_<name>`` registry discipline as metric names (enforced
+  by ``scripts/check_metric_names.py``); dynamic detail goes in attrs.
+* **Bounded span ring** — finished spans append to a process-wide deque
+  (``TDT_SPAN_RING`` entries, default 4096); open spans are tracked
+  separately so live introspection (``runtime/introspect.py``) can show
+  in-flight requests. Completed *traces* also emit one compact ``trace``
+  event into the telemetry event ring — the two rings share one story.
+* **Sampling** — ``TDT_TRACE_SAMPLE`` (float in [0, 1], default 1.0) is a
+  deterministic rate limiter: an error-feedback accumulator admits exactly
+  ``rate`` of traces (0.25 → every 4th), so tests and steady-state serving
+  see a predictable cadence instead of RNG jitter. Unsampled traces return
+  the shared no-op handle — zero allocation per span.
+* **Chrome export** — :func:`to_chrome` / :func:`export_chrome` render
+  selected traces as a ``chrome://tracing`` / Perfetto JSON: one process
+  row (pid) per trace, span attrs in ``args``, and — via the correlation
+  id — the in-kernel ``KernelTrace`` phase marks merged onto the same
+  timeline so a request span can zoom into ring-protocol phases.
+
+Clocks: spans stamp raw ``time.monotonic()`` seconds. Callers whose
+bookkeeping lives in another monotonic-derived clock (the serving loop's
+server-relative ``_now()``) convert with a constant offset before calling
+:meth:`Trace.record` — see ``serving/scheduler.py``. Chrome export
+normalizes all timestamps to the earliest exported span, so mixed-epoch
+traces still render.
+
+Correlation with ``KernelTrace``: the kernel-trace collector
+(``telemetry.consume_kernel_trace``) stamps the ACTIVE span's
+``(trace_id, span_id)`` into each collected record at jit-trace time —
+the time the kernel is built, which under serving happens inside the
+first request's prefill/decode span. :func:`to_chrome` with
+``kernel_traces=True`` files those records under the owning trace's row.
+
+Env knobs::
+
+    TDT_TRACE_SAMPLE   fraction of traces recorded (default 1.0; 0 = off)
+    TDT_SPAN_RING      finished-span ring capacity (default 4096)
+
+Tracing inherits telemetry's master gate: ``TDT_TELEMETRY=0`` disables
+span collection too (same single-cached-bool no-op path).
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import contextvars
+import itertools
+import json
+import threading
+import time
+from typing import Any, Mapping
+
+from triton_dist_tpu.runtime import telemetry
+from triton_dist_tpu.runtime.utils import get_float_env, get_int_env
+
+# -------------------------------------------------------------------- storage
+
+_LOCK = threading.Lock()
+_SPANS: collections.deque | None = None  # finished spans, oldest first
+_OPEN: dict[int, dict] = {}  # span_id -> span dict (started, not finished)
+_IDS = itertools.count(1)
+_SAMPLE_ACC = 0.0  # error-feedback accumulator for deterministic sampling
+_CURRENT: contextvars.ContextVar = contextvars.ContextVar(
+    "tdt_current_span", default=None
+)
+
+
+def _ring() -> collections.deque:
+    global _SPANS
+    if _SPANS is None:
+        _SPANS = collections.deque(maxlen=max(get_int_env("TDT_SPAN_RING", 4096), 1))
+    return _SPANS
+
+
+def now_s() -> float:
+    """The tracing clock: raw ``time.monotonic()`` seconds. Public so
+    callers with retroactive intervals in another clock can compute the
+    constant conversion offset (``now_s() - other_clock_now``)."""
+    return time.monotonic()
+
+
+def sample_rate() -> float:
+    """``TDT_TRACE_SAMPLE`` clamped to [0, 1]. Read per trace start (cheap;
+    honors mid-process changes in tests)."""
+    return min(max(get_float_env("TDT_TRACE_SAMPLE", 1.0), 0.0), 1.0)
+
+
+def enabled() -> bool:
+    """Tracing rides telemetry's master gate (``TDT_TELEMETRY=0`` disables
+    both) and is additionally off when the sample rate is 0."""
+    return telemetry.enabled() and sample_rate() > 0.0
+
+
+def reset() -> None:
+    """Drop every span (finished and open) and restart ids + the sampling
+    accumulator. Tests and operator resets only."""
+    global _SPANS, _IDS, _SAMPLE_ACC
+    with _LOCK:
+        _SPANS = None
+        _OPEN.clear()
+        _IDS = itertools.count(1)
+        _SAMPLE_ACC = 0.0
+
+
+def _clean_attrs(attrs: Mapping[str, Any]) -> dict:
+    return {
+        k: (v if isinstance(v, (str, int, float, bool, type(None))) else str(v))
+        for k, v in attrs.items()
+    }
+
+
+# --------------------------------------------------------------------- traces
+
+
+class Trace:
+    """Handle for one trace: a root span plus child spans callers add via
+    :meth:`span` (live, context-managed), :meth:`record` (retroactive
+    interval), and :meth:`point` (zero-duration marker). Thread-compatible
+    the same way the telemetry registry is: every mutation takes the module
+    lock, so a submit thread and the serving loop can both touch it."""
+
+    __slots__ = ("trace_id", "root_id", "sampled", "_name")
+
+    def __init__(self, trace_id: int, root_id: int, name: str, sampled: bool):
+        self.trace_id = trace_id
+        self.root_id = root_id
+        self.sampled = sampled
+        self._name = name
+
+    # -- span creation ------------------------------------------------------
+    @contextlib.contextmanager
+    def span(self, name: str, /, parent_id: int | None = None, **attrs):
+        """Context manager: one live span, timed around the block. Sets the
+        ambient current span (contextvar) so nested spans and the
+        resilience abort hook parent correctly. Yields the span dict —
+        mutate ``["attrs"]`` inside the block to attach results.
+
+        ``name`` is positional-only (here and on every span entry point)
+        so ``name=...`` stays available as an attribute key — the watchdog
+        labels its timeout points with the collective's name."""
+        if not self.sampled:
+            yield None
+            return
+        sp = _start_span(
+            self.trace_id, name,
+            parent_id if parent_id is not None else _ambient_parent(self.root_id),
+            attrs,
+        )
+        tok = _CURRENT.set(sp)
+        try:
+            yield sp
+        finally:
+            _CURRENT.reset(tok)
+            _finish_span(sp)
+
+    def record(self, name: str, start_s: float, end_s: float, /,
+               parent_id: int | None = None, **attrs) -> int | None:
+        """Retroactive span: an interval measured by the caller (in the
+        tracing clock — convert first, see the module doc). Returns the
+        span_id so siblings can reference it (shared-dispatch attribution)."""
+        if not self.sampled:
+            return None
+        sp = _start_span(
+            self.trace_id, name,
+            parent_id if parent_id is not None else self.root_id,
+            attrs, start_s=start_s,
+        )
+        _finish_span(sp, end_s=end_s)
+        return sp["span_id"]
+
+    def point(self, name: str, /, parent_id: int | None = None, **attrs) -> int | None:
+        """Zero-duration marker span at now."""
+        t = now_s()
+        return self.record(
+            name, t, t,
+            parent_id=parent_id if parent_id is not None else _ambient_parent(self.root_id),
+            **attrs,
+        )
+
+    def finish(self, **attrs) -> None:
+        """Close the root span and emit one compact ``trace`` event into the
+        telemetry event ring (the two rings' join point). Idempotent."""
+        if not self.sampled:
+            return
+        with _LOCK:
+            sp = _OPEN.get(self.root_id)
+        if sp is None:
+            return
+        if attrs:
+            sp["attrs"].update(_clean_attrs(attrs))
+        _finish_span(sp)
+        telemetry.emit(
+            "trace", trace_id=self.trace_id, name=self._name,
+            dur_s=round(sp["end_s"] - sp["start_s"], 6),
+            n_spans=len(spans(self.trace_id)),
+        )
+
+
+class _NoopTrace(Trace):
+    """Shared unsampled handle: every method an allocation-free no-op."""
+
+    def __init__(self):
+        super().__init__(0, 0, "", False)
+
+
+NOOP_TRACE = _NoopTrace()
+
+
+def start_trace(name: str, /, **attrs) -> Trace:
+    """Open a new trace (root span starts now). Returns the shared no-op
+    handle when tracing is disabled or the sampler skips this trace — all
+    Trace methods stay safe to call unconditionally."""
+    global _SAMPLE_ACC
+    if not telemetry.enabled():
+        return NOOP_TRACE
+    rate = sample_rate()
+    with _LOCK:
+        _SAMPLE_ACC += rate
+        take = _SAMPLE_ACC >= 1.0
+        if take:
+            _SAMPLE_ACC -= 1.0
+    if not take:
+        return NOOP_TRACE
+    trace_id = next(_IDS)
+    sp = _start_span(trace_id, name, None, attrs)
+    return Trace(trace_id, sp["span_id"], name, True)
+
+
+@contextlib.contextmanager
+def root_span(name: str, /, **attrs):
+    """One-shot trace whose root span wraps the block (``Engine._build``
+    style process activities). Yields the Trace handle."""
+    t = start_trace(name, **attrs)
+    try:
+        yield t
+    finally:
+        t.finish()
+
+
+def _ambient_parent(default: int) -> int:
+    cur = _CURRENT.get()
+    return cur["span_id"] if cur is not None else default
+
+
+def _start_span(trace_id: int, name: str, parent_id: int | None,
+                attrs: Mapping[str, Any], start_s: float | None = None) -> dict:
+    sp = {
+        "trace_id": trace_id,
+        "span_id": next(_IDS),
+        "parent_id": parent_id,
+        "name": name,
+        "start_s": now_s() if start_s is None else float(start_s),
+        "end_s": None,
+        "attrs": _clean_attrs(attrs),
+    }
+    with _LOCK:
+        _OPEN[sp["span_id"]] = sp
+    return sp
+
+
+def _finish_span(sp: dict, end_s: float | None = None) -> None:
+    sp["end_s"] = now_s() if end_s is None else float(end_s)
+    with _LOCK:
+        _OPEN.pop(sp["span_id"], None)
+        _ring().append(sp)
+
+
+# ------------------------------------------------------------- ambient access
+
+
+def current_span() -> dict | None:
+    """The innermost live ``Trace.span`` block's span on this thread/context
+    (None outside any). Resilience's abort hook parents to it."""
+    return _CURRENT.get()
+
+
+def current_correlation() -> tuple[int, int] | None:
+    """``(trace_id, span_id)`` of the ambient span — the correlation id the
+    kernel-trace collector stamps into records at jit-trace time."""
+    cur = _CURRENT.get()
+    if cur is None:
+        return None
+    return cur["trace_id"], cur["span_id"]
+
+
+def point_current(name: str, /, **attrs) -> None:
+    """Zero-duration marker attached to the ambient span's trace (no-op when
+    no span is live) — how ``resilience.record_status`` drops a collective
+    abort onto whatever request/server timeline was running."""
+    cur = _CURRENT.get()
+    if cur is None:
+        return
+    t = now_s()
+    sp = _start_span(cur["trace_id"], name, cur["span_id"], attrs, start_s=t)
+    _finish_span(sp, end_s=t)
+
+
+# -------------------------------------------------------------------- queries
+
+
+def spans(trace_id: int | None = None, include_open: bool = False) -> list[dict]:
+    """Finished spans, oldest first (optionally one trace; optionally with
+    the still-open spans appended — introspection's in-flight view)."""
+    with _LOCK:
+        out = list(_SPANS or ())
+        if include_open:
+            out += [dict(sp) for sp in _OPEN.values()]
+    if trace_id is not None:
+        out = [s for s in out if s["trace_id"] == trace_id]
+    return out
+
+
+def trace_ids() -> list[int]:
+    """Distinct trace ids with at least one finished or open span, ascending."""
+    with _LOCK:
+        ids = {s["trace_id"] for s in (_SPANS or ())}
+        ids.update(sp["trace_id"] for sp in _OPEN.values())
+    return sorted(ids)
+
+
+def last_trace_id() -> int | None:
+    ids = trace_ids()
+    return ids[-1] if ids else None
+
+
+def snapshot_traces() -> dict:
+    """JSON-safe dump of the span rings — the ``"traces"`` section
+    ``telemetry.dump`` and the ``/snapshot`` route attach: per-trace span
+    lists plus open-span count."""
+    with _LOCK:
+        finished = [dict(s) for s in (_SPANS or ())]
+        open_spans = [dict(s) for s in _OPEN.values()]
+    by_trace: dict[int, list] = {}
+    for s in finished + open_spans:
+        by_trace.setdefault(s["trace_id"], []).append(s)
+    return {
+        "n_spans": len(finished),
+        "n_open": len(open_spans),
+        "traces": [
+            {"trace_id": tid, "spans": sorted(sps, key=lambda s: s["start_s"])}
+            for tid, sps in sorted(by_trace.items())
+        ],
+    }
+
+
+# --------------------------------------------------------------- chrome export
+
+
+def to_chrome(trace_id: int | list[int] | None = None,
+              kernel_traces: bool = False) -> dict:
+    """Render traces as a ``chrome://tracing`` JSON dict.
+
+    One process row (pid) per trace_id, named after its root span +
+    request attrs; every span an ``"X"`` event with attrs in ``args`` and
+    the span/parent ids included so the chain is machine-checkable.
+    Timestamps normalize to the earliest exported span (µs). Open spans
+    export with their duration running to now.
+
+    ``kernel_traces=True`` merges ``telemetry.kernel_traces()`` records
+    whose correlation id (stamped at jit-trace time) belongs to an
+    exported trace: each in-kernel event lands on the owning trace's row
+    at tid ``1000 + rank`` — sequence-numbered (the in-kernel clock is
+    event ORDER, see ``tools/profiler.py``), so the zoomed view reads as a
+    schedule, not wall time."""
+    if trace_id is None:
+        ids = set(trace_ids())
+    elif isinstance(trace_id, int):
+        ids = {trace_id}
+    else:
+        ids = set(trace_id)
+    all_spans = [s for s in spans(include_open=True) if s["trace_id"] in ids]
+    if not all_spans:
+        return {"traceEvents": [], "displayTimeUnit": "ms"}
+    t0 = min(s["start_s"] for s in all_spans)
+    t_now = now_s()
+    events: list[dict] = []
+    named: set[int] = set()
+    for s in sorted(all_spans, key=lambda x: x["start_s"]):
+        if s["trace_id"] not in named:
+            named.add(s["trace_id"])
+            label = s["name"] if s["parent_id"] is None else f"trace {s['trace_id']}"
+            req = s["attrs"].get("req_id")
+            if req is not None:
+                label = f"{label} req={req}"
+            events.append({
+                "name": "process_name", "ph": "M", "pid": s["trace_id"],
+                "args": {"name": f"{label} [trace {s['trace_id']}]"},
+            })
+        end = s["end_s"] if s["end_s"] is not None else t_now
+        events.append({
+            "name": s["name"], "ph": "X",
+            "ts": (s["start_s"] - t0) * 1e6,
+            "dur": max((end - s["start_s"]) * 1e6, 0.0),
+            "pid": s["trace_id"], "tid": 0,
+            "args": {
+                **s["attrs"], "span_id": s["span_id"],
+                "parent_id": s["parent_id"],
+                **({} if s["end_s"] is not None else {"open": True}),
+            },
+        })
+    if kernel_traces:
+        for rec in telemetry.kernel_traces():
+            corr = rec.get("corr")
+            if not corr or corr[0] not in ids:
+                continue
+            tid = 1000 + int(rec.get("rank", 0))
+            for e in rec.get("events", ()):
+                events.append({
+                    "name": f"{rec.get('kernel', 'kernel')}:{e['tag']}",
+                    "ph": "X", "ts": float(e["seq"]), "dur": 1.0,
+                    "pid": corr[0], "tid": tid,
+                    "args": {"step": e["step"], "aux": e["aux"],
+                             "corr_span": corr[1]},
+                })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def export_chrome(path: str, trace_id: int | list[int] | None = None,
+                  kernel_traces: bool = False) -> str:
+    """Write :func:`to_chrome` JSON; returns the path (open the file in
+    ``chrome://tracing`` or ui.perfetto.dev)."""
+    with open(path, "w") as f:
+        json.dump(to_chrome(trace_id, kernel_traces=kernel_traces), f)
+    return path

@@ -60,6 +60,17 @@ def get_int_env(name: str, default: int = 0) -> int:
         return default
 
 
+def get_float_env(name: str, default: float = 0.0) -> float:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    try:
+        return float(v.strip())
+    except ValueError:
+        _warn_env_once(name, v, default)
+        return default
+
+
 def get_choice_env(name: str, choices: tuple[str, ...], default: str) -> str:
     """Env var restricted to an enumerated vocabulary, with the same
     warn-once-on-garbage policy as the bool/int parsers."""

@@ -45,7 +45,7 @@ import threading
 import time
 from typing import Callable
 
-from triton_dist_tpu.runtime import telemetry
+from triton_dist_tpu.runtime import telemetry, tracing
 
 
 class SlotState(enum.Enum):
@@ -86,6 +86,12 @@ class Request:
     state: RequestState = RequestState.QUEUED
     reject_reason: str | None = None
     tokens: list[int] = dataclasses.field(default_factory=list)
+    #: Per-request trace handle (``runtime.tracing``). ``submit`` opens it;
+    #: the server closes it at completion. Defaults to the no-op handle so
+    #: directly-constructed Requests stay safe to serve.
+    trace: tracing.Trace = dataclasses.field(
+        default=tracing.NOOP_TRACE, repr=False, compare=False
+    )
     submitted_at: float = 0.0
     arrived_at: float = 0.0
     first_token_at: float | None = None
@@ -154,6 +160,10 @@ class Scheduler:
         )
         now = time.monotonic() if now_s is None else now_s
         req.submitted_at = now
+        req.trace = tracing.start_trace(
+            "tdt_serving_request", req_id=req.req_id,
+            prompt_len=len(prompt), max_new=req.max_new,
+        )
         telemetry.inc("tdt_serving_requests_total")
         if not prompt or req.max_new < 1:
             return self._reject(req, "empty")
@@ -175,6 +185,7 @@ class Scheduler:
         req.reject_reason = reason
         telemetry.inc("tdt_serving_admission_rejects_total", reason=reason)
         telemetry.emit("serving_reject", req_id=req.req_id, reason=reason)
+        req.trace.finish(status="rejected", reason=reason)
         return req
 
     # ------------------------------------------------------------------ joins
@@ -204,6 +215,20 @@ class Scheduler:
         if joined:
             telemetry.set_gauge("tdt_serving_queue_depth", float(depth))
             self._occupancy_gauge()
+            # Queue wait = effective arrival → admission. Recorded here (not
+            # in TTFT) so queueing delay and prefill latency stop conflating.
+            # The span is retroactive: anchor its END at the tracing clock's
+            # now and stretch back by the wait measured in the caller's
+            # clock (both monotonic-derived, so durations transfer).
+            t_adm = tracing.now_s()
+            for slot in joined:
+                req = slot.request
+                wait = max(0.0, now_s - req.arrived_at)
+                telemetry.observe("tdt_serving_queue_wait_seconds", wait)
+                req.trace.record(
+                    "tdt_serving_queue_wait", t_adm - wait, t_adm,
+                    slot=slot.idx,
+                )
         return joined
 
     # ------------------------------------------------------------ transitions

@@ -49,7 +49,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from triton_dist_tpu.runtime import telemetry
+from triton_dist_tpu.runtime import telemetry, tracing
 from triton_dist_tpu.runtime.utils import get_int_env
 from triton_dist_tpu.serving.scheduler import (
     Request,
@@ -90,6 +90,17 @@ class InferenceServer:
             )
         )
         self._t0 = time.monotonic()
+        # Process-level trace owning the spans no single request owns
+        # (shared decode dispatches, recovery). Left open for the server's
+        # lifetime — introspection shows it as in-flight.
+        self._trace = tracing.start_trace(
+            "tdt_serving_server", slots=self.num_slots, chunk=self.chunk,
+            backend=getattr(engine, "backend", None),
+        )
+        # Live introspection endpoint (no-op unless TDT_HTTP_PORT is set).
+        from triton_dist_tpu.runtime import introspect
+
+        self._introspect = introspect.maybe_start()
 
     # ------------------------------------------------------------------ clock
     def _now(self) -> float:
@@ -157,9 +168,17 @@ class InferenceServer:
         req = slot.request
         ids = req.prompt + req.tokens[:-1]
         self._key, sub = jax.random.split(self._key)
-        token0, self.cache = self.engine.prefill_into_slot(
-            self.cache, slot.idx, jnp.asarray([ids], jnp.int32), key=sub
-        )
+        # The live span makes this request the AMBIENT trace while the
+        # prefill program traces/compiles — KernelTrace records collected
+        # during that compile correlate to this span (see telemetry.
+        # consume_kernel_trace).
+        with req.trace.span(
+            "tdt_serving_prefill", slot=slot.idx, hist_len=len(ids),
+            recovery=bool(req.tokens),
+        ):
+            token0, self.cache = self.engine.prefill_into_slot(
+                self.cache, slot.idx, jnp.asarray([ids], jnp.int32), key=sub
+            )
         if req.tokens:
             self._last[slot.idx] = req.tokens[-1]
             if slot.state is SlotState.PREFILL:
@@ -179,11 +198,22 @@ class InferenceServer:
         pre = {s.idx: int(self._remaining[s.idx]) for s in decoding}
         self._key, sub = jax.random.split(self._key)
         t0 = time.perf_counter()
-        out, tok, cache, _ = self._watchdog.call(
-            self.engine.decode_steps, self.cache,
-            jnp.asarray(self._last), jnp.asarray(self._remaining),
-            self.chunk, sub,
-        )
+        # One decode chunk is ONE shared device dispatch over the whole slot
+        # batch: it gets a single span in the SERVER trace (and is the
+        # ambient span while the chunk compiles, for KernelTrace
+        # correlation); each tenant then gets a per-slot chunk span in its
+        # own trace referencing the shared span's id.
+        d_start = tracing.now_s()
+        with self._trace.span(
+            "tdt_serving_dispatch", n_active=len(decoding), chunk=self.chunk
+        ) as dsp:
+            out, tok, cache, _ = self._watchdog.call(
+                self.engine.decode_steps, self.cache,
+                jnp.asarray(self._last), jnp.asarray(self._remaining),
+                self.chunk, sub,
+            )
+        d_end = tracing.now_s()
+        dispatch_id = dsp["span_id"] if dsp is not None else None
         self.cache = cache
         out_np = np.asarray(out)
         self._last = np.asarray(tok, dtype=np.int32).copy()
@@ -193,8 +223,18 @@ class InferenceServer:
         for slot in decoding:
             req = slot.request
             n_valid = min(pre[slot.idx], self.chunk)
+            req.trace.record(
+                "tdt_serving_decode_chunk", d_start, d_end,
+                slot=slot.idx, n_tokens=n_valid, dispatch=dispatch_id,
+            )
+            s_start = tracing.now_s()
             for j in range(n_valid):
                 self._stream(req, int(out_np[slot.idx, j]))
+            if n_valid:
+                req.trace.record(
+                    "tdt_serving_stream", s_start, tracing.now_s(),
+                    slot=slot.idx, n_tokens=n_valid,
+                )
             self._remaining[slot.idx] -= n_valid
             n_streamed += n_valid
             if self._remaining[slot.idx] == 0:
@@ -236,6 +276,8 @@ class InferenceServer:
                 req.on_finish(req)
             except Exception:
                 telemetry.inc("tdt_serving_callback_errors_total", kind="finish")
+        req.trace.point("tdt_serving_finish", slot=slot.idx)
+        req.trace.finish(status="ok", n_tokens=len(req.tokens))
 
     # --------------------------------------------------------------- recovery
     def _guarded(self, fn, what: str):
@@ -259,16 +301,18 @@ class InferenceServer:
 
     def _recover(self, why: str) -> None:
         eng = self.engine
+        from_backend = eng.backend
         occupied = self.scheduler.occupied_slots()
-        telemetry.inc("tdt_serving_recoveries_total", from_backend=eng.backend)
+        telemetry.inc("tdt_serving_recoveries_total", from_backend=from_backend)
         if occupied:
             # Each in-flight slot's decode is preempted by the rebuild (the
             # only preemption in the system) and re-prefilled from history.
             telemetry.inc("tdt_serving_preemptions_total", float(len(occupied)))
         telemetry.emit(
-            "serving_recovery", from_backend=eng.backend, why=why,
+            "serving_recovery", from_backend=from_backend, why=why,
             in_flight=len(occupied), queued=self.scheduler.queue_depth(),
         )
+        r_start = tracing.now_s()
         eng._degrade_to_xla(why)
         # The aborted dispatch consumed (donated) or may have poisoned the
         # old slot cache — rebuild it whole from each tenant's durable
@@ -276,3 +320,17 @@ class InferenceServer:
         self.cache = eng.alloc_slots(self.num_slots)
         for slot in occupied:
             self._prefill_slot(slot)
+        r_end = tracing.now_s()
+        # Recovery preempted every in-flight request — each affected trace
+        # gets the full rebuild+re-prefill interval as a span of its own
+        # (parented at its root), plus one in the server trace.
+        for slot in occupied:
+            if slot.request is not None:
+                slot.request.trace.record(
+                    "tdt_serving_recovery", r_start, r_end,
+                    why=why, from_backend=from_backend, slot=slot.idx,
+                )
+        self._trace.record(
+            "tdt_serving_recovery", r_start, r_end,
+            why=why, from_backend=from_backend, in_flight=len(occupied),
+        )

@@ -830,6 +830,97 @@ def bench_serving(on_tpu):
     return out
 
 
+def bench_serving_chaos(on_tpu):
+    """Chaos-arc serving benchmark (the SLO-guardrail subsystem): drive the
+    ``dist_ar`` server through a scripted abort → degraded-XLA recovery →
+    half-open probe → fused restore arc (``resilience.chaos_schedule``) and
+    report end-to-end tokens/s across the disruption plus the recovery
+    latency; a second sweep primes a pessimistic EWMA capacity estimate and
+    reports the overload shed rate. Gated by check_bench_regression.py:
+    ``serving_chaos_tokens_per_s`` (higher better) and
+    ``serving_chaos_recovery_ms`` (lower better); the shed rate is
+    informational (policy, not performance)."""
+    import os
+    import time
+
+    from triton_dist_tpu.models import PRESETS, DenseLLM, Engine
+    from triton_dist_tpu.runtime import resilience, telemetry
+    from triton_dist_tpu.runtime.mesh import initialize_distributed
+    from triton_dist_tpu.serving import InferenceServer, RequestState
+
+    ctx = initialize_distributed(
+        devices=jax.devices()[:1], axis_names=("tp",), set_default=False
+    )
+    model = DenseLLM(PRESETS["test-dense"], ctx, key=jax.random.PRNGKey(1))
+
+    slots, chunk = 4, 4
+    reqs = [
+        ([(7 * i + j) % 256 for j in range(4 + (3 * i) % 8)], 6 + (5 * i) % 8)
+        for i in range(16)
+    ]
+    out = {"serving_chaos_requests": len(reqs)}
+
+    def _hist(name):
+        entries = telemetry.snapshot()["histograms"].get(name) or []
+        count = sum(e["count"] for e in entries)
+        total = sum(e["sum"] for e in entries)
+        return count, total
+
+    prev_probe = os.environ.get("TDT_DEGRADE_PROBE_S")
+    os.environ["TDT_DEGRADE_PROBE_S"] = "0.05"
+    rec_count0, rec_sum0 = _hist("tdt_serving_recovery_seconds")
+    try:
+        eng = Engine(model, backend="dist_ar", max_len=64)
+        srv = InferenceServer(eng, num_slots=slots, chunk=chunk)
+        with resilience.chaos_schedule("abort@decode:1,heal"):
+            handles = [srv.submit(p, g) for p, g in reqs]
+            t0 = time.perf_counter()
+            srv.run()
+            wall = time.perf_counter() - t0
+            # Let the probe ladder converge back onto the fused backend so
+            # the arc it reports is the full degrade→restore round trip.
+            deadline = time.monotonic() + 10.0
+            while eng.backend != "dist_ar" and time.monotonic() < deadline:
+                if not srv.step():
+                    time.sleep(0.01)
+        toks = sum(len(h.tokens) for h in handles)
+        out["serving_chaos_tokens_per_s"] = round(toks / wall, 1)
+        out["serving_chaos_restored"] = float(eng.backend == "dist_ar")
+        rec_count, rec_sum = _hist("tdt_serving_recovery_seconds")
+        if rec_count > rec_count0:
+            out["serving_chaos_recovery_ms"] = round(
+                1e3 * (rec_sum - rec_sum0) / (rec_count - rec_count0), 2
+            )
+
+        # Shed-rate sweep: a deliberately pessimistic capacity estimate
+        # (1 token/s) makes any queue blow the 50 ms budget, so every
+        # sheddable-priority submission past the first is rejected before
+        # admission while priority-0 traffic rides through.
+        srv2 = InferenceServer(eng, num_slots=slots, chunk=chunk,
+                               shed_wait_s=0.05)
+        srv2.scheduler.note_decode_rate(1, 1.0)
+        shed_handles = [
+            srv2.submit(p, g, priority=i % 2) for i, (p, g) in enumerate(reqs)
+        ]
+        n_shed = sum(
+            1 for h in shed_handles
+            if h.state is RequestState.REJECTED
+            and h.reject_reason == "shed_overload"
+        )
+        srv2.run()  # drain what was admitted
+        out["serving_chaos_shed_rate"] = round(n_shed / len(reqs), 3)
+    finally:
+        # The chaos arc OPENed the collectives breaker in process-global
+        # state — clear it (and the probe-cadence override) so later bench
+        # sections trace fused routing again.
+        resilience.reset_degradation()
+        if prev_probe is None:
+            os.environ.pop("TDT_DEGRADE_PROBE_S", None)
+        else:
+            os.environ["TDT_DEGRADE_PROBE_S"] = prev_probe
+    return out
+
+
 def bench_dma_overlap_capture(on_tpu):
     """DURATION-overlap evidence in the driver record (r4 verdict missing
     #4's on-chip half): capture an XProf trace of the fused AG-GEMM kernel
@@ -1419,6 +1510,15 @@ def main():
         emit()
     else:
         extra["serving_skipped"] = "budget"
+    if remaining() > 45:
+        phase("serving_chaos")
+        try:
+            absorb(bench_serving_chaos(on_tpu))
+        except Exception as e:  # noqa: BLE001
+            extra["serving_chaos_error"] = f"{type(e).__name__}"
+        emit()
+    else:
+        extra["serving_chaos_skipped"] = "budget"
     if remaining() > 60:
         phase("dma_overlap")
         try:

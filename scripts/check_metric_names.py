@@ -59,6 +59,28 @@ TRACING_FNS = {"span", "record", "point", "start_trace", "root_span",
 METRIC_NAME = re.compile(r"^tdt_[a-z0-9]+_[a-z0-9_]+$")
 EVENT_KIND = re.compile(r"^[a-z][a-z0-9_]*$")
 
+#: Drift guard for the SLO-guardrail series: docs, dashboards, and the
+#: chaos/regression tooling reference these names, so a rename that passes
+#: the per-line lint is still a breakage. Enforced only on a default-roots
+#: run (explicit paths lint third-party files that owe us nothing).
+REQUIRED_NAMES = {
+    # shed / deadline / cancel (serving)
+    "tdt_serving_shed_total",
+    "tdt_serving_cancelled_total",
+    "tdt_serving_deadline_expiries_total",
+    "tdt_serving_deadline_overrun_seconds",
+    # circuit breaker / probe / chaos (resilience)
+    "tdt_degrade_state",
+    "tdt_resilience_breaker_transitions_total",
+    "tdt_resilience_probes_total",
+    "tdt_resilience_chaos_injected_total",
+    "tdt_mesh_connect_retries_total",
+    # span names
+    "tdt_serving_probe",
+    "tdt_serving_restore",
+    "tdt_serving_recovery",
+}
+
 
 def _is_telemetry_call(node: ast.Call) -> str | None:
     """Return the called function name when this is ``telemetry.<fn>(...)``
@@ -90,7 +112,7 @@ def _is_tracing_call(node: ast.Call) -> str | None:
     return None
 
 
-def check_file(path: pathlib.Path) -> list[str]:
+def check_file(path: pathlib.Path, seen: set[str] | None = None) -> list[str]:
     src = path.read_text()
     try:
         tree = ast.parse(src, filename=str(path))
@@ -122,6 +144,8 @@ def check_file(path: pathlib.Path) -> list[str]:
             elif not METRIC_NAME.match(first.value):
                 err(node, f"span name {first.value!r} does not match "
                           "tdt_<subsystem>_<name> (lowercase, >=3 segments)")
+            elif seen is not None:
+                seen.add(first.value)
             continue
         fname = _is_telemetry_call(node)
         if fname is None or not node.args:
@@ -134,6 +158,8 @@ def check_file(path: pathlib.Path) -> list[str]:
             elif not METRIC_NAME.match(first.value):
                 err(node, f"metric name {first.value!r} does not match "
                           "tdt_<subsystem>_<name> (lowercase, >=3 segments)")
+            elif seen is not None:
+                seen.add(first.value)
         elif fname in EVENT_FNS:
             if isinstance(first, ast.Constant) and first.value is None:
                 continue  # events(kind=None) positional form
@@ -146,6 +172,7 @@ def check_file(path: pathlib.Path) -> list[str]:
 
 
 def main(argv: list[str]) -> int:
+    default_run = not argv
     roots = [pathlib.Path(a) for a in argv] or list(DEFAULT_ROOTS)
     files: list[pathlib.Path] = []
     for root in roots:
@@ -155,8 +182,17 @@ def main(argv: list[str]) -> int:
             files.append(root)
 
     errors = []
+    seen: set[str] = set()
     for f in files:
-        errors.extend(check_file(f))
+        errors.extend(check_file(f, seen))
+
+    if default_run:
+        for name in sorted(REQUIRED_NAMES - seen):
+            errors.append(
+                f"required metric/span name {name!r} is referenced nowhere in "
+                "the scanned sources — renamed without updating "
+                "REQUIRED_NAMES (and docs/dashboards)?"
+            )
 
     if errors:
         print(f"check_metric_names: {len(errors)} violation(s)")

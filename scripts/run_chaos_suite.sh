@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# Run the scripted chaos suite: every `-m chaos` test (fault-injection
+# collectives, degraded-mode serving recovery, probe-driven un-degrade)
+# under fast, deterministic resilience knobs.
+#
+# Usage: scripts/run_chaos_suite.sh [extra pytest args...]
+#
+# The env pins below make the arcs quick and reproducible:
+#   * TDT_WAIT_BOUND_ITERS bounds interpret-mode collective waits so an
+#     injected dead peer aborts in milliseconds, not at the 1e6-poll cap.
+# Probe cadence (TDT_DEGRADE_PROBE_S) and fault programs
+# (TDT_CHAOS_SCHEDULE / resilience.chaos_schedule) are deliberately NOT
+# pinned here: each chaos test scripts its own arc — some need probes in
+# ~10ms, some need probing off entirely — and a process-wide default would
+# leak across tests with different contracts.
+set -u
+cd "$(dirname "$0")/.."
+
+: "${JAX_PLATFORMS:=cpu}"
+export JAX_PLATFORMS
+export TDT_WAIT_BOUND_ITERS="${TDT_WAIT_BOUND_ITERS:-20000}"
+unset TDT_CHAOS_SCHEDULE TDT_DEGRADE_PROBE_S
+
+exec python -m pytest tests/ -m chaos -q \
+  -p no:cacheprovider -p no:randomly "$@"

@@ -1,0 +1,265 @@
+"""Scripted chaos harness tests: ChaosSchedule semantics and the full
+degrade → probe → restore serving arcs the single-shot FaultPlan cannot
+express.
+
+The serving arcs run the world=1 test-dense engine on the ``dist_ar``
+backend (every collective short-circuits world==1 to plain XLA, so the
+backend label is what changes — no TPU interpret machinery needed) and
+assert the ISSUE acceptance bar: fused serving → injected abort →
+degraded-XLA recovery with zero token loss/duplication → half-open probe
+→ fused routing restored IN-PROCESS, with every transition visible in
+telemetry.
+
+Run the suite standalone via ``scripts/run_chaos_suite.sh``.
+"""
+
+import os
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from triton_dist_tpu.runtime import resilience, telemetry
+from triton_dist_tpu.runtime.platform import tpu_interpret_available
+from triton_dist_tpu.serving import InferenceServer
+
+MAX_LEN = 32
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _single_device_kernels():
+    if tpu_interpret_available():
+        yield
+        return
+    prev = os.environ.get("TDT_INTERPRET_FALLBACK")
+    os.environ["TDT_INTERPRET_FALLBACK"] = "1"
+    jax.clear_caches()
+    yield
+    if prev is None:
+        os.environ.pop("TDT_INTERPRET_FALLBACK", None)
+    else:
+        os.environ["TDT_INTERPRET_FALLBACK"] = prev
+    jax.clear_caches()
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    telemetry.reset()
+    resilience.reset_degradation()
+    yield
+    telemetry.reset()
+    resilience.reset_degradation()
+
+
+@pytest.fixture(scope="module")
+def model1():
+    from triton_dist_tpu.models import PRESETS, DenseLLM
+    from triton_dist_tpu.runtime.mesh import initialize_distributed
+    from triton_dist_tpu.runtime.platform import cpu_mesh
+
+    m = cpu_mesh((1,), ("tp",))
+    ctx = initialize_distributed(
+        devices=list(m.devices.flat), axis_names=("tp",), set_default=False
+    )
+    return DenseLLM(PRESETS["test-dense"], ctx, key=jax.random.PRNGKey(1))
+
+
+def make_engine(model1, backend="xla"):
+    from triton_dist_tpu.models import Engine
+
+    return Engine(model1, backend=backend, max_len=MAX_LEN)
+
+
+REQUESTS = [
+    ([3, 17, 42, 7, 99], 6),
+    ([8, 1, 13], 4),
+    ([100, 200, 30], 5),
+    ([91, 12, 55, 2, 8, 41], 4),
+]
+
+
+def _references(eng):
+    import jax.numpy as jnp
+
+    return [
+        np.asarray(eng.serve(jnp.asarray([p], jnp.int32), gen_len=g))[0]
+        for p, g in REQUESTS
+    ]
+
+
+# ================================================= ChaosSchedule (host)
+
+
+def test_chaos_schedule_parse_and_consume():
+    s = resilience.ChaosSchedule("abort@decode:1, abort@probe ,heal")
+    assert [(e.action, e.site, e.skip) for e in s.events] == [
+        ("abort", "decode", 1), ("abort", "probe", 0),
+    ]
+    assert not s.exhausted
+    # Checks naming other sites pass through without consuming the head.
+    assert s.take("prefill") is None
+    # skip=1: the first matching check passes, the second fires.
+    assert s.take("decode") is None
+    assert s.take("probe") is None  # still queued behind the decode event
+    ev = s.take("decode")
+    assert ev is not None and ev.action == "abort"
+    ev2 = s.take("probe")
+    assert ev2 is not None and s.exhausted
+    assert s.take("probe") is None  # exhausted programs stay exhausted
+
+
+@pytest.mark.parametrize("spec", [
+    "heal,abort@decode",        # heal must be last
+    "explode@decode",           # unknown action
+    "abort@",                   # empty site
+    "abort@decode:x",           # non-integer skip
+    "abortdecode",              # missing @
+])
+def test_chaos_schedule_rejects_bad_specs(spec):
+    with pytest.raises(ValueError):
+        resilience.ChaosSchedule(spec)
+
+
+def test_chaos_check_context_beats_env(monkeypatch):
+    monkeypatch.setenv("TDT_CHAOS_SCHEDULE", "abort@decode")
+    with resilience.chaos_schedule("heal"):
+        resilience.chaos_check("decode")  # context program is empty: no-op
+    assert not resilience.is_degraded("collectives")
+    # A malformed env spec is logged and ignored, never raises.
+    monkeypatch.setenv("TDT_CHAOS_SCHEDULE", "garbage")
+    resilience.chaos_check("decode")
+    assert not resilience.is_degraded("collectives")
+
+
+def test_chaos_check_abort_marks_and_raises():
+    with resilience.chaos_schedule("abort@prefill,heal"):
+        with pytest.raises(resilience.CollectiveAbortError):
+            resilience.chaos_check("prefill")
+        resilience.chaos_check("prefill")  # program exhausted: clean
+    assert resilience.is_degraded("collectives")
+    assert telemetry.counter_value(
+        "tdt_resilience_chaos_injected_total", site="prefill"
+    ) == 1.0
+    (ev,) = telemetry.events("chaos_inject")
+    assert ev["site"] == "prefill" and ev["action"] == "abort"
+
+
+# ======================================== probe arc: degrade → restore
+
+
+@pytest.mark.chaos
+def test_chaos_probe_arc_restores_fused_backend(model1, monkeypatch):
+    """The ISSUE acceptance arc: fused serving → chaos abort on the second
+    decode chunk → degraded-XLA recovery (zero loss/dup) → first half-open
+    probe FAILS (scripted) and doubles the backoff → second probe succeeds
+    → fused routing restored in-process, breaker CLOSED, all transitions
+    visible in telemetry."""
+    monkeypatch.setenv("TDT_DEGRADE_PROBE_S", "0.01")
+    ref_eng = make_engine(model1, backend="xla")
+    refs = _references(ref_eng)
+
+    eng = make_engine(model1, backend="dist_ar")
+    srv = InferenceServer(eng, num_slots=2, chunk=2)
+    streams: dict[int, list[int]] = {}
+    with resilience.chaos_schedule("abort@decode:1,abort@probe,heal"):
+        handles = [
+            srv.submit(p, g, on_token=lambda r, t, i: streams.setdefault(
+                r.req_id, []).append(t))
+            for p, g in REQUESTS
+        ]
+        srv.run()
+        # The queue drained; keep stepping until the probe ladder converges
+        # back onto the preferred backend (backoffs are 10–20ms here).
+        deadline = time.monotonic() + 30.0
+        while eng.backend != "dist_ar":
+            assert time.monotonic() < deadline, "probe never restored fused"
+            if not srv.step():
+                time.sleep(0.005)
+
+    # Zero token loss, zero duplication, byte-identical to the one-shot
+    # greedy reference across the whole degrade/restore arc.
+    for h, ref in zip(handles, refs):
+        assert h.done
+        np.testing.assert_array_equal(np.asarray(h.tokens, np.int32), ref)
+        assert streams[h.req_id] == list(h.tokens)
+
+    assert eng.backend == "dist_ar"
+    assert not resilience.any_degraded()
+    # Breaker walked open → half_open → open (failed probe, backoff
+    # doubled) → half_open → closed, and telemetry saw every transition.
+    trans = [
+        (e["from_state"], e["to_state"])
+        for e in telemetry.events("breaker_transition")
+        if e["feature"] == "collectives"
+    ]
+    assert trans == [
+        ("closed", "open"), ("open", "half_open"), ("half_open", "open"),
+        ("open", "half_open"), ("half_open", "closed"),
+    ]
+    assert telemetry.counter_value(
+        "tdt_resilience_probes_total", feature="collectives", outcome="failed"
+    ) == 1.0
+    assert telemetry.counter_value(
+        "tdt_resilience_probes_total", feature="collectives", outcome="ok"
+    ) == 1.0
+    assert telemetry.counter_value(
+        "tdt_serving_recoveries_total", from_backend="dist_ar"
+    ) == 1.0
+    assert telemetry.counter_value(
+        "tdt_serving_restores_total", to_backend="dist_ar"
+    ) == 1.0
+    assert telemetry.counter_value(
+        "tdt_resilience_chaos_injected_total", site="decode"
+    ) == 1.0
+    assert telemetry.counter_value(
+        "tdt_resilience_chaos_injected_total", site="probe"
+    ) == 1.0
+    # The dashboard gauge ends healthy.
+    (g,) = telemetry.snapshot()["gauges"]["tdt_degrade_state"]
+    assert g["labels"] == {"feature": "collectives"} and g["value"] == 0.0
+    # The failed probe left its event; both probes left server-trace spans.
+    assert len(telemetry.events("serving_probe_failed")) == 1
+    assert len(telemetry.events("serving_restore")) == 1
+
+
+@pytest.mark.chaos
+def test_chaos_double_fault_recovery_stays_degraded(model1, monkeypatch):
+    """Double fault: the chunk abort's recovery re-prefill is ITSELF
+    aborted (site 'recovery'). The bounded retry loop absorbs it on a
+    fresh cache and — with probing disabled — the engine stays pinned on
+    xla, still with zero token loss or duplication."""
+    monkeypatch.setenv("TDT_DEGRADE_PROBE_S", "0")  # sticky: no un-degrade
+    ref_eng = make_engine(model1, backend="xla")
+    refs = _references(ref_eng)
+
+    eng = make_engine(model1, backend="dist_ar")
+    srv = InferenceServer(eng, num_slots=2, chunk=2)
+    streams: dict[int, list[int]] = {}
+    with resilience.chaos_schedule("abort@decode:1,abort@recovery,heal"):
+        handles = [
+            srv.submit(p, g, on_token=lambda r, t, i: streams.setdefault(
+                r.req_id, []).append(t))
+            for p, g in REQUESTS
+        ]
+        srv.run()
+
+    for h, ref in zip(handles, refs):
+        assert h.done
+        np.testing.assert_array_equal(np.asarray(h.tokens, np.int32), ref)
+        assert streams[h.req_id] == list(h.tokens)
+
+    assert eng.backend == "xla"
+    assert resilience.probe_due() == []  # probing disabled: stays sticky
+    assert resilience.is_degraded("collectives")
+    assert telemetry.counter_value("tdt_serving_recovery_retries_total") == 1.0
+    assert telemetry.counter_value(
+        "tdt_resilience_chaos_injected_total", site="recovery"
+    ) == 1.0
+    (retry,) = telemetry.events("serving_recovery_retry")
+    assert retry["attempt"] == 1
+    # One recovery total: the double fault retried INSIDE it, not a second
+    # full recovery.
+    assert telemetry.counter_value(
+        "tdt_serving_recoveries_total", from_backend="dist_ar"
+    ) == 1.0

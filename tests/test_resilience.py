@@ -350,6 +350,9 @@ def _patch_mesh_connect(monkeypatch, fail_times):
 
 
 def test_mesh_connect_retries_then_succeeds(monkeypatch):
+    from triton_dist_tpu.runtime import telemetry
+
+    telemetry.reset()
     mesh, calls = _patch_mesh_connect(monkeypatch, fail_times=2)
     ctx = mesh.initialize_distributed(
         coordinator_address="198.51.100.7:1234", num_processes=1, process_id=0,
@@ -357,7 +360,13 @@ def test_mesh_connect_retries_then_succeeds(monkeypatch):
     )
     assert ctx.world_size >= 1
     assert calls["init"] == 3
-    assert calls["sleeps"] == [0.5, 1.0]  # exponential backoff
+    # Exponential backoff with full jitter: each sleep lands in 0.5–1x of
+    # its capped base (0.5, then 1.0) — never the deterministic lockstep
+    # that stampedes a coordinator on gang restarts.
+    assert len(calls["sleeps"]) == 2
+    for s, base in zip(calls["sleeps"], (0.5, 1.0)):
+        assert 0.5 * base <= s <= base, (s, base)
+    assert telemetry.counter_total("tdt_mesh_connect_retries_total") == 2
     assert mesh._JAX_DISTRIBUTED_INITIALIZED
 
 
@@ -370,6 +379,23 @@ def test_mesh_connect_exhausted_names_coordinator(monkeypatch):
         )
     assert calls["init"] == 3
     assert not mesh._JAX_DISTRIBUTED_INITIALIZED
+
+
+def test_mesh_connect_backoff_hard_cap(monkeypatch):
+    # With a long retry ladder the base doubles but never exceeds the cap.
+    monkeypatch.setenv("TDT_CONNECT_RETRIES", "6")
+    monkeypatch.setenv("TDT_CONNECT_BACKOFF_CAP_S", "2.0")
+    mesh, calls = _patch_mesh_connect(monkeypatch, fail_times=99)
+    with pytest.raises(RuntimeError, match="after 6 attempts"):
+        mesh.initialize_distributed(
+            coordinator_address="198.51.100.7:1234", num_processes=1, process_id=0,
+            set_default=False,
+        )
+    assert calls["init"] == 6 and len(calls["sleeps"]) == 5
+    assert all(s <= 2.0 for s in calls["sleeps"]), calls["sleeps"]
+    # The last rungs would be 4s/8s uncapped — they must sit in the
+    # jittered band of the 2s cap instead.
+    assert all(1.0 <= s <= 2.0 for s in calls["sleeps"][2:]), calls["sleeps"]
 
 
 # ------------------------------------------------------- bounded-wait lint

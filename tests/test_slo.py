@@ -1,0 +1,351 @@
+"""SLO-guardrail tests: deadlines, cancellation, and overload shedding.
+
+Scheduler-level tests are pure host (no jax); the server-level tests run
+the same world=1 test-dense engine as ``test_serving.py`` — every
+collective short-circuits to plain XLA, so only the generic-interpreter
+fallback for the single-device Pallas kernels is needed.
+
+The contract under test (see ``docs/resilience.md``):
+
+* a request whose deadline cannot be met never spends a slot — rejected at
+  submit (``shed_deadline``) or expired by the queue sweep;
+* a burst beyond the EWMA-projected decode capacity sheds low-priority
+  traffic BEFORE admission (``shed_overload``), priority 0 exempt, and
+  /healthz turns not-ready for the shed window;
+* ``cancel`` finalizes a queued request immediately and frees a running
+  slot at the next chunk boundary; terminal requests are never
+  re-finalized (no double-free).
+"""
+
+import os
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from triton_dist_tpu.runtime import introspect, resilience, telemetry
+from triton_dist_tpu.runtime.platform import tpu_interpret_available
+from triton_dist_tpu.serving import (
+    InferenceServer,
+    RequestState,
+    Scheduler,
+    SlotState,
+)
+
+MAX_LEN = 32
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _single_device_kernels():
+    if tpu_interpret_available():
+        yield
+        return
+    prev = os.environ.get("TDT_INTERPRET_FALLBACK")
+    os.environ["TDT_INTERPRET_FALLBACK"] = "1"
+    jax.clear_caches()
+    yield
+    if prev is None:
+        os.environ.pop("TDT_INTERPRET_FALLBACK", None)
+    else:
+        os.environ["TDT_INTERPRET_FALLBACK"] = prev
+    jax.clear_caches()
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    telemetry.reset()
+    resilience.reset_degradation()
+    introspect.set_health_provider(None)
+    yield
+    telemetry.reset()
+    resilience.reset_degradation()
+    introspect.set_health_provider(None)
+
+
+@pytest.fixture(scope="module")
+def model1():
+    from triton_dist_tpu.models import PRESETS, DenseLLM
+    from triton_dist_tpu.runtime.mesh import initialize_distributed
+    from triton_dist_tpu.runtime.platform import cpu_mesh
+
+    m = cpu_mesh((1,), ("tp",))
+    ctx = initialize_distributed(
+        devices=list(m.devices.flat), axis_names=("tp",), set_default=False
+    )
+    return DenseLLM(PRESETS["test-dense"], ctx, key=jax.random.PRNGKey(1))
+
+
+def make_engine(model1, backend="xla"):
+    from triton_dist_tpu.models import Engine
+
+    return Engine(model1, backend=backend, max_len=MAX_LEN)
+
+
+# =================================================== deadlines (scheduler)
+
+
+def test_nonpositive_deadline_sheds_at_submit():
+    sched = Scheduler(num_slots=1, max_len=MAX_LEN)
+    r = sched.submit([1, 2], max_new=2, ttft_deadline_s=0.0)
+    assert r.state is RequestState.REJECTED and r.reject_reason == "shed_deadline"
+    r2 = sched.submit([1, 2], max_new=2, deadline_s=-1.0)
+    assert r2.reject_reason == "shed_deadline"
+    assert sched.queue_depth() == 0
+    assert telemetry.counter_value(
+        "tdt_serving_shed_total", reason="shed_deadline", priority=1
+    ) == 2.0
+
+
+def test_env_default_deadlines(monkeypatch):
+    monkeypatch.setenv("TDT_DEADLINE_TTFT_S", "1.5")
+    monkeypatch.setenv("TDT_DEADLINE_TOTAL_S", "9.0")
+    sched = Scheduler(num_slots=1, max_len=MAX_LEN)
+    r = sched.submit([1, 2], max_new=2)
+    assert r.ttft_deadline_s == 1.5 and r.deadline_s == 9.0
+    # Explicit args override the env defaults.
+    r2 = sched.submit([1, 2], max_new=2, ttft_deadline_s=0.25, deadline_s=2.0)
+    assert r2.ttft_deadline_s == 0.25 and r2.deadline_s == 2.0
+
+
+def test_queue_time_expiry_frees_nothing_and_fires_callbacks():
+    """A queued request whose TTFT budget lapses before a slot frees is
+    expired by the join sweep — even when NO slot is free — with the
+    overrun recorded and on_finish fired exactly once."""
+    finished = []
+    sched = Scheduler(num_slots=1, max_len=MAX_LEN)
+    a = sched.submit([1, 2], max_new=4, now_s=0.0)
+    (slot,) = sched.join_free_slots(now_s=0.0)
+    assert slot.request is a  # occupies the only slot
+    b = sched.submit(
+        [3, 4], max_new=4, now_s=0.0, ttft_deadline_s=1.0,
+        on_finish=lambda r: finished.append(r.req_id),
+    )
+    # Sweep with no free slot: b is past its budget and must not keep
+    # waiting for capacity it can no longer use.
+    assert sched.join_free_slots(now_s=2.5) == []
+    assert b.state is RequestState.REJECTED
+    assert b.reject_reason == "shed_deadline"
+    assert finished == [b.req_id]
+    assert sched.queue_depth() == 0
+    assert telemetry.counter_value(
+        "tdt_serving_deadline_expiries_total", where="queue"
+    ) == 1.0
+    (h,) = telemetry.snapshot()["histograms"]["tdt_serving_deadline_overrun_seconds"]
+    assert h["count"] == 1 and abs(h["sum"] - 1.5) < 1e-9
+    # A not-yet-arrived request can NOT expire: its clock has not started.
+    c = sched.submit([5], max_new=2, arrival_time_s=10.0, now_s=0.0,
+                     ttft_deadline_s=0.5)
+    sched.join_free_slots(now_s=5.0)
+    assert c.state is RequestState.QUEUED
+
+
+# ==================================================== shedding (scheduler)
+
+
+def test_overload_shed_priority_classes():
+    sched = Scheduler(num_slots=1, max_len=MAX_LEN, shed_wait_s=0.05,
+                      shed_priority=1)
+    # Never shed blind: before any decode observation est_wait_s is None.
+    assert sched.est_wait_s() is None
+    a = sched.submit([1, 2], max_new=8, now_s=0.0)
+    assert a.state is RequestState.QUEUED
+    # 10 tokens/s EWMA, 8 tokens backlogged -> projected wait 0.8s >> 0.05s.
+    sched.note_decode_rate(10, 1.0)
+    assert sched.est_wait_s() == pytest.approx(0.8)
+    low = sched.submit([3, 4], max_new=4, now_s=1.0, priority=1)
+    assert low.state is RequestState.REJECTED
+    assert low.reject_reason == "shed_overload"
+    # Priority 0 rides through the same overload.
+    vip = sched.submit([5, 6], max_new=4, now_s=1.0, priority=0)
+    assert vip.state is RequestState.QUEUED
+    assert telemetry.counter_value(
+        "tdt_serving_shed_total", reason="shed_overload", priority=1
+    ) == 1.0
+    # /healthz signal: not-ready inside the shed window, ready after.
+    assert sched.shedding(now_s=1.0 + sched.shed_health_s - 0.1)
+    assert not sched.shedding(now_s=1.0 + sched.shed_health_s + 0.1)
+
+
+def test_shed_against_request_ttft_budget():
+    """With no global shed budget, the request's own TTFT deadline is the
+    overload bound: a projected wait beyond it sheds at submit."""
+    sched = Scheduler(num_slots=1, max_len=MAX_LEN, shed_wait_s=0.0)
+    sched.submit([1, 2], max_new=8, now_s=0.0)
+    sched.note_decode_rate(10, 1.0)  # projected wait now 0.8s
+    r = sched.submit([3, 4], max_new=4, now_s=0.0, ttft_deadline_s=0.5)
+    assert r.reject_reason == "shed_overload"
+    # A budget the projection fits is admitted.
+    ok = sched.submit([3, 4], max_new=4, now_s=0.0, ttft_deadline_s=5.0)
+    assert ok.state is RequestState.QUEUED
+    # No budget at all (and no global one): nothing to shed against.
+    free = sched.submit([3, 4], max_new=4, now_s=0.0)
+    assert free.state is RequestState.QUEUED
+
+
+def test_healthz_not_ready_under_shed_pressure(model1):
+    eng = make_engine(model1)
+    srv = InferenceServer(eng, num_slots=1, chunk=2, shed_wait_s=0.01)
+    code, body = introspect._healthz()
+    assert code == 200 and body["status"] == "ok" and body["ready"]
+    assert body["serving"]["backend"] == "xla"
+    # Force a shed: prime the EWMA, backlog one queued request, submit.
+    srv.submit([1, 2], max_new=8)
+    srv.scheduler.note_decode_rate(1, 1.0)  # 1 token/s: any queue blows 10ms
+    shed = srv.submit([3, 4], max_new=8)
+    assert shed.reject_reason == "shed_overload"
+    code, body = introspect._healthz()
+    assert code == 503 and body["status"] == "shedding" and not body["ready"]
+    assert body["serving"]["shedding"] is True
+    assert body["degraded"] == {}  # shedding is not a breaker state
+
+
+# ================================================ cancellation (scheduler)
+
+
+def test_cancel_queued_finalizes_immediately():
+    finished = []
+    sched = Scheduler(num_slots=1, max_len=MAX_LEN)
+    r = sched.submit([1, 2], max_new=4,
+                     on_finish=lambda q: finished.append(q.req_id))
+    assert sched.cancel(r.req_id) is True
+    assert r.state is RequestState.CANCELLED and r.finish_reason == "cancelled"
+    assert sched.queue_depth() == 0 and finished == [r.req_id]
+    assert telemetry.counter_value(
+        "tdt_serving_cancelled_total", where="queued"
+    ) == 1.0
+    # Terminal: a second cancel is refused, callbacks do not re-fire.
+    assert sched.cancel(r.req_id) is False
+    assert finished == [r.req_id]
+    # The sweep never resurrects it.
+    assert sched.join_free_slots(now_s=0.0) == []
+
+
+def test_cancel_running_flags_only():
+    sched = Scheduler(num_slots=1, max_len=MAX_LEN)
+    r = sched.submit([1, 2], max_new=4)
+    (slot,) = sched.join_free_slots(now_s=0.0)
+    assert sched.cancel(r.req_id) is True
+    assert r.cancel_requested and r.state is RequestState.RUNNING
+    assert slot.state is SlotState.PREFILL  # the scheduler does NOT free it
+    assert sched.cancel(r.req_id) is True  # idempotent while running
+    assert len(telemetry.events("serving_cancel")) == 1  # flagged once
+    # Unknown ids are refused.
+    assert sched.cancel(10_000) is False
+
+
+def test_cancel_race_with_sweep_cannot_double_free():
+    """cancel() finalizing a queued request concurrently with the join
+    sweep: the sweep must skip the CANCELLED tombstone, not admit it."""
+    sched = Scheduler(num_slots=2, max_len=MAX_LEN)
+    a = sched.submit([1], max_new=2)
+    b = sched.submit([2], max_new=2)
+    assert sched.cancel(a.req_id)
+    (slot,) = sched.join_free_slots(now_s=0.0)
+    assert slot.request is b  # a's tombstone was skipped, order held
+    assert a.state is RequestState.CANCELLED
+
+
+# ======================================= satellite: scheduler edge cases
+
+
+def test_queue_full_rejects_even_with_free_slots():
+    """The queue bound is an admission bound, not a capacity bound: slots
+    only fill at the join sweep, so a bounded queue can reject while every
+    slot is FREE."""
+    sched = Scheduler(num_slots=4, max_len=MAX_LEN, queue_limit=1)
+    assert all(s.state is SlotState.FREE for s in sched.slots)
+    a = sched.submit([1], max_new=2)
+    b = sched.submit([2], max_new=2)
+    assert a.state is RequestState.QUEUED
+    assert b.state is RequestState.REJECTED and b.reject_reason == "queue_full"
+    # After the sweep drains the queue, admission reopens.
+    sched.join_free_slots(now_s=0.0)
+    c = sched.submit([3], max_new=2)
+    assert c.state is RequestState.QUEUED
+
+
+def test_fcfs_preserved_across_deferrals_and_expiries():
+    """One sweep mixing a future arrival, an expired request, an admit, and
+    a no-capacity deferral must keep strict submission order in the queue
+    — expiry and deferral must not reorder anything."""
+    sched = Scheduler(num_slots=1, max_len=MAX_LEN)
+    future = sched.submit([1], max_new=2, arrival_time_s=5.0, now_s=0.0)
+    doomed = sched.submit([2], max_new=2, now_s=0.0, ttft_deadline_s=0.5)
+    a = sched.submit([3], max_new=2, now_s=0.0)
+    b = sched.submit([4], max_new=2, now_s=0.0)
+    (slot,) = sched.join_free_slots(now_s=1.0)
+    assert slot.request is a  # first *eligible* submitter wins
+    assert doomed.reject_reason == "shed_deadline"
+    assert future.state is RequestState.QUEUED
+    assert b.state is RequestState.QUEUED
+    assert sched.queue_depth() == 2
+    # Free the slot past `future`'s arrival: submission order (future came
+    # first) decides, not eligibility order.
+    sched.start_decode(slot)
+    sched.finish(slot)
+    sched.release(slot)
+    (s2,) = sched.join_free_slots(now_s=6.0)
+    assert s2.request is future
+    sched.finish(s2)
+    sched.release(s2)
+    (s3,) = sched.join_free_slots(now_s=6.0)
+    assert s3.request is b
+
+
+# ===================================================== server-level SLOs
+
+
+def test_mid_decode_cancel_frees_slot_within_one_chunk(model1):
+    eng = make_engine(model1)
+    srv = InferenceServer(eng, num_slots=2, chunk=2)
+    finished = []
+    r = srv.submit([3, 17, 42], max_new=12,
+                   on_finish=lambda q: finished.append(q.finish_reason))
+    other = srv.submit([8, 1], max_new=4)
+    assert srv.step()  # join + prefill + one decode chunk
+    assert r.state is RequestState.RUNNING and len(r.tokens) >= 1
+    n_before = len(r.tokens)
+    assert srv.cancel(r.req_id) is True
+    srv.step()  # the next chunk boundary reaps it BEFORE decoding
+    assert r.state is RequestState.CANCELLED and r.finish_reason == "cancelled"
+    assert len(r.tokens) == n_before  # nothing streamed after the cancel
+    assert finished == ["cancelled"]
+    assert telemetry.counter_value(
+        "tdt_serving_cancelled_total", where="running"
+    ) == 1.0
+    # The slot is genuinely free: a double cancel is refused and the other
+    # stream (and a new tenant) drain normally through the freed capacity.
+    assert srv.cancel(r.req_id) is False
+    late = srv.submit([5, 5, 5], max_new=3)
+    srv.run()
+    assert other.done and len(other.tokens) == 4
+    assert late.done and len(late.tokens) == 3
+    assert srv.scheduler.occupancy() == 0
+    # Cancelled streams do not count as completions.
+    assert telemetry.counter_value("tdt_serving_requests_completed_total") == 2.0
+
+
+def test_mid_decode_deadline_truncates_with_distinct_reason(model1):
+    eng = make_engine(model1)
+    srv = InferenceServer(eng, num_slots=1, chunk=1)
+    # Warm the prefill/chunk compiles first — a cold compile inside the
+    # request's budget would (correctly) expire it before decode starts.
+    warm = srv.submit([3, 17, 42], max_new=2)
+    srv.run()
+    assert warm.done
+    r = srv.submit([3, 17, 42], max_new=20, deadline_s=0.3)
+    assert srv.step()
+    assert r.state is RequestState.RUNNING
+    time.sleep(0.35)  # blow the total budget mid-decode
+    srv.step()  # reaped at the chunk boundary
+    assert r.state is RequestState.DONE and r.finish_reason == "deadline"
+    assert 0 < len(r.tokens) < 20  # truncated, not completed or dropped
+    assert srv.scheduler.occupancy() == 0
+    assert telemetry.counter_value(
+        "tdt_serving_deadline_expiries_total", where="decode"
+    ) == 1.0
+    # Only the warm-up stream counts as a completion.
+    assert telemetry.counter_value("tdt_serving_requests_completed_total") == 1.0
+    snap = telemetry.snapshot()["histograms"]
+    assert snap["tdt_serving_deadline_overrun_seconds"][0]["count"] == 1

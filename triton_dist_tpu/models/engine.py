@@ -73,13 +73,21 @@ class Engine:
         self.top_p = top_p
         self._build(backend)
 
+    def rebuild(self, backend: str) -> None:
+        """Re-resolve routing onto ``backend``: retrace every compiled
+        program so the circuit-breaker state (``resilience.is_degraded``)
+        is re-read at trace time. The serving layer calls this to probe and
+        restore the preferred backend after a breaker closes; operators can
+        call it directly after ``resilience.reset_degradation()``."""
+        self._build(backend)
+
     def _build(self, backend: str) -> None:
         """(Re)build the compiled prefill/decode programs for ``backend``.
 
         Callable after construction: degraded-mode fallback rebuilds the
-        engine on "xla" (fresh jit functions retrace, so the sticky
-        degradation flags and the backend switch take effect) and serving
-        continues on the same model/caches."""
+        engine on "xla" (fresh jit functions retrace, so the breaker state
+        and the backend switch take effect) and serving continues on the
+        same model/caches."""
         # Build cost dominates cold TTFT and dwarfs a recovery window — it
         # gets its own trace so a degraded rebuild shows up timed.
         with tracing.root_span("tdt_engine_build", backend=backend):

@@ -11,13 +11,18 @@ the runtime's stdlib-only observability rule):
 route                   body
 ======================  =====================================================
 ``/metrics``            Prometheus text exposition (``telemetry.to_prometheus``)
-``/healthz``            JSON health verdict: ``status`` ``ok``/``degraded``,
-                        sticky degradation reasons, last collective abort,
-                        watchdog timeout total, uptime — 200 when ok, 503
-                        when degraded (load-balancer friendly)
-``/snapshot``           full JSON ``telemetry.snapshot()`` + span-trace
-                        section (``tracing.snapshot_traces()``)
-``/traces``             JSON list of known trace ids
+``/healthz``            JSON health verdict: ``status`` ``ok`` / ``degraded``
+                        / ``shedding``, per-feature circuit-breaker states,
+                        the serving health provider's section (shed
+                        pressure, backend vs preferred backend), last
+                        collective abort, watchdog timeout total, uptime —
+                        200 when ready, 503 otherwise (load-balancer
+                        friendly)
+``/snapshot``           JSON ``telemetry.snapshot()`` + span-trace section
+                        (``tracing.snapshot_traces()``); list sections are
+                        capped at ``?limit=`` items (default 256, 0 =
+                        uncapped)
+``/traces``             JSON list of known trace ids (newest ``?limit=``)
 ``/traces/<id>``        chrome://tracing JSON for that trace (``last`` picks
                         the newest; append ``?kernel=1`` to merge
                         correlated KernelTrace records)
@@ -48,6 +53,20 @@ from triton_dist_tpu.runtime.utils import tdt_log
 
 _LOCK = threading.Lock()
 _SERVER: "IntrospectionServer | None" = None
+_HEALTH_PROVIDER = None
+
+#: Default item cap for the list-valued sections of /snapshot and /traces;
+#: override per request with ``?limit=N`` (``limit=0`` = uncapped).
+DEFAULT_SCRAPE_LIMIT = 256
+
+
+def set_health_provider(fn) -> None:
+    """Register a callable returning a JSON-safe dict merged into /healthz
+    as its ``"serving"`` section; a ``"ready": false`` entry (e.g. under
+    shed pressure) turns the whole verdict not-ready. ``InferenceServer``
+    registers itself at construction; pass None to clear."""
+    global _HEALTH_PROVIDER
+    _HEALTH_PROVIDER = fn
 
 
 def _healthz() -> tuple[int, dict]:
@@ -55,9 +74,25 @@ def _healthz() -> tuple[int, dict]:
 
     reasons = resilience.degraded_reasons()
     last = resilience.last_abort()
+    serving = None
+    provider = _HEALTH_PROVIDER
+    if provider is not None:
+        try:
+            serving = dict(provider())
+        except Exception as e:  # a health probe must never 500 on a bug
+            serving = {"ready": True, "provider_error": f"{type(e).__name__}: {e}"}
+    serving_ready = serving is None or bool(serving.get("ready", True))
+    ready = not reasons and serving_ready
+    status = (
+        "degraded" if reasons
+        else ("shedding" if not serving_ready else "ok")
+    )
     body = {
-        "status": "degraded" if reasons else "ok",
+        "status": status,
+        "ready": ready,
         "degraded": reasons,
+        "breakers": resilience.breaker_states(),
+        "serving": serving,
         "last_abort": None if last is None else {
             "feature": last.feature, "kernel": last.kernel,
             "phase": last.phase, "peer": last.peer,
@@ -68,7 +103,23 @@ def _healthz() -> tuple[int, dict]:
         "aborts": telemetry.counter_total("tdt_resilience_aborts_total"),
         "uptime_s": round(time.monotonic() - _MONO0, 3),
     }
-    return (503 if reasons else 200), body
+    return (200 if ready else 503), body
+
+
+def _limit_from(query: str) -> int:
+    """``?limit=N`` (0 = uncapped); anything absent/invalid → the default."""
+    for part in query.split("&"):
+        k, _, v = part.partition("=")
+        if k == "limit" and v.isdigit():
+            return int(v)
+    return DEFAULT_SCRAPE_LIMIT
+
+
+def _cap(items: list, limit: int) -> list:
+    """Keep the newest ``limit`` entries (rings append chronologically)."""
+    if limit and len(items) > limit:
+        return items[-limit:]
+    return items
 
 
 _MONO0 = time.monotonic()
@@ -99,11 +150,29 @@ class _Handler(http.server.BaseHTTPRequestHandler):
             elif path == "/healthz":
                 self._send_json(*_healthz())
             elif path == "/snapshot":
+                # Bounded by default: a scrape during a long soak must not
+                # serialize the entire event/span rings (?limit=0 uncaps).
+                limit = _limit_from(query)
                 snap = telemetry.snapshot()
-                snap["traces"] = tracing.snapshot_traces()
+                n_events = len(snap.get("events", []))
+                snap["events"] = _cap(snap.get("events", []), limit)
+                snap["kernel_traces"] = _cap(snap.get("kernel_traces", []), limit)
+                traces = tracing.snapshot_traces()
+                n_traces = len(traces.get("traces", []))
+                traces["traces"] = _cap(traces.get("traces", []), limit)
+                snap["traces"] = traces
+                snap["truncated"] = {
+                    "limit": limit,
+                    "events_total": n_events,
+                    "traces_total": n_traces,
+                }
                 self._send_json(200, snap)
             elif path == "/traces":
-                self._send_json(200, {"trace_ids": tracing.trace_ids()})
+                limit = _limit_from(query)
+                ids = tracing.trace_ids()
+                self._send_json(200, {
+                    "trace_ids": _cap(ids, limit), "n_total": len(ids),
+                })
             elif path.startswith("/traces/"):
                 which = path[len("/traces/"):]
                 tid = tracing.last_trace_id() if which == "last" else (

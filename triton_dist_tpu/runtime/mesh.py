@@ -20,12 +20,20 @@ from __future__ import annotations
 import dataclasses
 import math
 import os
+import random
 import time
 from typing import Mapping, Sequence
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from triton_dist_tpu.runtime import telemetry
+from triton_dist_tpu.runtime.utils import get_float_env, get_int_env
+
+#: Hard cap on one coordinator connect-retry sleep, seconds
+#: (``TDT_CONNECT_BACKOFF_CAP_S`` overrides).
+DEFAULT_CONNECT_BACKOFF_CAP_S = 5.0
 
 _DEFAULT_CONTEXT: "DistContext | None" = None
 _JAX_DISTRIBUTED_INITIALIZED = False
@@ -130,10 +138,16 @@ def initialize_distributed(
             num_processes = int(os.environ.get("NUM_PROCESSES", "1"))
         if process_id is None:
             process_id = int(os.environ.get("PROCESS_ID", "0"))
-        # Retry the rendezvous with exponential backoff: in a gang-scheduled
-        # launch the coordinator process may come up seconds after its
-        # followers, and a single refused connection should not kill the job.
-        attempts = 3
+        # Retry the rendezvous with capped, jittered exponential backoff: in
+        # a gang-scheduled launch the coordinator process may come up seconds
+        # after its followers, and a single refused connection should not
+        # kill the job. Full jitter (0.5–1x the capped base) because every
+        # follower restarts at once — a deterministic schedule stampedes the
+        # coordinator in lockstep on each retry wave.
+        attempts = max(get_int_env("TDT_CONNECT_RETRIES", 3), 1)
+        cap_s = get_float_env(
+            "TDT_CONNECT_BACKOFF_CAP_S", DEFAULT_CONNECT_BACKOFF_CAP_S
+        )
         last: Exception | None = None
         for attempt in range(attempts):
             try:
@@ -147,7 +161,9 @@ def initialize_distributed(
             except Exception as e:  # noqa: BLE001 — connect errors vary by transport
                 last = e
                 if attempt < attempts - 1:
-                    time.sleep(0.5 * 2**attempt)
+                    telemetry.inc("tdt_mesh_connect_retries_total")
+                    base = min(0.5 * 2**attempt, cap_s)
+                    time.sleep(base * (0.5 + 0.5 * random.random()))
         if last is not None:
             raise RuntimeError(
                 f"could not reach coordinator at {coordinator_address} "

@@ -14,14 +14,25 @@ production counterpart, spanning four layers:
   instead of spinning forever. :func:`consume_status` surfaces that record
   host-side as a :class:`CollectiveAbortError` naming the stalled phase and
   peer rank, and marks the collective degraded.
-* **Degradation registry** — sticky per-process flags consulted at trace
-  time by the AUTO routing in ``kernels/gemm_allreduce``/``allreduce``/
-  ``allgather``/``reduce_scatter``/``ep_a2a`` and by ``layers/tp``: once a
-  collective has aborted (or a watchdog tripped), subsequent traces route
-  the plain XLA collective path with a logged reason. Stickiness takes
-  effect at the next trace — exiting a :func:`fault_plan` context or an
-  ``Engine._build`` rebuild clears the jit caches that would otherwise
-  replay the cached Pallas executable.
+* **Degradation registry** — per-feature circuit breakers consulted at
+  trace time by the AUTO routing in ``kernels/gemm_allreduce``/
+  ``allreduce``/``allgather``/``reduce_scatter``/``ep_a2a`` and by
+  ``layers/tp``: once a collective has aborted (or a watchdog tripped) its
+  breaker OPENs and subsequent traces route the plain XLA collective path
+  with a logged reason. Unlike the original one-way flag, an OPEN breaker
+  becomes probe-eligible after a ``TDT_DEGRADE_PROBE_S`` backoff
+  (HALF_OPEN); a successful sandboxed probe dispatch CLOSEs it and fused
+  routing returns, while a failed probe re-opens with exponential backoff.
+  State changes take effect at the next trace — exiting a
+  :func:`fault_plan`/:func:`probe_scope` context or an ``Engine._build``
+  rebuild clears the jit caches that would otherwise replay the cached
+  executable.
+* **Chaos schedule** — the multi-fault extension of FaultPlan: a
+  deterministic program of host-side fault injections
+  (``TDT_CHAOS_SCHEDULE`` or :func:`chaos_schedule`, e.g.
+  ``"abort@decode:1,abort@recovery,heal"``) consumed in order by
+  :func:`chaos_check` call sites in the serving loop, so tests can script
+  double-fault recovery and probe-driven un-degrade arcs.
 * **CollectiveWatchdog** — host-side wall-time bound on collective dispatch
   with retry/backoff (``TDT_COLL_TIMEOUT_MS``, ``TDT_COLL_RETRIES``); on
   final timeout it marks the feature degraded and either runs the caller's
@@ -35,6 +46,9 @@ Env flags::
     TDT_COLL_TIMEOUT_MS    watchdog per-attempt budget (0 = disabled, default)
     TDT_COLL_RETRIES       extra watchdog attempts after the first (default 2)
     TDT_WAIT_BOUND_ITERS   device-side wait poll cap (0 = unbounded waits)
+    TDT_DEGRADE_PROBE_S    breaker probe backoff base, seconds (default 30;
+                           <= 0 disables probing = the old sticky behavior)
+    TDT_CHAOS_SCHEDULE     scripted fault schedule (see ChaosSchedule)
     TDT_LOG                log verbosity: silent / warn (default) / debug
 
 Every degradation, abort, fallback, and watchdog trip is also recorded as a
@@ -47,12 +61,14 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import enum
+import os
 import threading
+import time
 
 import numpy as np
 
 from triton_dist_tpu.runtime import telemetry
-from triton_dist_tpu.runtime.utils import get_int_env, tdt_log
+from triton_dist_tpu.runtime.utils import get_float_env, get_int_env, tdt_log
 
 # ------------------------------------------------------------- status protocol
 
@@ -217,6 +233,143 @@ def apply_fault_plan(kernel, plan: FaultPlan):
     return wrapped
 
 
+# ------------------------------------------------------------ chaos schedule
+
+
+@dataclasses.dataclass
+class ChaosEvent:
+    """One step of a :class:`ChaosSchedule`: fire ``action`` at the
+    ``skip``-th-next :func:`chaos_check` call naming ``site``."""
+
+    action: str
+    site: str
+    skip: int = 0
+
+
+#: Serving-loop injection sites wired through :func:`chaos_check`.
+CHAOS_SITES = ("prefill", "decode", "recovery", "probe")
+CHAOS_ACTIONS = ("abort",)
+
+
+class ChaosSchedule:
+    """Deterministic multi-event fault schedule — the multi-fault extension
+    of :class:`FaultPlan`.
+
+    The spec is a comma-separated program of ``<action>@<site>[:skip]``
+    steps, consumed strictly in order by :func:`chaos_check` calls: the head
+    event fires when a check names its site (after letting ``skip`` matching
+    checks pass); checks naming other sites pass through untouched. A
+    trailing ``heal`` marks the program's end — everything after the last
+    injection runs clean. Example::
+
+        abort@decode:1,abort@probe,heal
+
+    reads "let one decode chunk through, abort the second, then fail the
+    first half-open probe, then heal" — the double-fault probe arc the
+    single-shot FaultPlan cannot express.
+    """
+
+    def __init__(self, spec: str):
+        self.spec = spec
+        self.events: list[ChaosEvent] = []
+        self._lock = threading.Lock()
+        tokens = [t.strip() for t in spec.split(",") if t.strip()]
+        for i, tok in enumerate(tokens):
+            if tok == "heal":
+                if i != len(tokens) - 1:
+                    raise ValueError(f"'heal' must be last in {spec!r}")
+                break
+            action, sep, rest = tok.partition("@")
+            if not sep or action not in CHAOS_ACTIONS:
+                raise ValueError(
+                    f"bad chaos step {tok!r} in {spec!r} "
+                    f"(want <action>@<site>[:skip], action in {CHAOS_ACTIONS})"
+                )
+            site, _, skip = rest.partition(":")
+            if not site:
+                raise ValueError(f"bad chaos step {tok!r} in {spec!r}: empty site")
+            if skip and not skip.isdigit():
+                raise ValueError(f"bad chaos skip in {tok!r}: want an integer")
+            self.events.append(
+                ChaosEvent(action=action, site=site, skip=int(skip or 0))
+            )
+
+    @property
+    def exhausted(self) -> bool:
+        with self._lock:
+            return not self.events
+
+    def take(self, site: str) -> ChaosEvent | None:
+        """Consume-and-return the head event if this check fires it."""
+        with self._lock:
+            if not self.events or self.events[0].site != site:
+                return None
+            head = self.events[0]
+            if head.skip > 0:
+                head.skip -= 1
+                return None
+            return self.events.pop(0)
+
+
+_CHAOS_CTX: ChaosSchedule | None = None
+_CHAOS_ENV: ChaosSchedule | None = None
+_CHAOS_ENV_SPEC: str | None = None
+
+
+def _active_chaos() -> ChaosSchedule | None:
+    if _CHAOS_CTX is not None:
+        return _CHAOS_CTX
+    global _CHAOS_ENV, _CHAOS_ENV_SPEC
+    spec = os.environ.get("TDT_CHAOS_SCHEDULE", "").strip()
+    if not spec:
+        return None
+    if spec != _CHAOS_ENV_SPEC:
+        # One stateful schedule per spec per process: the program is consumed
+        # once, deterministically, and stays exhausted afterwards.
+        _CHAOS_ENV_SPEC = spec
+        try:
+            _CHAOS_ENV = ChaosSchedule(spec)
+        except ValueError as e:
+            _log(f"[resilience] ignoring bad TDT_CHAOS_SCHEDULE: {e}")
+            _CHAOS_ENV = None
+    return _CHAOS_ENV
+
+
+@contextlib.contextmanager
+def chaos_schedule(spec: str):
+    """Activate a :class:`ChaosSchedule` for :func:`chaos_check` sites inside
+    the context (takes precedence over ``TDT_CHAOS_SCHEDULE``)."""
+    global _CHAOS_CTX
+    sched = ChaosSchedule(spec)
+    prev = _CHAOS_CTX
+    _CHAOS_CTX = sched
+    try:
+        yield sched
+    finally:
+        _CHAOS_CTX = prev
+
+
+def chaos_check(site: str) -> None:
+    """Host-side chaos-injection hook, called by the serving loop at each
+    named site. No-op unless an active schedule's head event matches; a
+    fired ``abort`` marks 'collectives' degraded and raises
+    :class:`CollectiveAbortError` — the same observable failure as a real
+    bounded-wait abort, minus the device."""
+    sched = _active_chaos()
+    if sched is None:
+        return
+    ev = sched.take(site)
+    if ev is None:
+        return
+    telemetry.inc("tdt_resilience_chaos_injected_total", site=site)
+    telemetry.emit("chaos_inject", site=site, action=ev.action, spec=sched.spec)
+    reason = f"chaos schedule injected {ev.action} at site '{site}'"
+    _log(f"[resilience] {reason}")
+    if ev.action == "abort":
+        mark_degraded("collectives", reason)
+        raise CollectiveAbortError(reason)
+
+
 # ------------------------------------------------------ degradation registry
 
 
@@ -230,19 +383,95 @@ class AbortInfo:
     reason: str
 
 
+class BreakerState(enum.Enum):
+    """Per-feature circuit-breaker state.
+
+    ::
+
+        CLOSED ──mark_degraded──► OPEN ──backoff elapsed──► probe_due()
+        begin_probe():       OPEN → HALF_OPEN   (probe thread sees it healthy)
+        end_probe(ok=True):  HALF_OPEN → CLOSED (fused routing restored)
+        end_probe(ok=False): HALF_OPEN → OPEN   (backoff doubles, capped)
+    """
+
+    CLOSED = "closed"
+    OPEN = "open"
+    HALF_OPEN = "half_open"
+
+
+#: `tdt_degrade_state` gauge encoding (dashboard-friendly ordinal).
+_STATE_GAUGE = {
+    BreakerState.CLOSED: 0.0,
+    BreakerState.HALF_OPEN: 1.0,
+    BreakerState.OPEN: 2.0,
+}
+
+DEFAULT_DEGRADE_PROBE_S = 30.0
+#: Max exponential-backoff multiplier over the probe base (2^6).
+PROBE_BACKOFF_CAP = 64.0
+
+
+@dataclasses.dataclass
+class _Breaker:
+    feature: str
+    state: BreakerState = BreakerState.CLOSED
+    reason: str = ""
+    failures: int = 0
+    opened_at: float = 0.0  # time.monotonic() of the last OPEN transition
+    backoff_s: float = 0.0
+
+
 _LOCK = threading.Lock()
-_DEGRADED: dict[str, str] = {}
+_BREAKERS: dict[str, _Breaker] = {}
 _ABORTS: list[AbortInfo] = []
 _NOTED: set[str] = set()
+#: Thread-local probe exemption: features the current thread is allowed to
+#: see as healthy while their breaker is HALF_OPEN (see :func:`probe_scope`).
+_PROBE_TLS = threading.local()
+
+
+def _probe_base_s() -> float:
+    return get_float_env("TDT_DEGRADE_PROBE_S", DEFAULT_DEGRADE_PROBE_S)
+
+
+def _backoff_for(failures: int) -> float:
+    base = max(_probe_base_s(), 0.0)
+    return base * min(2.0 ** max(failures - 1, 0), PROBE_BACKOFF_CAP)
+
+
+def _probe_exempt() -> frozenset:
+    return getattr(_PROBE_TLS, "features", frozenset())
+
+
+def _transition(br: _Breaker, to: BreakerState, why: str) -> None:
+    # Callers hold _LOCK; telemetry has its own independent lock.
+    if br.state is to:
+        return
+    frm, br.state = br.state, to
+    telemetry.inc(
+        "tdt_resilience_breaker_transitions_total", feature=br.feature, to=to.value
+    )
+    telemetry.set_gauge("tdt_degrade_state", _STATE_GAUGE[to], feature=br.feature)
+    telemetry.emit(
+        "breaker_transition",
+        feature=br.feature, from_state=frm.value, to_state=to.value,
+        why=why, failures=br.failures,
+    )
 
 
 def mark_degraded(feature: str, reason: str) -> None:
-    """Sticky per-process degradation flag with a logged reason. Consulted
-    at trace time by AUTO routing; the first mark per feature logs once."""
+    """OPEN the feature's circuit breaker with a logged reason. Consulted at
+    trace time by AUTO routing; a mark while already non-CLOSED is a no-op
+    (first reason wins; a failing probe is re-opened by :func:`end_probe`)."""
     with _LOCK:
-        if feature in _DEGRADED:
+        br = _BREAKERS.setdefault(feature, _Breaker(feature=feature))
+        if br.state is not BreakerState.CLOSED:
             return
-        _DEGRADED[feature] = reason
+        br.reason = reason
+        br.failures += 1
+        br.backoff_s = _backoff_for(br.failures)
+        br.opened_at = time.monotonic()
+        _transition(br, BreakerState.OPEN, reason)
     telemetry.inc("tdt_resilience_degradations_total", feature=feature)
     telemetry.emit("degraded", feature=feature, reason=reason)
     _log(f"[resilience] '{feature}' degraded to XLA fallback: {reason}")
@@ -250,25 +479,128 @@ def mark_degraded(feature: str, reason: str) -> None:
 
 def is_degraded(*features: str) -> bool:
     """True when any named feature — or the global 'collectives' flag the
-    watchdog sets — has been marked degraded."""
+    watchdog sets — has a non-CLOSED breaker. Features under the current
+    thread's :func:`probe_scope` read as healthy so a half-open probe can
+    trace the fused path."""
+    exempt = _probe_exempt()
     with _LOCK:
-        return any(f in _DEGRADED for f in (*features, "collectives"))
+        for f in (*features, "collectives"):
+            br = _BREAKERS.get(f)
+            if br is not None and br.state is not BreakerState.CLOSED and f not in exempt:
+                return True
+    return False
 
 
 def any_degraded() -> bool:
+    exempt = _probe_exempt()
     with _LOCK:
-        return bool(_DEGRADED)
+        return any(
+            br.state is not BreakerState.CLOSED and f not in exempt
+            for f, br in _BREAKERS.items()
+        )
 
 
 def degraded_reasons() -> dict[str, str]:
     with _LOCK:
-        return dict(_DEGRADED)
+        return {
+            f: br.reason
+            for f, br in _BREAKERS.items()
+            if br.state is not BreakerState.CLOSED
+        }
+
+
+def breaker_states() -> dict[str, dict]:
+    """JSON-safe view of every breaker (the `/healthz` payload section)."""
+    now = time.monotonic()
+    with _LOCK:
+        return {
+            f: {
+                "state": br.state.value,
+                "reason": br.reason or None,
+                "failures": br.failures,
+                "backoff_s": round(br.backoff_s, 3),
+                "probe_in_s": (
+                    round(max(br.opened_at + br.backoff_s - now, 0.0), 3)
+                    if br.state is BreakerState.OPEN and _probe_base_s() > 0
+                    else None
+                ),
+            }
+            for f, br in _BREAKERS.items()
+        }
+
+
+def probe_due() -> list[str]:
+    """OPEN features whose backoff has elapsed, ready for a half-open probe
+    (empty while probing is disabled via ``TDT_DEGRADE_PROBE_S <= 0``)."""
+    if _probe_base_s() <= 0:
+        return []
+    now = time.monotonic()
+    with _LOCK:
+        return sorted(
+            f
+            for f, br in _BREAKERS.items()
+            if br.state is BreakerState.OPEN and now - br.opened_at >= br.backoff_s
+        )
+
+
+def begin_probe(features) -> None:
+    """OPEN → HALF_OPEN for each named feature (idempotent)."""
+    with _LOCK:
+        for f in features:
+            br = _BREAKERS.get(f)
+            if br is not None and br.state is BreakerState.OPEN:
+                _transition(br, BreakerState.HALF_OPEN, "probe dispatch")
+
+
+@contextlib.contextmanager
+def probe_scope(features):
+    """Exempt the current thread from the named features' breakers so ONE
+    sandboxed dispatch can trace the fused path while everything else stays
+    degraded. Entry and exit clear jax's caches — the same rule as
+    :func:`fault_plan`: routing flags are read at trace time and do not
+    participate in jit cache keys."""
+    import jax
+
+    prev = _probe_exempt()
+    _PROBE_TLS.features = prev | frozenset(features)
+    jax.clear_caches()
+    try:
+        yield
+    finally:
+        _PROBE_TLS.features = prev
+        jax.clear_caches()
+
+
+def end_probe(features, ok: bool) -> None:
+    """Record the probe verdict: CLOSED on success (failure count resets),
+    back to OPEN with doubled (capped) backoff on failure."""
+    now = time.monotonic()
+    outcome = "ok" if ok else "failed"
+    with _LOCK:
+        for f in features:
+            br = _BREAKERS.get(f)
+            if br is None:
+                continue
+            telemetry.inc(
+                "tdt_resilience_probes_total", feature=f, outcome=outcome
+            )
+            if ok:
+                br.reason = ""
+                br.failures = 0
+                br.backoff_s = 0.0
+                _transition(br, BreakerState.CLOSED, "probe succeeded")
+            else:
+                br.failures += 1
+                br.backoff_s = _backoff_for(br.failures)
+                br.opened_at = now
+                _transition(br, BreakerState.OPEN, "probe failed")
+    _log(f"[resilience] probe {outcome} for {sorted(features)}")
 
 
 def reset_degradation() -> None:
-    """Clear all sticky flags and recorded aborts (tests / operator reset)."""
+    """Clear all breakers and recorded aborts (tests / operator reset)."""
     with _LOCK:
-        _DEGRADED.clear()
+        _BREAKERS.clear()
         _ABORTS.clear()
         _NOTED.clear()
 

@@ -12,7 +12,7 @@ than a block-allocator walk.
 State machines::
 
     slot     FREE → PREFILL → DECODE → DONE → FREE       (join/evict cycle)
-    request  QUEUED → RUNNING → DONE   |   REJECTED      (admission verdicts)
+    request  QUEUED → RUNNING → DONE   |   REJECTED | CANCELLED
 
 Scheduling policy: FCFS by arrival. The pending queue keeps submission
 order; :meth:`Scheduler.join_free_slots` walks it in order and admits every
@@ -27,6 +27,25 @@ mid-decode (no preemption-by-eviction; the only preemption in the system is
 the degraded-mode rebuild, see ``serving/server.py``). Oversized requests
 are rejected at submit time with ``reason="kv_budget"``; a full bounded
 queue rejects with ``reason="queue_full"``.
+
+SLO guardrails (all optional, all enforced BEFORE a slot is spent):
+
+* **Deadlines** — per-request TTFT and total budgets (seconds from
+  effective arrival; ``TDT_DEADLINE_TTFT_S`` / ``TDT_DEADLINE_TOTAL_S``
+  defaults). A non-positive deadline rejects at submit
+  (``shed_deadline``); a queued request whose budget lapses before a slot
+  frees is expired by the sweep in :meth:`join_free_slots` — a doomed
+  request never occupies a slot. Mid-decode total-deadline truncation is
+  the server's half (``InferenceServer._reap_slots``).
+* **Shedding** — an EWMA decode-capacity estimate (fed by the server via
+  :meth:`note_decode_rate`) projects the queue wait at submit time; when
+  the projection blows the request's TTFT deadline or the global
+  ``TDT_SHED_WAIT_S`` budget, requests at priority >= ``TDT_SHED_PRIORITY``
+  are rejected early (``shed_overload``). Lower numbers are MORE
+  important; priority-0 traffic is never shed by default.
+* **Cancellation** — :meth:`cancel` finalizes a queued request immediately
+  and flags a running one; the server frees the slot at the next chunk
+  boundary. Terminal requests are never re-finalized (no double-free).
 
 The scheduler is pure host-side bookkeeping — it never touches jax. The
 device work (prefill scatter, masked decode chunks) lives in
@@ -46,6 +65,16 @@ import time
 from typing import Callable
 
 from triton_dist_tpu.runtime import telemetry, tracing
+from triton_dist_tpu.runtime.utils import get_float_env, get_int_env
+
+#: EWMA smoothing for the decode-capacity estimate: heavy enough to ride
+#: out chunk-to-chunk jitter, light enough to track a recovery rebuild.
+EWMA_ALPHA = 0.3
+
+
+def _env_deadline(name: str) -> float | None:
+    v = get_float_env(name, 0.0)
+    return v if v > 0 else None
 
 
 class SlotState(enum.Enum):
@@ -60,6 +89,7 @@ class RequestState(enum.Enum):
     RUNNING = "running"
     DONE = "done"
     REJECTED = "rejected"
+    CANCELLED = "cancelled"
 
 
 @dataclasses.dataclass
@@ -82,9 +112,20 @@ class Request:
     on_token: Callable[["Request", int, int], None] | None = None
     #: ``on_finish(request)`` — called once when the stream completes.
     on_finish: Callable[["Request"], None] | None = None
+    #: Shedding class: lower is MORE important (0 = never shed by default).
+    priority: int = 1
+    #: SLO budgets, seconds from effective arrival (None = no bound).
+    ttft_deadline_s: float | None = None
+    deadline_s: float | None = None
 
     state: RequestState = RequestState.QUEUED
     reject_reason: str | None = None
+    #: How the stream ended: "ok" | "cancelled" | "deadline" (None while
+    #: running or when rejected before any slot was spent).
+    finish_reason: str | None = None
+    #: Set by :meth:`Scheduler.cancel` on a RUNNING request; the server
+    #: honors it at the next chunk boundary.
+    cancel_requested: bool = False
     tokens: list[int] = dataclasses.field(default_factory=list)
     #: Per-request trace handle (``runtime.tracing``). ``submit`` opens it;
     #: the server closes it at completion. Defaults to the no-op handle so
@@ -136,27 +177,57 @@ class Scheduler:
     while the serving loop runs); the slot-transition methods are meant to
     be called from the single serving loop."""
 
-    def __init__(self, num_slots: int, max_len: int, queue_limit: int = 0):
+    def __init__(self, num_slots: int, max_len: int, queue_limit: int = 0,
+                 shed_wait_s: float | None = None,
+                 shed_priority: int | None = None):
         assert num_slots >= 1 and max_len >= 2
         self.num_slots = num_slots
         self.max_len = max_len
         self.queue_limit = queue_limit  # 0 = unbounded
+        #: Global projected-wait shed budget, seconds (0 = only per-request
+        #: TTFT deadlines trigger overload shedding).
+        self.shed_wait_s = (
+            get_float_env("TDT_SHED_WAIT_S", 0.0)
+            if shed_wait_s is None else float(shed_wait_s)
+        )
+        #: Minimum priority class eligible for overload shedding.
+        self.shed_priority = (
+            get_int_env("TDT_SHED_PRIORITY", 1)
+            if shed_priority is None else int(shed_priority)
+        )
+        #: /healthz stays not-ready this long after the last shed.
+        self.shed_health_s = get_float_env("TDT_SHED_HEALTH_S", 5.0)
         self.slots = [Slot(idx=i) for i in range(num_slots)]
         self._pending: collections.deque[Request] = collections.deque()
         self._ids = itertools.count()
         self._lock = threading.Lock()
+        self._ewma_tps = 0.0
+        self._last_shed_now_s: float | None = None
 
     # ------------------------------------------------------------- admission
     def submit(self, prompt, max_new: int, arrival_time_s: float = 0.0,
-               on_token=None, on_finish=None, now_s: float | None = None) -> Request:
+               on_token=None, on_finish=None, now_s: float | None = None,
+               priority: int = 1, ttft_deadline_s: float | None = None,
+               deadline_s: float | None = None) -> Request:
         """Admission-check and enqueue one request (FCFS). Returns the
         request handle; a rejected request comes back with
-        ``state=REJECTED`` and ``reject_reason`` set — it is NOT queued."""
+        ``state=REJECTED`` and ``reject_reason`` set — it is NOT queued.
+        Deadlines default to ``TDT_DEADLINE_TTFT_S`` / ``TDT_DEADLINE_TOTAL_S``
+        when not given (unset/non-positive env = no bound)."""
         prompt = [int(t) for t in prompt]
         req = Request(
             req_id=next(self._ids), prompt=prompt, max_new=int(max_new),
             arrival_time_s=float(arrival_time_s),
             on_token=on_token, on_finish=on_finish,
+            priority=int(priority),
+            ttft_deadline_s=(
+                _env_deadline("TDT_DEADLINE_TTFT_S")
+                if ttft_deadline_s is None else float(ttft_deadline_s)
+            ),
+            deadline_s=(
+                _env_deadline("TDT_DEADLINE_TOTAL_S")
+                if deadline_s is None else float(deadline_s)
+            ),
         )
         now = time.monotonic() if now_s is None else now_s
         req.submitted_at = now
@@ -172,6 +243,21 @@ class Scheduler:
             # max_len KV row — admitting anything larger would guarantee an
             # out-of-cache abort mid-decode.
             return self._reject(req, "kv_budget")
+        if (req.ttft_deadline_s is not None and req.ttft_deadline_s <= 0) or (
+            req.deadline_s is not None and req.deadline_s <= 0
+        ):
+            # Already-expired budget: doomed on arrival, never spend a slot.
+            return self._shed(req, "shed_deadline", now)
+        if req.priority >= self.shed_priority:
+            est = self.est_wait_s()
+            budgets = [
+                b for b in (req.ttft_deadline_s, self.shed_wait_s or None)
+                if b is not None
+            ]
+            if est is not None and budgets and est > min(budgets):
+                # The EWMA capacity projection says this request would blow
+                # its TTFT budget (or the global shed budget) just queueing.
+                return self._shed(req, "shed_overload", now)
         with self._lock:
             if self.queue_limit and len(self._pending) >= self.queue_limit:
                 return self._reject(req, "queue_full")
@@ -188,31 +274,130 @@ class Scheduler:
         req.trace.finish(status="rejected", reason=reason)
         return req
 
+    def _shed(self, req: Request, reason: str, now_s: float) -> Request:
+        self._last_shed_now_s = now_s
+        telemetry.inc(
+            "tdt_serving_shed_total", reason=reason, priority=req.priority
+        )
+        return self._reject(req, reason)
+
+    # ---------------------------------------------------- capacity estimate
+    def note_decode_rate(self, tokens: int, wall_s: float) -> None:
+        """Feed one decode-chunk observation into the EWMA tokens/s
+        estimate (called by the server after every chunk dispatch)."""
+        if tokens <= 0 or wall_s <= 0:
+            return
+        inst = tokens / wall_s
+        self._ewma_tps = (
+            inst if self._ewma_tps <= 0
+            else EWMA_ALPHA * inst + (1.0 - EWMA_ALPHA) * self._ewma_tps
+        )
+        telemetry.set_gauge("tdt_serving_ewma_tokens_per_s", self._ewma_tps)
+
+    def backlog_tokens(self) -> int:
+        """Decode tokens committed ahead of a new arrival: every queued
+        request's full budget plus the unfinished remainder of each running
+        slot (worst-case, since admission guarantees the budget fits)."""
+        with self._lock:
+            pending = sum(r.max_new for r in self._pending)
+        running = sum(
+            max(s.request.max_new - len(s.request.tokens), 0)
+            for s in self.slots
+            if s.request is not None
+        )
+        return pending + running
+
+    def est_wait_s(self) -> float | None:
+        """Projected queue wait from the EWMA capacity (None until the
+        first decode chunk has been observed — never shed blind)."""
+        if self._ewma_tps <= 0:
+            return None
+        return self.backlog_tokens() / self._ewma_tps
+
+    def shedding(self, now_s: float) -> bool:
+        """True inside the ``TDT_SHED_HEALTH_S`` window after the last shed
+        — the /healthz not-ready signal under overload."""
+        if self._last_shed_now_s is None:
+            return False
+        return (now_s - self._last_shed_now_s) <= self.shed_health_s
+
+    # ---------------------------------------------------------- cancellation
+    def cancel(self, req_id: int) -> bool:
+        """Client cancellation. A QUEUED request is removed and finalized
+        here; a RUNNING one is only flagged — the serving loop frees its
+        slot at the next chunk boundary (`InferenceServer._reap_slots`).
+        Terminal requests return False untouched, so a double cancel (or a
+        cancel racing completion) can never double-free a slot."""
+        with self._lock:
+            req = None
+            for i, r in enumerate(self._pending):
+                if r.req_id == req_id:
+                    req = r
+                    del self._pending[i]
+                    depth = len(self._pending)
+                    break
+        if req is not None:
+            req.state = RequestState.CANCELLED
+            req.finish_reason = "cancelled"
+            telemetry.set_gauge("tdt_serving_queue_depth", float(depth))
+            telemetry.inc("tdt_serving_cancelled_total", where="queued")
+            telemetry.emit("serving_cancel", req_id=req_id, where="queued")
+            req.trace.finish(status="cancelled", where="queued")
+            if req.on_finish is not None:
+                try:
+                    req.on_finish(req)
+                except Exception:
+                    telemetry.inc(
+                        "tdt_serving_callback_errors_total", kind="on_finish"
+                    )
+            return True
+        for slot in self.slots:
+            r = slot.request
+            if r is not None and r.req_id == req_id:
+                if r.state is not RequestState.RUNNING:
+                    return False
+                if not r.cancel_requested:
+                    r.cancel_requested = True
+                    telemetry.emit("serving_cancel", req_id=req_id, where="running")
+                return True
+        return False
+
     # ------------------------------------------------------------------ joins
     def join_free_slots(self, now_s: float) -> list[Slot]:
         """Admit arrived requests (FCFS) into free slots; each admitted
-        request's slot moves FREE→PREFILL. Returns the slots to prefill."""
+        request's slot moves FREE→PREFILL. Returns the slots to prefill.
+
+        The walk doubles as the queue-time expiry sweep: requests whose
+        TTFT/total budget lapsed while queued are rejected here (with
+        ``shed_deadline``) and requests cancelled while queued are dropped
+        — both run even when no slot is free, so a hopeless request never
+        waits for capacity it can no longer use."""
         joined: list[Slot] = []
+        expired: list[Request] = []
         free = [s for s in self.slots if s.state is SlotState.FREE]
-        if not free:
-            return joined
         with self._lock:
             deferred: collections.deque[Request] = collections.deque()
-            while self._pending and free:
+            while self._pending:
                 req = self._pending.popleft()
-                if req.arrival_time_s > now_s:
-                    deferred.append(req)  # not offered yet — keep its order
+                if req.state is RequestState.CANCELLED:
+                    continue  # finalized by cancel() racing this sweep
+                if self._queue_expired(req, now_s):
+                    expired.append(req)
                     continue
+                if req.arrival_time_s > now_s or not free:
+                    deferred.append(req)  # not offered yet / no capacity —
+                    continue              # keep its order
                 slot = free.pop(0)
                 req.state = RequestState.RUNNING
                 req.arrived_at = max(req.submitted_at, req.arrival_time_s)
                 slot.state = SlotState.PREFILL
                 slot.request = req
                 joined.append(slot)
-            deferred.extend(self._pending)
             self._pending = deferred
             depth = len(self._pending)
-        if joined:
+        for req in expired:
+            self._expire(req, now_s)  # telemetry + callbacks outside the lock
+        if joined or expired:
             telemetry.set_gauge("tdt_serving_queue_depth", float(depth))
             self._occupancy_gauge()
             # Queue wait = effective arrival → admission. Recorded here (not
@@ -230,6 +415,35 @@ class Scheduler:
                     slot=slot.idx,
                 )
         return joined
+
+    def _queue_expired(self, req: Request, now_s: float) -> bool:
+        """Queue-time deadline check: has an arrived request already waited
+        past its TTFT (or total) budget? Not-yet-arrived requests cannot
+        expire — their clock has not started."""
+        if req.arrival_time_s > now_s:
+            return False
+        waited = now_s - max(req.submitted_at, req.arrival_time_s)
+        return (
+            req.ttft_deadline_s is not None and waited > req.ttft_deadline_s
+        ) or (req.deadline_s is not None and waited > req.deadline_s)
+
+    def _expire(self, req: Request, now_s: float) -> None:
+        waited = now_s - max(req.submitted_at, req.arrival_time_s)
+        limit = min(
+            b for b in (req.ttft_deadline_s, req.deadline_s) if b is not None
+        )
+        telemetry.inc("tdt_serving_deadline_expiries_total", where="queue")
+        telemetry.observe(
+            "tdt_serving_deadline_overrun_seconds", max(waited - limit, 0.0)
+        )
+        self._shed(req, "shed_deadline", now_s)
+        if req.on_finish is not None:
+            try:
+                req.on_finish(req)
+            except Exception:
+                telemetry.inc(
+                    "tdt_serving_callback_errors_total", kind="on_finish"
+                )
 
     # ------------------------------------------------------------ transitions
     def start_decode(self, slot: Slot) -> None:

@@ -23,17 +23,44 @@ iteration-level scheduling.
 (``CollectiveAbortError`` via ``resilience.consume_status``) or a
 ``CollectiveWatchdog`` timeout surfacing from a join or a decode chunk
 triggers :meth:`InferenceServer._recover`: the engine rebuilds on the
-``xla`` backend (sticky degradation, same contract as ``Engine.serve``),
-a fresh slot cache is allocated (the aborted dispatch may have poisoned or
-consumed the donated buffers), and every in-flight slot re-prefills from
-its token history ``prompt + tokens[:-1]`` — the re-prefill's sampled token
-is discarded (it was already streamed), so recovery produces **zero
-dropped and zero duplicated** stream tokens. Queued requests are untouched.
+``xla`` backend (the feature's circuit breaker OPENs, same contract as
+``Engine.serve``), a fresh slot cache is allocated (the aborted dispatch
+may have poisoned or consumed the donated buffers), and every in-flight
+slot re-prefills from its token history ``prompt + tokens[:-1]`` — the
+re-prefill's sampled token is discarded (it was already streamed), so
+recovery produces **zero dropped and zero duplicated** stream tokens.
+Queued requests are untouched. A fault DURING the re-prefill (the
+double-fault scenario) is retried a bounded number of times on a fresh
+cache before surfacing.
+
+**Un-degrade via half-open probes**: while the engine runs degraded, every
+:meth:`step` first asks ``resilience.probe_due()`` whether a breaker's
+backoff has elapsed; if so the preferred backend is rebuilt and probed with
+ONE sandboxed dispatch (a throwaway 1-slot cache, under
+``resilience.probe_scope`` so only the probing thread sees the feature
+healthy). A successful probe CLOSEs the breaker and
+:meth:`_restore_streams` re-resolves routing for live traffic — fresh
+cache, re-prefill from history, zero stream disruption (the same machinery
+as recovery, pointed back at the fused path). A failed probe re-opens the
+breaker with doubled backoff and the server stays on xla; live slots are
+untouched either way because the probe never touches the serving cache.
+
+**SLO guardrails** (scheduler-enforced, see ``serving/scheduler.py``):
+per-request TTFT/total deadlines with queue-time expiry, EWMA-projected
+overload shedding before admission, and :meth:`cancel` — the server's half
+is :meth:`_reap_slots`, which frees cancelled and total-deadline-expired
+slots at each chunk boundary with distinct finish reasons.
 
 Env knobs::
 
-    TDT_SERVE_SLOTS   fixed slot-batch size B (default 4)
-    TDT_SERVE_CHUNK   decode steps per device dispatch (default 8)
+    TDT_SERVE_SLOTS       fixed slot-batch size B (default 4)
+    TDT_SERVE_CHUNK       decode steps per device dispatch (default 8)
+    TDT_DEADLINE_TTFT_S   default TTFT budget, s (<=0/unset = none)
+    TDT_DEADLINE_TOTAL_S  default total budget, s (<=0/unset = none)
+    TDT_SHED_WAIT_S       global projected-wait shed budget, s (0 = off)
+    TDT_SHED_PRIORITY     min priority class eligible for shedding (def. 1)
+    TDT_SHED_HEALTH_S     /healthz not-ready window after a shed (def. 5)
+    TDT_DEGRADE_PROBE_S   breaker probe backoff base, s (def. 30; <=0 off)
 
 Metrics (``tdt_serving_*``, see ``docs/serving.md`` and
 ``docs/observability.md``): request/completion/reject/preemption/recovery
@@ -49,14 +76,19 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from triton_dist_tpu.runtime import telemetry, tracing
+from triton_dist_tpu.runtime import resilience, telemetry, tracing
 from triton_dist_tpu.runtime.utils import get_int_env
 from triton_dist_tpu.serving.scheduler import (
     Request,
+    RequestState,
     Scheduler,
     Slot,
     SlotState,
 )
+
+#: Bounded retry budget for faults that land DURING a recovery or restore
+#: re-prefill (each retry rebuilds on xla over a fresh cache).
+REPREFILL_RETRIES = 3
 
 
 class InferenceServer:
@@ -64,9 +96,9 @@ class InferenceServer:
 
     def __init__(self, engine, num_slots: int | None = None,
                  chunk: int | None = None, queue_limit: int = 0,
-                 key: jax.Array | None = None, watchdog=None):
-        from triton_dist_tpu.runtime import resilience
-
+                 key: jax.Array | None = None, watchdog=None,
+                 shed_wait_s: float | None = None,
+                 shed_priority: int | None = None):
         self.engine = engine
         self.num_slots = (
             get_int_env("TDT_SERVE_SLOTS", 4) if num_slots is None else int(num_slots)
@@ -75,7 +107,13 @@ class InferenceServer:
             get_int_env("TDT_SERVE_CHUNK", 8) if chunk is None else int(chunk)
         )
         assert self.num_slots >= 1 and self.chunk >= 1
-        self.scheduler = Scheduler(self.num_slots, engine.max_len, queue_limit)
+        #: The backend the operator asked for — the restore target whenever
+        #: a breaker closes while the engine is running degraded.
+        self._preferred_backend = engine.backend
+        self.scheduler = Scheduler(
+            self.num_slots, engine.max_len, queue_limit,
+            shed_wait_s=shed_wait_s, shed_priority=shed_priority,
+        )
         self.cache = engine.alloc_slots(self.num_slots)
         # Host-authoritative per-slot decode state (tiny, synced per chunk).
         self._last = np.zeros((self.num_slots,), np.int32)
@@ -98,9 +136,24 @@ class InferenceServer:
             backend=getattr(engine, "backend", None),
         )
         # Live introspection endpoint (no-op unless TDT_HTTP_PORT is set).
+        # The health provider makes /healthz reflect shed pressure and the
+        # degraded/preferred backend split regardless of who started the
+        # endpoint.
         from triton_dist_tpu.runtime import introspect
 
         self._introspect = introspect.maybe_start()
+        introspect.set_health_provider(self._health_info)
+
+    def _health_info(self) -> dict:
+        shedding = self.scheduler.shedding(self._now())
+        return {
+            "ready": not shedding,
+            "shedding": shedding,
+            "backend": self.engine.backend,
+            "preferred_backend": self._preferred_backend,
+            "queue_depth": self.scheduler.queue_depth(),
+            "slot_occupancy": self.scheduler.occupancy(),
+        }
 
     # ------------------------------------------------------------------ clock
     def _now(self) -> float:
@@ -109,20 +162,33 @@ class InferenceServer:
 
     # ----------------------------------------------------------------- submit
     def submit(self, prompt, max_new: int, arrival_time_s: float = 0.0,
-               on_token=None, on_finish=None) -> Request:
+               on_token=None, on_finish=None, priority: int = 1,
+               ttft_deadline_s: float | None = None,
+               deadline_s: float | None = None) -> Request:
         """Admission-check and enqueue one request; returns its handle
         (``state=REJECTED`` + ``reject_reason`` when not admitted)."""
         return self.scheduler.submit(
             prompt, max_new, arrival_time_s=arrival_time_s,
             on_token=on_token, on_finish=on_finish, now_s=self._now(),
+            priority=priority, ttft_deadline_s=ttft_deadline_s,
+            deadline_s=deadline_s,
         )
+
+    def cancel(self, req_id: int) -> bool:
+        """Client cancellation: a queued request finalizes immediately; a
+        running one frees its slot at the next chunk boundary."""
+        return self.scheduler.cancel(int(req_id))
 
     # ------------------------------------------------------------------- loop
     def step(self) -> bool:
-        """One scheduler iteration: join arrived requests into free slots
-        (prefill + first token), then one masked decode chunk over the slot
-        batch. Returns True when any work was done."""
-        worked = self._join_ready()
+        """One scheduler iteration: probe a due circuit breaker (restoring
+        the preferred backend on success), join arrived requests into free
+        slots (prefill + first token), reap cancelled/expired slots, then
+        one masked decode chunk over the slot batch. Returns True when any
+        work was done."""
+        worked = self._maybe_probe()
+        worked = self._join_ready() or worked
+        self._reap_slots()
         if not self.scheduler.decoding_slots():
             return worked
         self._guarded(self._decode_once, what="decode chunk")
@@ -167,6 +233,9 @@ class InferenceServer:
         the prefill-sampled token is discarded, nothing streams twice."""
         req = slot.request
         ids = req.prompt + req.tokens[:-1]
+        # Scripted chaos site: "recovery" when re-prefilling from history
+        # (double-fault scenarios), "prefill" on a fresh join.
+        resilience.chaos_check("recovery" if req.tokens else "prefill")
         self._key, sub = jax.random.split(self._key)
         # The live span makes this request the AMBIENT trace while the
         # prefill program traces/compiles — KernelTrace records collected
@@ -194,6 +263,7 @@ class InferenceServer:
 
     # ----------------------------------------------------------------- decode
     def _decode_once(self) -> None:
+        resilience.chaos_check("decode")
         decoding = self.scheduler.decoding_slots()
         pre = {s.idx: int(self._remaining[s.idx]) for s in decoding}
         self._key, sub = jax.random.split(self._key)
@@ -242,6 +312,8 @@ class InferenceServer:
         if n_streamed:
             telemetry.inc("tdt_serving_tokens_total", float(n_streamed))
             telemetry.observe("tdt_serving_chunk_token_seconds", wall / n_streamed)
+            # Feed the admission-time overload projection.
+            self.scheduler.note_decode_rate(n_streamed, wall)
 
     # -------------------------------------------------------------- streaming
     def _stream(self, req: Request, token: int) -> None:
@@ -258,16 +330,22 @@ class InferenceServer:
             except Exception:  # a user callback must never kill the loop
                 telemetry.inc("tdt_serving_callback_errors_total", kind="token")
 
-    def _finish(self, slot: Slot) -> None:
-        from triton_dist_tpu.serving.scheduler import RequestState
-
+    def _finish(self, slot: Slot, reason: str = "ok") -> None:
+        """End a slot's stream and free it. ``reason`` distinguishes a
+        natural completion ("ok") from a client cancel ("cancelled") and a
+        total-deadline truncation ("deadline") — only "ok" counts toward
+        ``tdt_serving_requests_completed_total``."""
         req = slot.request
-        req.state = RequestState.DONE
+        req.finish_reason = reason
+        req.state = (
+            RequestState.CANCELLED if reason == "cancelled" else RequestState.DONE
+        )
         req.finished_at = self._now()
-        tpot = req.tpot_s
-        if tpot is not None:
-            telemetry.observe("tdt_serving_tpot_seconds", tpot)
-        telemetry.inc("tdt_serving_requests_completed_total")
+        if reason == "ok":
+            tpot = req.tpot_s
+            if tpot is not None:
+                telemetry.observe("tdt_serving_tpot_seconds", tpot)
+            telemetry.inc("tdt_serving_requests_completed_total")
         self.scheduler.finish(slot)
         self.scheduler.release(slot)
         self._remaining[slot.idx] = 0
@@ -276,28 +354,83 @@ class InferenceServer:
                 req.on_finish(req)
             except Exception:
                 telemetry.inc("tdt_serving_callback_errors_total", kind="finish")
-        req.trace.point("tdt_serving_finish", slot=slot.idx)
-        req.trace.finish(status="ok", n_tokens=len(req.tokens))
+        req.trace.point("tdt_serving_finish", slot=slot.idx, reason=reason)
+        req.trace.finish(status=reason, n_tokens=len(req.tokens))
+
+    def _reap_slots(self) -> None:
+        """Chunk-boundary lifecycle sweep: free cancelled slots and truncate
+        streams whose TOTAL deadline passed mid-decode. Runs between chunk
+        dispatches, so both free their slot within one chunk of the event."""
+        now = self._now()
+        for slot in self.scheduler.occupied_slots():
+            req = slot.request
+            if slot.state not in (SlotState.PREFILL, SlotState.DECODE):
+                continue
+            if req.cancel_requested:
+                telemetry.inc("tdt_serving_cancelled_total", where="running")
+                self._finish(slot, reason="cancelled")
+            elif (
+                req.deadline_s is not None
+                and now - req.arrived_at > req.deadline_s
+            ):
+                telemetry.inc(
+                    "tdt_serving_deadline_expiries_total", where="decode"
+                )
+                telemetry.observe(
+                    "tdt_serving_deadline_overrun_seconds",
+                    now - req.arrived_at - req.deadline_s,
+                )
+                self._finish(slot, reason="deadline")
 
     # --------------------------------------------------------------- recovery
     def _guarded(self, fn, what: str):
         """Run one serving step; on a degraded-mode failure (bounded-wait
         abort or watchdog timeout), rebuild on xla WITHOUT dropping the
         queue or any in-flight stream, then resume. Anything else raises."""
-        from triton_dist_tpu.runtime import resilience
-
         try:
             return fn()
         except Exception as e:
-            recoverable = self.engine.backend != "xla" and (
-                resilience.any_degraded()
-                or isinstance(e, (resilience.CollectiveAbortError,
-                                  resilience.CollectiveTimeoutError))
-            )
+            # Host-injected aborts (chaos) can fire even while the engine is
+            # already on xla — recovery handles both, it just skips the
+            # backend rebuild and reallocates the cache.
+            recoverable = isinstance(
+                e, (resilience.CollectiveAbortError,
+                    resilience.CollectiveTimeoutError)
+            ) or (self.engine.backend != "xla" and resilience.any_degraded())
             if not recoverable:
                 raise
             self._recover(f"{type(e).__name__} during {what}")
             return None
+
+    def _reprefill_occupied(self, occupied) -> None:
+        """Re-prefill every in-flight slot from its durable token history,
+        absorbing faults that land DURING the re-prefill (the double-fault
+        scenario): each retry rebuilds on xla over a fresh cache — the
+        failed attempt's prefill scatter consumed (donated) cache buffers —
+        and starts the walk over. Safe to restart: a slot whose re-prefill
+        already succeeded just re-prefills again; token0 cannot stream twice
+        because a recovering request's history is non-empty."""
+        attempts = 0
+        while True:
+            try:
+                for slot in occupied:
+                    self._prefill_slot(slot)
+                return
+            except (resilience.CollectiveAbortError,
+                    resilience.CollectiveTimeoutError) as e:
+                attempts += 1
+                telemetry.inc("tdt_serving_recovery_retries_total")
+                telemetry.emit(
+                    "serving_recovery_retry",
+                    why=type(e).__name__, attempt=attempts,
+                )
+                if attempts >= REPREFILL_RETRIES:
+                    raise
+                if self.engine.backend != "xla":
+                    self.engine._degrade_to_xla(
+                        f"{type(e).__name__} during recovery re-prefill"
+                    )
+                self.cache = self.engine.alloc_slots(self.num_slots)
 
     def _recover(self, why: str) -> None:
         eng = self.engine
@@ -318,9 +451,9 @@ class InferenceServer:
         # old slot cache — rebuild it whole from each tenant's durable
         # token history. Queued requests ride along untouched.
         self.cache = eng.alloc_slots(self.num_slots)
-        for slot in occupied:
-            self._prefill_slot(slot)
+        self._reprefill_occupied(occupied)
         r_end = tracing.now_s()
+        telemetry.observe("tdt_serving_recovery_seconds", r_end - r_start)
         # Recovery preempted every in-flight request — each affected trace
         # gets the full rebuild+re-prefill interval as a span of its own
         # (parented at its root), plus one in the server trace.
@@ -333,4 +466,75 @@ class InferenceServer:
         self._trace.record(
             "tdt_serving_recovery", r_start, r_end,
             why=why, from_backend=from_backend, in_flight=len(occupied),
+        )
+
+    # ------------------------------------------------------- half-open probe
+    def _maybe_probe(self) -> bool:
+        """When running degraded and a breaker's backoff has elapsed, probe
+        the preferred backend with one sandboxed dispatch. Success closes
+        the breaker and restores live routing; failure re-opens it with
+        doubled backoff. Either way the serving cache is untouched — the
+        probe runs on a throwaway 1-slot cache."""
+        if self.engine.backend == self._preferred_backend:
+            return False
+        due = resilience.probe_due()
+        if not due:
+            return False
+        resilience.begin_probe(due)
+        ok, err = True, ""
+        with self._trace.span(
+            "tdt_serving_probe", features=",".join(due),
+            to_backend=self._preferred_backend,
+        ):
+            try:
+                with resilience.probe_scope(due):
+                    self.engine.rebuild(self._preferred_backend)
+                    resilience.chaos_check("probe")
+                    sandbox = self.engine.alloc_slots(1)
+                    token0, sandbox = self.engine.prefill_into_slot(
+                        sandbox, 0, jnp.asarray([[1, 2, 3]], jnp.int32)
+                    )
+                    out = self.engine.decode_steps(
+                        sandbox, jnp.asarray([int(token0)], jnp.int32),
+                        jnp.asarray([1], jnp.int32), 1,
+                    )
+                    jax.block_until_ready(out[0])
+            except Exception as e:  # a probe must never kill the loop
+                ok, err = False, f"{type(e).__name__}: {e}"
+        resilience.end_probe(due, ok=ok)
+        if ok:
+            self._restore_streams()
+        else:
+            telemetry.emit("serving_probe_failed", features=",".join(due), error=err)
+            # Back to the degraded programs; the serving cache was never
+            # touched, so live streams resume exactly where they were.
+            self.engine.rebuild("xla")
+        return True
+
+    def _restore_streams(self) -> None:
+        """Re-resolve routing onto the (just-probed) preferred backend for
+        LIVE traffic without dropping a stream: fresh slot cache +
+        re-prefill from history — the recovery machinery pointed back at
+        the fused path."""
+        occupied = self.scheduler.occupied_slots()
+        to_backend = self.engine.backend
+        telemetry.inc("tdt_serving_restores_total", to_backend=to_backend)
+        telemetry.emit(
+            "serving_restore", to_backend=to_backend,
+            in_flight=len(occupied), queued=self.scheduler.queue_depth(),
+        )
+        r_start = tracing.now_s()
+        self.cache = self.engine.alloc_slots(self.num_slots)
+        self._reprefill_occupied(occupied)
+        r_end = tracing.now_s()
+        telemetry.observe("tdt_serving_restore_seconds", r_end - r_start)
+        for slot in occupied:
+            if slot.request is not None:
+                slot.request.trace.record(
+                    "tdt_serving_restore", r_start, r_end,
+                    to_backend=to_backend, slot=slot.idx,
+                )
+        self._trace.record(
+            "tdt_serving_restore", r_start, r_end,
+            to_backend=to_backend, in_flight=len(occupied),
         )

@@ -921,6 +921,83 @@ def bench_serving_chaos(on_tpu):
     return out
 
 
+def bench_serving_rank_loss(on_tpu):
+    """Rank-loss serving benchmark (the crash-resumable-serving subsystem):
+    kill a health-board rank with a scripted ``die@1`` mid-decode, let the
+    dead-peer fail-fast gate route the serving loop through ONE epoch-fenced
+    recovery (no per-collective timeout storm), revive the rank during the
+    rebuild, and report end-to-end tokens/s through the fault plus the
+    recovery latency. Gated by check_bench_regression.py:
+    ``serving_rank_loss_tokens_per_s`` (higher better) and
+    ``serving_rank_loss_recovery_ms`` (lower better); ``_restored`` is the
+    arc's pass/fail bit (1.0 = fused routing came back)."""
+    import os
+    import time
+
+    from triton_dist_tpu.models import PRESETS, DenseLLM, Engine
+    from triton_dist_tpu.runtime import mesh, resilience, telemetry
+    from triton_dist_tpu.runtime.mesh import initialize_distributed
+    from triton_dist_tpu.serving import InferenceServer
+
+    ctx = initialize_distributed(
+        devices=jax.devices()[:1], axis_names=("tp",), set_default=False
+    )
+    model = DenseLLM(PRESETS["test-dense"], ctx, key=jax.random.PRNGKey(1))
+
+    reqs = [
+        ([(11 * i + j) % 256 for j in range(4 + (3 * i) % 8)], 6 + (5 * i) % 8)
+        for i in range(16)
+    ]
+    out = {"serving_rank_loss_requests": len(reqs)}
+
+    def _hist(name):
+        entries = telemetry.snapshot()["histograms"].get(name) or []
+        return (sum(e["count"] for e in entries),
+                sum(e["sum"] for e in entries))
+
+    prev_probe = os.environ.get("TDT_DEGRADE_PROBE_S")
+    os.environ["TDT_DEGRADE_PROBE_S"] = "0.05"
+    rec_count0, rec_sum0 = _hist("tdt_serving_recovery_seconds")
+    aborts0 = telemetry.counter_total("tdt_resilience_aborts_total")
+    try:
+        eng = Engine(model, backend="dist_ar", max_len=64)
+        srv = InferenceServer(eng, num_slots=4, chunk=4)
+        # Huge heartbeat: only the scripted die can kill a rank here.
+        mesh.init_health_board(world=2, heartbeat_s=1000.0)
+        with resilience.chaos_schedule("die@1:2,revive@1,heal"):
+            handles = [srv.submit(p, g) for p, g in reqs]
+            t0 = time.perf_counter()
+            srv.run()
+            wall = time.perf_counter() - t0
+            deadline = time.monotonic() + 10.0
+            while eng.backend != "dist_ar" and time.monotonic() < deadline:
+                if not srv.step():
+                    time.sleep(0.01)
+        toks = sum(len(h.tokens) for h in handles)
+        out["serving_rank_loss_tokens_per_s"] = round(toks / wall, 1)
+        out["serving_rank_loss_restored"] = float(
+            eng.backend == "dist_ar" and not resilience.dead_ranks()
+        )
+        # The fail-fast property, as a number: bounded-wait aborts burned
+        # on the dead peer (0.0 = the gate refused before any device poll).
+        out["serving_rank_loss_timeout_aborts"] = (
+            telemetry.counter_total("tdt_resilience_aborts_total") - aborts0
+        )
+        rec_count, rec_sum = _hist("tdt_serving_recovery_seconds")
+        if rec_count > rec_count0:
+            out["serving_rank_loss_recovery_ms"] = round(
+                1e3 * (rec_sum - rec_sum0) / (rec_count - rec_count0), 2
+            )
+    finally:
+        mesh.reset_health_board()
+        resilience.reset_degradation()
+        if prev_probe is None:
+            os.environ.pop("TDT_DEGRADE_PROBE_S", None)
+        else:
+            os.environ["TDT_DEGRADE_PROBE_S"] = prev_probe
+    return out
+
+
 def bench_dma_overlap_capture(on_tpu):
     """DURATION-overlap evidence in the driver record (r4 verdict missing
     #4's on-chip half): capture an XProf trace of the fused AG-GEMM kernel
@@ -1519,6 +1596,15 @@ def main():
         emit()
     else:
         extra["serving_chaos_skipped"] = "budget"
+    if remaining() > 45:
+        phase("serving_rank_loss")
+        try:
+            absorb(bench_serving_rank_loss(on_tpu))
+        except Exception as e:  # noqa: BLE001
+            extra["serving_rank_loss_error"] = f"{type(e).__name__}"
+        emit()
+    else:
+        extra["serving_rank_loss_skipped"] = "budget"
     if remaining() > 60:
         phase("dma_overlap")
         try:

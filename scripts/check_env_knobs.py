@@ -1,0 +1,178 @@
+#!/usr/bin/env python
+"""Lint: every ``TDT_*`` environment knob READ in the package is documented.
+
+The runtime grows knobs one `get_int_env` at a time, and the docs tables
+(``docs/*.md``) drift behind — an operator who greps the docs for a tuning
+lever must find every knob that actually exists. This lint closes the loop
+mechanically:
+
+* an **env read** is any of
+  - ``get_bool_env / get_int_env / get_float_env / get_choice_env /
+    os.getenv`` with a literal first argument,
+  - ``os.environ.get("TDT_...")`` / ``os.environ["TDT_..."]`` /
+    ``"TDT_..." in os.environ``;
+* every read knob matching ``TDT_[A-Z0-9_]+`` must appear somewhere in the
+  docs set (``docs/**/*.md`` plus ``README.md``) — a docs TABLE row is the
+  convention, but any mention satisfies the lint (prose near the table is
+  fine; absence is the bug);
+* a **dynamic knob name** (non-literal first argument to an env helper) is
+  rejected outright — an un-greppable knob can never be documented.
+
+Escape hatch: a trailing ``# env-knob-ok: <reason>`` comment on the
+offending line, for a read that is deliberately internal (none exist
+today; keep it that way).
+
+Usage: ``python scripts/check_env_knobs.py [code_roots...] [--docs DIR]``
+(defaults: ``triton_dist_tpu/`` scanned against ``docs/`` + ``README.md``).
+Exit 1 with ``file:line`` diagnostics on violations. The explicit-roots
+form exists for the fixture tests in ``tests/test_tools.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+DEFAULT_ROOTS = (REPO / "triton_dist_tpu",)
+DEFAULT_DOCS = REPO / "docs"
+
+WAIVER = "# env-knob-ok:"
+KNOB = re.compile(r"^TDT_[A-Z0-9_]+$")
+#: Helper names whose first argument is an env-var name.
+ENV_FNS = {"get_bool_env", "get_int_env", "get_float_env",
+           "get_choice_env", "getenv"}
+
+
+def _fn_name(node: ast.Call) -> str | None:
+    fn = node.func
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return None
+
+
+def _is_environ(node: ast.expr) -> bool:
+    """True for a reference to ``os.environ`` (or a bare ``environ``)."""
+    if isinstance(node, ast.Attribute):
+        return node.attr == "environ"
+    return isinstance(node, ast.Name) and node.id == "environ"
+
+
+def _literal_str(node: ast.expr) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def scan_file(path: pathlib.Path) -> tuple[dict[str, str], list[str]]:
+    """Return ({knob: first "file:line" site}, [violations]) for one file."""
+    src = path.read_text()
+    try:
+        tree = ast.parse(src, filename=str(path))
+    except SyntaxError as e:  # a broken file is some other tool's problem
+        return {}, [f"{path}:{e.lineno}: syntax error while linting: {e.msg}"]
+    lines = src.splitlines()
+    try:
+        rel = path.relative_to(REPO)
+    except ValueError:
+        rel = path
+
+    knobs: dict[str, str] = {}
+    errors: list[str] = []
+
+    def waived(node: ast.AST) -> bool:
+        line = lines[node.lineno - 1] if node.lineno - 1 < len(lines) else ""
+        return WAIVER in line
+
+    def saw(name: str | None, node: ast.AST) -> None:
+        if name is not None and KNOB.match(name):
+            knobs.setdefault(name, f"{rel}:{node.lineno}")
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            fname = _fn_name(node)
+            if fname in ENV_FNS and node.args:
+                name = _literal_str(node.args[0])
+                if name is None:
+                    if not waived(node):
+                        errors.append(
+                            f"{rel}:{node.lineno}: dynamic env-knob name "
+                            f"passed to {fname}() — knob names must be "
+                            "string literals so they can be documented"
+                        )
+                else:
+                    saw(name, node)
+            elif (fname == "get" and isinstance(node.func, ast.Attribute)
+                  and _is_environ(node.func.value) and node.args):
+                saw(_literal_str(node.args[0]), node)
+        elif isinstance(node, ast.Subscript) and _is_environ(node.value):
+            saw(_literal_str(node.slice), node)
+        elif isinstance(node, ast.Compare) and len(node.ops) == 1:
+            if (isinstance(node.ops[0], (ast.In, ast.NotIn))
+                    and _is_environ(node.comparators[0])):
+                saw(_literal_str(node.left), node)
+    return knobs, errors
+
+
+def documented_knobs(docs_dir: pathlib.Path) -> set[str]:
+    token = re.compile(r"TDT_[A-Z0-9_]+")
+    docs: set[str] = set()
+    paths = sorted(docs_dir.rglob("*.md")) if docs_dir.is_dir() else []
+    readme = docs_dir.parent / "README.md"
+    if readme.exists():
+        paths.append(readme)
+    for p in paths:
+        docs.update(token.findall(p.read_text()))
+    return docs
+
+
+def main(argv: list[str]) -> int:
+    docs_dir = DEFAULT_DOCS
+    roots: list[pathlib.Path] = []
+    it = iter(argv)
+    for a in it:
+        if a == "--docs":
+            docs_dir = pathlib.Path(next(it, ""))
+        else:
+            roots.append(pathlib.Path(a))
+    roots = roots or list(DEFAULT_ROOTS)
+
+    files: list[pathlib.Path] = []
+    for root in roots:
+        if root.is_dir():
+            files.extend(sorted(root.rglob("*.py")))
+        else:
+            files.append(root)
+
+    knobs: dict[str, str] = {}
+    errors: list[str] = []
+    for f in files:
+        file_knobs, file_errors = scan_file(f)
+        errors.extend(file_errors)
+        for name, site in file_knobs.items():
+            knobs.setdefault(name, site)
+
+    docs = documented_knobs(docs_dir)
+    for name in sorted(set(knobs) - docs):
+        errors.append(
+            f"{knobs[name]}: knob {name!r} is read here but documented "
+            f"nowhere under {docs_dir} (or README.md) — add it to the "
+            "relevant knobs table"
+        )
+
+    if errors:
+        print(f"check_env_knobs: {len(errors)} violation(s)")
+        for e in errors:
+            print(e)
+        return 1
+    print(f"check_env_knobs: OK ({len(knobs)} knob(s) across "
+          f"{len(files)} file(s), {len(docs)} documented)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

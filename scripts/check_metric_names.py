@@ -75,6 +75,19 @@ REQUIRED_NAMES = {
     "tdt_resilience_probes_total",
     "tdt_resilience_chaos_injected_total",
     "tdt_mesh_connect_retries_total",
+    # rank health / epoch fencing (mesh + resilience)
+    "tdt_mesh_epoch",
+    "tdt_health_beats_total",
+    "tdt_health_deaths_total",
+    "tdt_health_rank_alive",
+    "tdt_resilience_dead_peer_failfast_total",
+    "tdt_resilience_stale_epoch_total",
+    # write-ahead journal / crash recovery / shutdown (serving)
+    "tdt_serving_journal_records_total",
+    "tdt_serving_journal_fsyncs_total",
+    "tdt_serving_journal_replayed_total",
+    "tdt_serving_journal_replay_seconds",
+    "tdt_serving_drain_seconds",
     # span names
     "tdt_serving_probe",
     "tdt_serving_restore",

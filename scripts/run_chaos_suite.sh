@@ -1,9 +1,24 @@
 #!/usr/bin/env bash
-# Run the scripted chaos suite: every `-m chaos` test (fault-injection
-# collectives, degraded-mode serving recovery, probe-driven un-degrade)
-# under fast, deterministic resilience knobs.
+# Run the scripted chaos suite scenario by scenario and print a per-scenario
+# exit-code summary table, so one broken arc names itself instead of hiding
+# inside a single aggregated pytest exit code.
 #
 # Usage: scripts/run_chaos_suite.sh [extra pytest args...]
+#
+# Scenarios (every `-m chaos` test is covered by exactly one):
+#   collective-faults   FaultPlan kinds on the ctx4 interpret mesh
+#                       (delay absorbs, drop aborts bounded, corrupt surfaces)
+#   dead-peer-gate      dead rank on the registry -> trace-time DeadPeerError
+#                       at the kernel boundary, zero bounded-wait aborts
+#   serving-degrade     injected abort mid-serving -> degraded-XLA recovery,
+#                       zero token loss/duplication
+#   probe-arc           degrade -> failed probe -> restore fused in-process
+#   double-fault        recovery re-prefill itself aborted -> bounded retry
+#   rank-death          scripted die@<rank> mid-decode -> dead_peer fail-fast,
+#                       epoch fence, revive, fused restore (NEW)
+#   kill-and-recover    journaled server abandoned mid-serve -> fresh server
+#                       replays the journal, zero drop/dup (NEW)
+#   observability       chaos arcs stay visible in traces + telemetry
 #
 # The env pins below make the arcs quick and reproducible:
 #   * TDT_WAIT_BOUND_ITERS bounds interpret-mode collective waits so an
@@ -21,5 +36,50 @@ export JAX_PLATFORMS
 export TDT_WAIT_BOUND_ITERS="${TDT_WAIT_BOUND_ITERS:-20000}"
 unset TDT_CHAOS_SCHEDULE TDT_DEGRADE_PROBE_S
 
-exec python -m pytest tests/ -m chaos -q \
-  -p no:cacheprovider -p no:randomly "$@"
+PYTEST=(python -m pytest -m chaos -q -p no:cacheprovider -p no:randomly)
+
+names=()
+statuses=()
+overall=0
+
+run_scenario() {
+  local name="$1"
+  shift
+  echo
+  echo "=== chaos scenario: ${name} ==="
+  "${PYTEST[@]}" "$@"
+  local rc=$?
+  names+=("${name}")
+  statuses+=("${rc}")
+  if [ "${rc}" -ne 0 ]; then
+    overall=1
+  fi
+}
+
+run_scenario collective-faults tests/test_resilience.py "$@"
+run_scenario dead-peer-gate tests/test_mesh_health.py "$@"
+run_scenario serving-degrade tests/test_serving.py "$@"
+run_scenario probe-arc \
+  tests/test_chaos.py::test_chaos_probe_arc_restores_fused_backend "$@"
+run_scenario double-fault \
+  tests/test_chaos.py::test_chaos_double_fault_recovery_stays_degraded "$@"
+run_scenario rank-death \
+  tests/test_chaos.py::test_chaos_rank_death_arc_fails_fast_and_recovers "$@"
+run_scenario kill-and-recover \
+  tests/test_journal.py::test_kill_and_recover_zero_drop_zero_dup "$@"
+run_scenario observability tests/test_telemetry.py tests/test_tracing.py "$@"
+
+echo
+echo "=== chaos suite summary ==="
+printf '%-20s %s\n' "scenario" "result"
+printf '%-20s %s\n' "--------" "------"
+i=0
+while [ "${i}" -lt "${#names[@]}" ]; do
+  if [ "${statuses[$i]}" -eq 0 ]; then
+    printf '%-20s %s\n' "${names[$i]}" "PASS"
+  else
+    printf '%-20s %s\n' "${names[$i]}" "FAIL (exit ${statuses[$i]})"
+  fi
+  i=$((i + 1))
+done
+exit "${overall}"

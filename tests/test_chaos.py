@@ -263,3 +263,84 @@ def test_chaos_double_fault_recovery_stays_degraded(model1, monkeypatch):
     assert telemetry.counter_value(
         "tdt_serving_recoveries_total", from_backend="dist_ar"
     ) == 1.0
+
+
+# ==================================== rank-death arc: die → fence → revive
+
+
+@pytest.mark.chaos
+def test_chaos_rank_death_arc_fails_fast_and_recovers(model1, monkeypatch):
+    """The rank-loss acceptance arc: scripted ``die@1`` mid-decode kills a
+    peer on the health board → the in-flight collective fails fast with
+    ``dead_peer`` (NO bounded-wait timeout storm: zero aborts on the
+    ledger) → the mesh epoch bumps → ONE recovery rebuilds the engine on
+    the surviving configuration → scripted ``revive@1`` during recovery
+    brings the rank back (second epoch bump) → probes restore the fused
+    backend, and every stream is byte-identical to the one-shot
+    reference."""
+    from triton_dist_tpu.runtime import mesh
+
+    monkeypatch.setenv("TDT_DEGRADE_PROBE_S", "0.01")
+    ref_eng = make_engine(model1, backend="xla")
+    refs = _references(ref_eng)
+
+    eng = make_engine(model1, backend="dist_ar")
+    srv = InferenceServer(eng, num_slots=2, chunk=2)
+    # Huge heartbeat so only the scripted die — never a wall-clock lease
+    # expiry on a slow CI box — can kill a rank.
+    board = mesh.init_health_board(world=2, heartbeat_s=1000.0)
+    streams: dict[int, list[int]] = {}
+    try:
+        # skip=2 burns the two join prefills: the death lands MID-DECODE.
+        with resilience.chaos_schedule("die@1:2,revive@1,heal"):
+            handles = [
+                srv.submit(p, g, on_token=lambda r, t, i: streams.setdefault(
+                    r.req_id, []).append(t))
+                for p, g in REQUESTS
+            ]
+            srv.run()
+            deadline = time.monotonic() + 30.0
+            while eng.backend != "dist_ar":
+                assert time.monotonic() < deadline, "probe never restored fused"
+                if not srv.step():
+                    time.sleep(0.005)
+
+        for h, ref in zip(handles, refs):
+            assert h.done
+            np.testing.assert_array_equal(np.asarray(h.tokens, np.int32), ref)
+            assert streams[h.req_id] == list(h.tokens)
+
+        # The mesh healed: rank 1 alive again, epoch fenced twice
+        # (death + revival), nothing left degraded.
+        assert board.alive(1)
+        assert resilience.dead_ranks() == {}
+        assert resilience.mesh_epoch() == 2
+        assert eng.backend == "dist_ar"
+        assert not resilience.any_degraded()
+
+        # THE no-timeout-storm property: the dead peer was refused at the
+        # dead_peer fail-fast gate, so the bounded-wait abort ledger — a
+        # timeout per collective in a naive design — stayed EMPTY.
+        assert telemetry.counter_total("tdt_resilience_aborts_total") == 0.0
+        assert telemetry.counter_total(
+            "tdt_resilience_dead_peer_failfast_total"
+        ) >= 1.0
+        assert telemetry.counter_value(
+            "tdt_health_deaths_total", rank=1
+        ) == 1.0
+        assert telemetry.counter_value(
+            "tdt_health_revivals_total", rank=1
+        ) == 1.0
+        # Exactly ONE recovery absorbed the death (no per-collective storm),
+        # and one restore brought fused routing back.
+        assert telemetry.counter_value(
+            "tdt_serving_recoveries_total", from_backend="dist_ar"
+        ) == 1.0
+        assert telemetry.counter_value(
+            "tdt_serving_restores_total", to_backend="dist_ar"
+        ) == 1.0
+        kinds = [e["kind"] for e in telemetry.events()]
+        assert "rank_dead" in kinds and "rank_revived" in kinds
+        assert kinds.count("mesh_epoch") == 2
+    finally:
+        mesh.reset_health_board()

@@ -1,0 +1,314 @@
+"""Rank-loss tolerance unit tests: heartbeat health board, dead-peer
+fail-fast, mesh-epoch fencing, and the ``die``/``revive`` chaos grammar.
+
+Host tier — every lease computation takes an explicit ``now`` so nothing
+here sleeps. The one device-adjacent test (``dist_pallas_call`` refusing a
+collective while a rank is dead) is ``@pytest.mark.chaos`` and runs on the
+ctx4 interpret mesh like the rest of the chaos suite.
+"""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from triton_dist_tpu.runtime import mesh, resilience, telemetry
+from triton_dist_tpu.runtime.resilience import (
+    CollectiveAbortError,
+    DeadPeerError,
+    StaleEpochError,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    telemetry.reset()
+    resilience.reset_degradation()
+    mesh.reset_health_board()
+    yield
+    telemetry.reset()
+    resilience.reset_degradation()
+    mesh.reset_health_board()
+    jax.clear_caches()
+
+
+# ------------------------------------------------------------- health board
+
+
+def test_health_board_lease_expiry_and_beat():
+    b = mesh.HealthBoard(4, heartbeat_s=1.0, miss=3, now=0.0)
+    assert b.lease_s == 3.0
+    assert all(b.alive(r) for r in range(4))
+
+    # Rank 1 beats inside the window; everyone else stays silent.
+    b.beat(1, now=2.0)
+    assert b.sweep(now=2.5) == []          # nobody past the lease yet
+    newly_dead = b.sweep(now=3.5)          # 0/2/3 silent for 3.5s > 3.0s
+    assert sorted(newly_dead) == [0, 2, 3]
+    assert b.alive(1) and not b.alive(0)
+    assert set(resilience.dead_ranks()) == {0, 2, 3}
+    # One epoch bump per death, starting from 0.
+    assert resilience.mesh_epoch() == 3
+    # Sweeping again declares nothing new (idempotent).
+    assert b.sweep(now=3.6) == []
+
+    snap = b.snapshot(now=4.0)
+    assert snap["world"] == 4 and snap["epoch"] == 3
+    assert snap["ranks"]["1"]["alive"] is True
+    assert snap["ranks"]["0"]["alive"] is False
+    assert "lease expired" in snap["ranks"]["0"]["reason"]
+    assert snap["ranks"]["1"]["last_beat_age_s"] == 2.0
+
+
+def test_health_board_dead_beat_ignored_until_revive():
+    b = mesh.HealthBoard(2, heartbeat_s=1.0, miss=2, now=0.0)
+    epoch = b.declare_dead(1, reason="operator")
+    assert epoch == 1 and not b.alive(1)
+    # A zombie's beat must not resurrect it.
+    b.beat(1, now=0.1)
+    assert not b.alive(1)
+    assert telemetry.counter_value("tdt_health_stale_beats_total", rank=1) == 1.0
+    # Revival is the explicit path: fresh lease + another epoch bump.
+    assert b.revive(1, now=5.0) == 2
+    assert b.alive(1)
+    b.beat(0, now=5.0)                     # keep the bystander alive
+    assert b.sweep(now=6.0) == []          # lease renewed at revive time
+    b.beat(1, now=6.5)                     # and normal beats count again
+    assert telemetry.counter_value("tdt_health_beats_total", rank=1) == 1.0
+    assert telemetry.counter_value("tdt_health_beats_total", rank=0) == 1.0
+
+
+def test_health_board_validates_inputs():
+    with pytest.raises(ValueError):
+        mesh.HealthBoard(0)
+    b = mesh.HealthBoard(2, heartbeat_s=1.0, miss=1, now=0.0)
+    with pytest.raises(ValueError):
+        b.beat(2)
+    with pytest.raises(ValueError):
+        b.declare_dead(-1)
+
+
+def test_health_board_module_singleton():
+    assert mesh.health_board() is None
+    b = mesh.init_health_board(world=3, heartbeat_s=1.0, miss=1, now=0.0)
+    assert mesh.health_board() is b
+    mesh.reset_health_board()
+    assert mesh.health_board() is None
+
+
+def test_heartbeat_thread_renews_lease():
+    b = mesh.HealthBoard(1, heartbeat_s=0.02, miss=3)
+    hb = mesh.start_heartbeat(b, rank=0, interval_s=0.01)
+    try:
+        time.sleep(0.15)                   # several leases' worth of wall time
+        assert b.sweep() == []             # the publisher kept rank 0 alive
+        assert b.alive(0)
+    finally:
+        hb.stop()
+    assert telemetry.counter_value("tdt_health_beats_total", rank=0) >= 2.0
+
+
+# --------------------------------------------- dead-rank registry + epoch
+
+
+def test_declare_dead_and_revive_bump_epoch_idempotently():
+    assert resilience.mesh_epoch() == 0
+    e1 = resilience.declare_rank_dead(2, reason="test")
+    assert e1 == 1 and resilience.dead_ranks() == {2: "test"}
+    # Re-declaring the same rank changes nothing.
+    assert resilience.declare_rank_dead(2) == 1
+    assert resilience.mesh_epoch() == 1
+    # Death opens the collectives breaker with the dead_peer reason.
+    assert resilience.is_degraded("collectives")
+    assert "dead_peer" in resilience.degraded_reasons()["collectives"]
+
+    e2 = resilience.declare_rank_revived(2)
+    assert e2 == 2 and resilience.dead_ranks() == {}
+    assert resilience.declare_rank_revived(2) == 2  # idempotent too
+    # Revival does NOT close the breaker — that's the probe's job.
+    assert resilience.is_degraded("collectives")
+
+    (g,) = telemetry.snapshot()["gauges"]["tdt_mesh_epoch"]
+    assert g["value"] == 2.0
+    assert telemetry.counter_value("tdt_health_deaths_total", rank=2) == 1.0
+    assert telemetry.counter_value("tdt_health_revivals_total", rank=2) == 1.0
+    kinds = [e["kind"] for e in telemetry.events()]
+    assert "rank_dead" in kinds and "rank_revived" in kinds
+
+
+def test_check_dead_peers_fails_fast():
+    resilience.check_dead_peers(kernel="k")  # nobody dead: no-op
+    resilience.declare_rank_dead(1, reason="gone")
+    with pytest.raises(DeadPeerError, match=r"dead_peer — rank\(s\) 1"):
+        resilience.check_dead_peers(feature="allgather", kernel="_ring_ag")
+    # DeadPeerError IS a CollectiveAbortError: every recovery path that
+    # catches aborts handles rank death with zero changes.
+    assert issubclass(DeadPeerError, CollectiveAbortError)
+    assert telemetry.counter_value(
+        "tdt_resilience_dead_peer_failfast_total",
+        feature="allgather", kernel="_ring_ag",
+    ) == 1.0
+    # reset_degradation is the full reset: registry and epoch included.
+    resilience.reset_degradation()
+    assert resilience.dead_ranks() == {} and resilience.mesh_epoch() == 0
+
+
+# ------------------------------------------------------ epoch-fenced status
+
+
+def test_record_status_stale_epoch_aborts():
+    resilience.declare_rank_dead(0)        # epoch 0 -> 1
+    stale = [resilience.STATUS_OK, 0, -1, 0, 0]  # stamped at epoch 0
+    with pytest.raises(StaleEpochError, match="epoch"):
+        resilience.record_status(stale, feature="allreduce", kernel="_ar_k")
+    ab = resilience.last_abort()
+    assert ab.phase == "stale_epoch" and ab.peer == -1
+    assert telemetry.counter_value(
+        "tdt_resilience_stale_epoch_total", feature="allreduce", kernel="_ar_k"
+    ) == 1.0
+    # The stale-epoch fence has its own counter, NOT the bounded-wait abort
+    # series (the no-timeout-storm ledger must stay clean).
+    assert telemetry.counter_total("tdt_resilience_aborts_total") == 0.0
+    kinds = [e["kind"] for e in telemetry.events()]
+    assert "stale_epoch_abort" in kinds
+
+
+def test_record_status_current_epoch_and_legacy_words_pass():
+    resilience.declare_rank_dead(0)
+    resilience.declare_rank_revived(0)     # epoch now 2
+    ok5 = [resilience.STATUS_OK, 0, -1, 0, resilience.mesh_epoch()]
+    resilience.record_status(ok5, feature="x", kernel="k")   # no raise
+    # 4-word legacy status lists carry no epoch: no fence to check.
+    resilience.record_status([resilience.STATUS_OK, 0, -1, 0],
+                             feature="x", kernel="k")
+    assert resilience.last_abort() is None
+
+
+def test_describe_status_reports_stale_epoch():
+    resilience.declare_rank_dead(3)
+    msg = resilience.describe_status([resilience.STATUS_OK, 0, -1, 0, 0])
+    assert msg is not None and "stale" in msg.lower()
+    cur = [resilience.STATUS_OK, 0, -1, 0, resilience.mesh_epoch()]
+    assert resilience.describe_status(cur) is None
+
+
+# ------------------------------------------------- chaos die/revive grammar
+
+
+def test_chaos_schedule_parses_die_and_revive():
+    s = resilience.ChaosSchedule("die@1:1,revive@1,heal")
+    assert [(e.action, e.rank, e.skip) for e in s.events] == [
+        ("die", 1, 1), ("revive", 1, 0),
+    ]
+    # Rank events match ANY site; skip consumes one check of any kind.
+    assert s.take("prefill") is None       # skip burned
+    ev = s.take("decode")
+    assert ev is not None and ev.action == "die" and ev.rank == 1
+    assert s.take("probe").action == "revive"
+    assert s.exhausted
+
+
+@pytest.mark.parametrize("spec", [
+    "die@decode",       # die targets a rank, not a site
+    "revive@x",         # non-integer rank
+    "die@",             # empty target
+])
+def test_chaos_schedule_rejects_bad_rank_specs(spec):
+    with pytest.raises(ValueError):
+        resilience.ChaosSchedule(spec)
+
+
+def test_chaos_die_routes_through_board_and_raises():
+    b = mesh.init_health_board(world=2, heartbeat_s=1.0, miss=1, now=0.0)
+    with resilience.chaos_schedule("die@1,revive@1,heal"):
+        with pytest.raises(DeadPeerError):
+            resilience.chaos_check("decode")
+        assert not b.alive(1)
+        assert resilience.dead_ranks()[1] == "chaos die"
+        assert resilience.mesh_epoch() == 1
+        # Revive fires at the next check of any site — and does NOT raise.
+        resilience.chaos_check("recovery")
+        assert b.alive(1) and resilience.mesh_epoch() == 2
+    assert telemetry.counter_value(
+        "tdt_resilience_chaos_injected_total", site="decode"
+    ) == 1.0
+
+
+def test_chaos_die_without_board_uses_registry():
+    with resilience.chaos_schedule("die@3,heal"):
+        with pytest.raises(DeadPeerError):
+            resilience.chaos_check("prefill")
+    assert resilience.dead_ranks()[3] == "chaos die"
+
+
+# --------------------------------------------- collective fail-fast (device)
+
+
+@pytest.mark.chaos
+def test_dist_pallas_call_refuses_collectives_while_rank_dead(ctx4, rng):
+    """The no-timeout-storm property at the kernel boundary: with a dead
+    rank on the registry, tracing ANY fused collective raises DeadPeerError
+    before a single device poll is spent — zero bounded-wait aborts.
+
+    The refusal fires at trace time (inside ``dist_pallas_call``, before
+    lowering), so this holds even on hosts whose jax lacks the TPU
+    interpreter; the numeric-parity legs are gated on interpreter support.
+    """
+    import numpy as np
+
+    from triton_dist_tpu.kernels import AllGatherMethod, all_gather_shard
+    from triton_dist_tpu.runtime.platform import interpret_mode_default
+
+    def ag(ctx):
+        return jax.jit(jax.shard_map(
+            lambda xs: all_gather_shard(
+                xs, axis="tp", method=AllGatherMethod.RING_1D
+            ).reshape(-1, xs.shape[-1]),
+            mesh=ctx.mesh, in_specs=(P("tp"),), out_specs=P(),
+            check_vma=False,
+        ))
+
+    x = jnp.asarray(rng.standard_normal((4 * 8, 64)), jnp.float32)
+    can_execute = bool(interpret_mode_default())
+
+    if can_execute:
+        np.testing.assert_allclose(np.asarray(ag(ctx4)(x)), np.asarray(x))
+        jax.clear_caches()                 # force a re-trace at the new epoch
+
+    resilience.declare_rank_dead(2, reason="test kill")
+    with pytest.raises(DeadPeerError, match="dead_peer"):
+        jax.block_until_ready(ag(ctx4)(x))
+    # Fail fast means NO bounded-wait timeout was burned on the dead peer.
+    assert telemetry.counter_total("tdt_resilience_aborts_total") == 0.0
+    assert telemetry.counter_total(
+        "tdt_resilience_dead_peer_failfast_total"
+    ) >= 1.0
+
+    # Revival + re-trace serves exact results again at the new epoch.
+    resilience.reset_degradation()
+    jax.clear_caches()
+    if can_execute:
+        np.testing.assert_allclose(np.asarray(ag(ctx4)(x)), np.asarray(x))
+
+
+def test_concurrent_beats_are_thread_safe():
+    b = mesh.HealthBoard(8, heartbeat_s=10.0, miss=3, now=0.0)
+    errs = []
+
+    def hammer(rank):
+        try:
+            for i in range(200):
+                b.beat(rank, now=float(i))
+        except Exception as e:  # pragma: no cover - only on a real race
+            errs.append(e)
+
+    threads = [threading.Thread(target=hammer, args=(r,)) for r in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    assert b.sweep(now=199.0) == []

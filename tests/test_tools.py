@@ -802,3 +802,71 @@ def test_bench_regression_gate_error_paths(tmp_path):
     empty = tmp_path / "empty.json"
     empty.write_text(json.dumps({"schema": 1, "primary": {}, "extra": {}}))
     assert _run_gate(base, str(empty), "--require-common", "1").returncode == 2
+
+
+# ------------------------------------------------------- env-knob lint
+
+
+def _run_knob_lint(*args):
+    import subprocess
+    import sys
+
+    return subprocess.run(
+        [sys.executable, "scripts/check_env_knobs.py", *args],
+        capture_output=True, text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+
+
+def test_env_knob_lint_repo_is_clean():
+    r = _run_knob_lint()
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_env_knob_lint_flags_undocumented_and_dynamic(tmp_path):
+    """Every read shape is recognized (helpers, environ.get, subscript,
+    membership), undocumented knobs are flagged with the read site, and a
+    dynamic knob name is rejected unless waived."""
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "knobs.md").write_text(
+        "| knob | meaning |\n|---|---|\n"
+        "| `TDT_DOCUMENTED_A` | present |\n"
+        "| `TDT_DOCUMENTED_B` | present |\n"
+    )
+    bad = tmp_path / "bad_knobs.py"
+    bad.write_text(
+        "import os\n"
+        "from triton_dist_tpu.runtime.utils import get_int_env\n"
+        "def f(name):\n"
+        "    a = get_int_env('TDT_DOCUMENTED_A', 1)\n"          # OK
+        "    b = os.environ.get('TDT_DOCUMENTED_B')\n"          # OK
+        "    c = os.environ['TDT_MISSING_SUBSCRIPT']\n"         # undocumented
+        "    d = 'TDT_MISSING_MEMBER' in os.environ\n"          # undocumented
+        "    e = os.getenv('TDT_MISSING_GETENV')\n"             # undocumented
+        "    f = get_int_env(name, 0)\n"                        # dynamic
+        "    g = get_int_env(name, 0)  # env-knob-ok: waived\n"  # waived
+        "    return a, b, c, d, e, f, g\n"
+    )
+    r = _run_knob_lint(str(bad), "--docs", str(docs))
+    assert r.returncode == 1, r.stdout + r.stderr
+    for knob in ("TDT_MISSING_SUBSCRIPT", "TDT_MISSING_MEMBER",
+                 "TDT_MISSING_GETENV"):
+        assert knob in r.stdout, r.stdout
+    assert "dynamic env-knob name" in r.stdout
+    assert r.stdout.count("bad_knobs.py:9") == 1, r.stdout   # dynamic flagged
+    assert "bad_knobs.py:10" not in r.stdout, r.stdout       # waiver honored
+    for knob in ("TDT_DOCUMENTED_A", "TDT_DOCUMENTED_B"):
+        assert knob not in r.stdout, r.stdout
+
+    # Documenting the stragglers turns the same tree green.
+    (docs / "knobs.md").write_text(
+        "| `TDT_DOCUMENTED_A` | `TDT_DOCUMENTED_B` |\n"
+        "| `TDT_MISSING_SUBSCRIPT` | `TDT_MISSING_MEMBER` |\n"
+        "| `TDT_MISSING_GETENV` | |\n"
+    )
+    bad.write_text(bad.read_text().replace(
+        "    f = get_int_env(name, 0)\n", ""
+    ))
+    r = _run_knob_lint(str(bad), "--docs", str(docs))
+    assert r.returncode == 0, r.stdout + r.stderr

@@ -14,10 +14,16 @@ route                   body
 ``/healthz``            JSON health verdict: ``status`` ``ok`` / ``degraded``
                         / ``shedding``, per-feature circuit-breaker states,
                         the serving health provider's section (shed
-                        pressure, backend vs preferred backend), last
-                        collective abort, watchdog timeout total, uptime —
-                        200 when ready, 503 otherwise (load-balancer
-                        friendly)
+                        pressure, backend vs preferred backend), the mesh
+                        section (mesh epoch, dead ranks, health-board
+                        snapshot when one is installed), last collective
+                        abort, watchdog timeout total, uptime — 200 when
+                        ready, 503 otherwise (load-balancer friendly)
+``/requests``           JSON request-level view from the serving loop:
+                        queue depth + head-of-queue summary, per-slot state
+                        machine position and deadlines remaining, journal
+                        lag (404 until an ``InferenceServer`` registers its
+                        provider)
 ``/snapshot``           JSON ``telemetry.snapshot()`` + span-trace section
                         (``tracing.snapshot_traces()``); list sections are
                         capped at ``?limit=`` items (default 256, 0 =
@@ -54,6 +60,7 @@ from triton_dist_tpu.runtime.utils import tdt_log
 _LOCK = threading.Lock()
 _SERVER: "IntrospectionServer | None" = None
 _HEALTH_PROVIDER = None
+_REQUESTS_PROVIDER = None
 
 #: Default item cap for the list-valued sections of /snapshot and /traces;
 #: override per request with ``?limit=N`` (``limit=0`` = uncapped).
@@ -67,6 +74,48 @@ def set_health_provider(fn) -> None:
     registers itself at construction; pass None to clear."""
     global _HEALTH_PROVIDER
     _HEALTH_PROVIDER = fn
+
+
+def set_requests_provider(fn) -> None:
+    """Register a callable returning the JSON-safe request-level view served
+    at ``/requests`` (queue depth, per-slot state, deadlines remaining,
+    journal lag). ``InferenceServer`` registers itself at construction; pass
+    None to clear."""
+    global _REQUESTS_PROVIDER
+    _REQUESTS_PROVIDER = fn
+
+
+def _mesh_section() -> dict:
+    """Mesh-membership view for /healthz: epoch, dead ranks, and the
+    health-board snapshot when one is installed."""
+    from triton_dist_tpu.runtime import mesh, resilience
+
+    section: dict = {
+        "epoch": resilience.mesh_epoch(),
+        "dead_ranks": {
+            str(r): why for r, why in sorted(resilience.dead_ranks().items())
+        },
+    }
+    board = mesh.health_board()
+    if board is not None:
+        try:
+            section["health_board"] = board.snapshot()
+        except Exception as e:  # a health probe must never 500 on a bug
+            section["health_board"] = {
+                "error": f"{type(e).__name__}: {e}"
+            }
+    return section
+
+
+def _requests() -> tuple[int, dict]:
+    provider = _REQUESTS_PROVIDER
+    if provider is None:
+        return 404, {"error": "no requests provider registered "
+                              "(is an InferenceServer running?)"}
+    try:
+        return 200, dict(provider())
+    except Exception as e:  # a debug route must never 500 the serving loop
+        return 200, {"provider_error": f"{type(e).__name__}: {e}"}
 
 
 def _healthz() -> tuple[int, dict]:
@@ -92,6 +141,7 @@ def _healthz() -> tuple[int, dict]:
         "ready": ready,
         "degraded": reasons,
         "breakers": resilience.breaker_states(),
+        "mesh": _mesh_section(),
         "serving": serving,
         "last_abort": None if last is None else {
             "feature": last.feature, "kernel": last.kernel,
@@ -149,6 +199,8 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                 self._send(200, telemetry.to_prometheus(), "text/plain; version=0.0.4")
             elif path == "/healthz":
                 self._send_json(*_healthz())
+            elif path == "/requests":
+                self._send_json(*_requests())
             elif path == "/snapshot":
                 # Bounded by default: a scrape during a long soak must not
                 # serialize the entire event/span rings (?limit=0 uncaps).
@@ -187,8 +239,8 @@ class _Handler(http.server.BaseHTTPRequestHandler):
             else:
                 self._send_json(404, {
                     "error": f"unknown route {path!r}",
-                    "routes": ["/metrics", "/healthz", "/snapshot",
-                               "/traces", "/traces/<id|last>"],
+                    "routes": ["/metrics", "/healthz", "/requests",
+                               "/snapshot", "/traces", "/traces/<id|last>"],
                 })
         except Exception as e:  # a debug endpoint must never kill its thread
             try:

@@ -21,6 +21,7 @@ import dataclasses
 import math
 import os
 import random
+import threading
 import time
 from typing import Mapping, Sequence
 
@@ -28,8 +29,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-from triton_dist_tpu.runtime import telemetry
-from triton_dist_tpu.runtime.utils import get_float_env, get_int_env
+from triton_dist_tpu.runtime import resilience, telemetry
+from triton_dist_tpu.runtime.utils import get_float_env, get_int_env, tdt_log
 
 #: Hard cap on one coordinator connect-retry sleep, seconds
 #: (``TDT_CONNECT_BACKOFF_CAP_S`` overrides).
@@ -197,6 +198,217 @@ def finalize_distributed() -> None:
     """Tear down distributed state (reference ``utils.py:206``)."""
     global _DEFAULT_CONTEXT, _JAX_DISTRIBUTED_INITIALIZED
     _DEFAULT_CONTEXT = None
+    reset_health_board()
     if jax.process_count() > 1:  # pragma: no cover - multi-host only
         jax.distributed.shutdown()
     _JAX_DISTRIBUTED_INITIALIZED = False
+
+
+# ---------------------------------------------------------------- health board
+
+#: Heartbeat publication interval, seconds (``TDT_HEARTBEAT_S`` overrides).
+DEFAULT_HEARTBEAT_S = 1.0
+#: Missed beats before a rank's lease expires (``TDT_HEARTBEAT_MISS``).
+DEFAULT_HEARTBEAT_MISS = 3
+
+
+class HealthBoard:
+    """Per-rank liveness leases over the monotonic clock.
+
+    Each rank holds a lease of ``heartbeat_s * miss`` seconds, renewed by
+    :meth:`beat`; :meth:`sweep` declares expired leases dead. Death and
+    revival route through the ``runtime.resilience`` dead-rank registry,
+    which bumps the **mesh epoch** and opens the 'collectives' breaker so
+    every subsequent fused collective fails fast with ``dead_peer`` instead
+    of timing out one bounded wait at a time.
+
+    Beats are published through the coordinator path the process already
+    has: in the single-controller/simulation setting every rank's beat is a
+    local :meth:`beat` call (a chaos ``die@<rank>`` models the loss); in a
+    multi-process launch each follower runs :func:`start_heartbeat` and the
+    transport delivering the beat to the board-owning process is whatever
+    side channel the deployment already uses for rendezvous — the board
+    deliberately takes ``beat(rank)`` calls rather than owning a socket.
+
+    All clock inputs accept an explicit ``now`` (monotonic seconds) so
+    lease arithmetic is unit-testable without sleeping.
+    """
+
+    def __init__(
+        self,
+        world: int,
+        *,
+        heartbeat_s: float | None = None,
+        miss: int | None = None,
+        now: float | None = None,
+    ):
+        if world < 1:
+            raise ValueError(f"HealthBoard world must be >= 1, got {world}")
+        self.world = int(world)
+        self.heartbeat_s = (
+            get_float_env("TDT_HEARTBEAT_S", DEFAULT_HEARTBEAT_S)
+            if heartbeat_s is None
+            else float(heartbeat_s)
+        )
+        self.miss = (
+            get_int_env("TDT_HEARTBEAT_MISS", DEFAULT_HEARTBEAT_MISS)
+            if miss is None
+            else int(miss)
+        )
+        self._lock = threading.Lock()
+        t = time.monotonic() if now is None else now
+        # Every rank starts with a full lease: a rank that never beats at
+        # all still expires, one lease after board construction.
+        self._last_beat = {r: t for r in range(self.world)}
+        for r in range(self.world):
+            telemetry.set_gauge("tdt_health_rank_alive", 1.0, rank=r)
+
+    @property
+    def lease_s(self) -> float:
+        """Seconds of silence after which a rank is declared dead."""
+        return self.heartbeat_s * max(self.miss, 1)
+
+    @property
+    def epoch(self) -> int:
+        """Current mesh epoch (authoritative value lives in resilience)."""
+        return resilience.mesh_epoch()
+
+    def alive(self, rank: int) -> bool:
+        return rank not in resilience.dead_ranks()
+
+    def beat(self, rank: int, now: float | None = None) -> None:
+        """Renew ``rank``'s lease. Beats from a dead rank are ignored —
+        rejoining requires an explicit :meth:`revive` (epoch fence), not a
+        silent lease renewal."""
+        if not 0 <= rank < self.world:
+            raise ValueError(f"rank {rank} outside world {self.world}")
+        if not self.alive(rank):
+            telemetry.inc("tdt_health_stale_beats_total", rank=rank)
+            return
+        with self._lock:
+            self._last_beat[rank] = time.monotonic() if now is None else now
+        telemetry.inc("tdt_health_beats_total", rank=rank)
+
+    def sweep(self, now: float | None = None) -> list[int]:
+        """Declare every rank whose lease has expired dead; returns the
+        newly dead ranks. Safe to call from the serving loop every step."""
+        t = time.monotonic() if now is None else now
+        lease = self.lease_s
+        with self._lock:
+            expired = [
+                r
+                for r, last in self._last_beat.items()
+                if t - last > lease and self.alive(r)
+            ]
+        for r in expired:
+            self.declare_dead(
+                r, reason=f"heartbeat lease expired ({lease:.3f}s silent)"
+            )
+        return expired
+
+    def declare_dead(self, rank: int, reason: str = "declared dead") -> int:
+        """Transition ``rank`` to dead: epoch bump + fail-fast ``dead_peer``
+        on every collective touching it. Idempotent. Returns the epoch."""
+        if not 0 <= rank < self.world:
+            raise ValueError(f"rank {rank} outside world {self.world}")
+        return resilience.declare_rank_dead(rank, reason=reason)
+
+    def revive(self, rank: int, now: float | None = None) -> int:
+        """Return a rank to the membership with a fresh lease. Bumps the
+        epoch; fused routing still waits for a successful breaker probe."""
+        with self._lock:
+            self._last_beat[rank] = time.monotonic() if now is None else now
+        return resilience.declare_rank_revived(rank)
+
+    def snapshot(self, now: float | None = None) -> dict:
+        """JSON-safe per-rank view (the ``/healthz`` mesh section)."""
+        t = time.monotonic() if now is None else now
+        dead = resilience.dead_ranks()
+        with self._lock:
+            last = dict(self._last_beat)
+        return {
+            "epoch": self.epoch,
+            "world": self.world,
+            "heartbeat_s": self.heartbeat_s,
+            "lease_s": self.lease_s,
+            "ranks": {
+                str(r): {
+                    "alive": r not in dead,
+                    "reason": dead.get(r),
+                    "last_beat_age_s": round(max(t - last[r], 0.0), 3),
+                }
+                for r in range(self.world)
+            },
+        }
+
+
+_HEALTH_BOARD: HealthBoard | None = None
+
+
+def init_health_board(world: int | None = None, **kwargs) -> HealthBoard:
+    """Create and install the process health board. ``world`` defaults to
+    the default context's world size when one exists."""
+    global _HEALTH_BOARD
+    if world is None:
+        world = get_default_context().world_size
+    _HEALTH_BOARD = HealthBoard(world, **kwargs)
+    return _HEALTH_BOARD
+
+
+def health_board() -> HealthBoard | None:
+    """The installed board, or None when liveness tracking is off."""
+    return _HEALTH_BOARD
+
+
+def reset_health_board() -> None:
+    global _HEALTH_BOARD
+    _HEALTH_BOARD = None
+
+
+class Heartbeat:
+    """Daemon publisher: renews one rank's lease (and optionally sweeps)
+    every ``interval_s``. ``stop()`` joins the thread."""
+
+    def __init__(self, board: HealthBoard, rank: int, interval_s: float, sweep: bool):
+        self._board = board
+        self._rank = rank
+        self._interval_s = interval_s
+        self._sweep = sweep
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name=f"tdt-heartbeat-{rank}", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._board.beat(self._rank)
+                if self._sweep:
+                    self._board.sweep()
+            except Exception as e:  # pragma: no cover - never kill the host
+                tdt_log(f"[mesh] heartbeat error: {e}", level="warn")
+            self._stop.wait(self._interval_s)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+
+
+def start_heartbeat(
+    board: HealthBoard | None = None,
+    rank: int = 0,
+    interval_s: float | None = None,
+    *,
+    sweep: bool = True,
+) -> Heartbeat:
+    """Start a daemon heartbeat for ``rank`` against ``board`` (default:
+    the installed board). The publisher beats every ``interval_s`` (default
+    the board's ``heartbeat_s``) so a healthy rank renews well inside its
+    ``heartbeat_s * miss`` lease."""
+    board = board if board is not None else _HEALTH_BOARD
+    if board is None:
+        raise RuntimeError("no health board installed; call init_health_board()")
+    return Heartbeat(
+        board, rank, board.heartbeat_s if interval_s is None else interval_s, sweep
+    )

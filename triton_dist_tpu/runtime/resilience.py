@@ -32,7 +32,19 @@ production counterpart, spanning four layers:
   (``TDT_CHAOS_SCHEDULE`` or :func:`chaos_schedule`, e.g.
   ``"abort@decode:1,abort@recovery,heal"``) consumed in order by
   :func:`chaos_check` call sites in the serving loop, so tests can script
-  double-fault recovery and probe-driven un-degrade arcs.
+  double-fault recovery and probe-driven un-degrade arcs. ``die@<rank>`` /
+  ``revive@<rank>`` steps script whole-rank loss against the dead-rank
+  registry below.
+* **Dead-rank registry + mesh epoch** — the rank-death tier above the
+  per-feature breakers: :func:`declare_rank_dead` (fed by
+  ``mesh.HealthBoard`` lease expiry or a chaos ``die@<rank>``) records the
+  rank, bumps the **mesh epoch** (``tdt_mesh_epoch``), and OPENs the
+  'collectives' breaker, after which every fused collective launched via
+  ``dist_pallas_call`` fails fast with :class:`DeadPeerError` at trace time
+  — no per-collective bounded-wait timeout storm. The epoch is stamped into
+  word [4] of the status-buffer protocol (``shmem.kernel.init_status``) so
+  an executable traced before a reconfiguration aborts deterministically
+  with ``stale_epoch`` instead of touching a reassigned peer.
 * **CollectiveWatchdog** — host-side wall-time bound on collective dispatch
   with retry/backoff (``TDT_COLL_TIMEOUT_MS``, ``TDT_COLL_RETRIES``); on
   final timeout it marks the feature degraded and either runs the caller's
@@ -97,6 +109,8 @@ _PHASES: list[str] = [
     "fanin_recv",
     "a2a_recv",
     "injected_corrupt",
+    "dead_peer",
+    "stale_epoch",
 ]
 
 
@@ -139,6 +153,109 @@ class CollectiveAbortError(RuntimeError):
 
 class CollectiveTimeoutError(RuntimeError):
     """The host-side CollectiveWatchdog exhausted its attempts."""
+
+
+class DeadPeerError(CollectiveAbortError):
+    """A collective was refused (or aborted) because a participating rank is
+    on the dead-rank registry. Subclasses :class:`CollectiveAbortError` so
+    every existing recovery path (serving ``_guarded``, probe verdicts)
+    treats rank death as a recoverable collective failure."""
+
+
+class StaleEpochError(CollectiveAbortError):
+    """A kernel's status buffer carried a mesh epoch older than the live
+    one: the executable was traced before a reconfiguration and its peer
+    assignments can no longer be trusted. Deterministic fencing — the abort
+    fires on the epoch comparison alone, never on payload corruption."""
+
+
+# ----------------------------------------------- mesh epoch + dead ranks
+
+# The mesh epoch is owned here (not in runtime.mesh) so shmem/kernels/serving
+# can consult it without importing the mesh layer: mesh imports resilience,
+# never the reverse. It bumps on every membership reconfiguration (death OR
+# revival) — an epoch identifies one stable membership view, so any cached
+# executable stamped with an older value must be fenced out.
+_MESH_EPOCH = 0
+_DEAD_RANKS: dict[int, str] = {}
+
+
+def mesh_epoch() -> int:
+    """Current mesh epoch (monotonic within the process; 0 = initial)."""
+    with _LOCK:
+        return _MESH_EPOCH
+
+
+def _bump_epoch_locked(why: str) -> int:
+    global _MESH_EPOCH
+    _MESH_EPOCH += 1
+    telemetry.set_gauge("tdt_mesh_epoch", float(_MESH_EPOCH))
+    telemetry.emit("mesh_epoch", epoch=_MESH_EPOCH, why=why)
+    return _MESH_EPOCH
+
+
+def declare_rank_dead(rank: int, reason: str = "declared dead") -> int:
+    """Record ``rank`` as dead, bump the mesh epoch, and OPEN the
+    'collectives' breaker so fused routing drains immediately. Idempotent:
+    re-declaring an already-dead rank returns the current epoch unchanged.
+    Returns the (possibly new) mesh epoch."""
+    with _LOCK:
+        if rank in _DEAD_RANKS:
+            return _MESH_EPOCH
+        _DEAD_RANKS[rank] = reason
+        epoch = _bump_epoch_locked(f"rank {rank} dead: {reason}")
+    telemetry.inc("tdt_health_deaths_total", rank=rank)
+    telemetry.set_gauge("tdt_health_rank_alive", 0.0, rank=rank)
+    telemetry.emit("rank_dead", rank=rank, reason=reason, epoch=epoch)
+    _log(f"[resilience] rank {rank} declared dead (epoch {epoch}): {reason}")
+    # Fail fast from now on: one breaker OPEN, not one timeout per collective.
+    mark_degraded("collectives", f"dead_peer: rank {rank} ({reason})")
+    return epoch
+
+
+def declare_rank_revived(rank: int) -> int:
+    """Remove ``rank`` from the dead set and bump the mesh epoch. Does NOT
+    close any breaker — the half-open probe machinery must prove the fused
+    path healthy at the new epoch before traffic returns. Idempotent."""
+    with _LOCK:
+        if rank not in _DEAD_RANKS:
+            return _MESH_EPOCH
+        del _DEAD_RANKS[rank]
+        epoch = _bump_epoch_locked(f"rank {rank} revived")
+    telemetry.inc("tdt_health_revivals_total", rank=rank)
+    telemetry.set_gauge("tdt_health_rank_alive", 1.0, rank=rank)
+    telemetry.emit("rank_revived", rank=rank, epoch=epoch)
+    _log(f"[resilience] rank {rank} revived (epoch {epoch})")
+    return epoch
+
+
+def dead_ranks() -> dict[int, str]:
+    """Live view of the dead-rank registry: {rank: reason}."""
+    with _LOCK:
+        return dict(_DEAD_RANKS)
+
+
+def check_dead_peers(*, feature: str = "collectives", kernel: str = "") -> None:
+    """Fail fast with :class:`DeadPeerError` when any rank is on the dead
+    registry. Called by ``dist_pallas_call`` before every collective launch
+    (trace time — the error surfaces before a single device poll is spent)
+    and by host paths that would otherwise discover the death one bounded
+    wait at a time. Deliberately NOT probe-exempt: a half-open probe while
+    the rank is still dead must fail, and succeed only after revival."""
+    with _LOCK:
+        if not _DEAD_RANKS:
+            return
+        dead = dict(_DEAD_RANKS)
+        epoch = _MESH_EPOCH
+    telemetry.inc(
+        "tdt_resilience_dead_peer_failfast_total",
+        feature=feature, kernel=kernel or "host",
+    )
+    ranks = ", ".join(f"{r} ({why})" for r, why in sorted(dead.items()))
+    raise DeadPeerError(
+        f"{feature} collective ({kernel or 'host'}) refused at epoch {epoch}: "
+        f"dead_peer — rank(s) {ranks}"
+    )
 
 
 # ------------------------------------------------------------------ fault plans
@@ -239,16 +356,23 @@ def apply_fault_plan(kernel, plan: FaultPlan):
 @dataclasses.dataclass
 class ChaosEvent:
     """One step of a :class:`ChaosSchedule`: fire ``action`` at the
-    ``skip``-th-next :func:`chaos_check` call naming ``site``."""
+    ``skip``-th-next :func:`chaos_check` call naming ``site``. For the
+    rank-targeted actions (``die``/``revive``) ``site`` holds the decimal
+    rank and the event fires at ANY site — rank loss is not tied to a
+    particular serving phase."""
 
     action: str
     site: str
     skip: int = 0
 
+    @property
+    def rank(self) -> int | None:
+        return int(self.site) if self.action in ("die", "revive") else None
+
 
 #: Serving-loop injection sites wired through :func:`chaos_check`.
 CHAOS_SITES = ("prefill", "decode", "recovery", "probe")
-CHAOS_ACTIONS = ("abort",)
+CHAOS_ACTIONS = ("abort", "die", "revive")
 
 
 class ChaosSchedule:
@@ -267,6 +391,13 @@ class ChaosSchedule:
     reads "let one decode chunk through, abort the second, then fail the
     first half-open probe, then heal" — the double-fault probe arc the
     single-shot FaultPlan cannot express.
+
+    Rank-loss steps use the same shape with a RANK in the site position:
+    ``die@<rank>[:skip]`` declares the rank dead (epoch bump + fail-fast
+    ``dead_peer``) at the skip-th-next check of ANY site; ``revive@<rank>``
+    returns it at a later check without raising. ``die@1:1,revive@1,heal``
+    scripts "kill rank 1 at the second serving-loop step, revive it at the
+    next one" — the full death → degrade → rebuild → probe → restore arc.
     """
 
     def __init__(self, spec: str):
@@ -290,6 +421,11 @@ class ChaosSchedule:
                 raise ValueError(f"bad chaos step {tok!r} in {spec!r}: empty site")
             if skip and not skip.isdigit():
                 raise ValueError(f"bad chaos skip in {tok!r}: want an integer")
+            if action in ("die", "revive") and not site.isdigit():
+                raise ValueError(
+                    f"bad chaos step {tok!r} in {spec!r}: "
+                    f"'{action}' targets a rank, want {action}@<rank>[:skip]"
+                )
             self.events.append(
                 ChaosEvent(action=action, site=site, skip=int(skip or 0))
             )
@@ -300,11 +436,14 @@ class ChaosSchedule:
             return not self.events
 
     def take(self, site: str) -> ChaosEvent | None:
-        """Consume-and-return the head event if this check fires it."""
+        """Consume-and-return the head event if this check fires it. Rank
+        events (``die``/``revive``) match any site; ``abort`` only its own."""
         with self._lock:
-            if not self.events or self.events[0].site != site:
+            if not self.events:
                 return None
             head = self.events[0]
+            if head.rank is None and head.site != site:
+                return None
             if head.skip > 0:
                 head.skip -= 1
                 return None
@@ -368,6 +507,26 @@ def chaos_check(site: str) -> None:
     if ev.action == "abort":
         mark_degraded("collectives", reason)
         raise CollectiveAbortError(reason)
+    if ev.action == "die":
+        # Route through the same transition real lease expiry takes (board
+        # when present, registry otherwise), then surface the loss at this
+        # call site exactly as a fused launch would.
+        from triton_dist_tpu.runtime import mesh
+
+        board = mesh.health_board()
+        if board is not None:
+            board.declare_dead(ev.rank, reason="chaos die")
+        else:
+            declare_rank_dead(ev.rank, reason="chaos die")
+        check_dead_peers(kernel=f"chaos@{site}")
+    if ev.action == "revive":
+        from triton_dist_tpu.runtime import mesh
+
+        board = mesh.health_board()
+        if board is not None:
+            board.revive(ev.rank)
+        else:
+            declare_rank_revived(ev.rank)
 
 
 # ------------------------------------------------------ degradation registry
@@ -598,11 +757,15 @@ def end_probe(features, ok: bool) -> None:
 
 
 def reset_degradation() -> None:
-    """Clear all breakers and recorded aborts (tests / operator reset)."""
+    """Clear all breakers, recorded aborts, the dead-rank registry, and the
+    mesh epoch (tests / operator full reset)."""
+    global _MESH_EPOCH
     with _LOCK:
         _BREAKERS.clear()
         _ABORTS.clear()
         _NOTED.clear()
+        _DEAD_RANKS.clear()
+        _MESH_EPOCH = 0
 
 
 def aborts() -> list[AbortInfo]:
@@ -638,10 +801,24 @@ def _log(msg: str, level: str = "warn") -> None:
 # ----------------------------------------------------------- abort surfacing
 
 
+def _stamped_epoch(w) -> int | None:
+    """Mesh epoch stamped into a status buffer, or None for the 4-word
+    pre-epoch layout (older callers construct those directly)."""
+    return int(w[4]) if w.size > 4 else None
+
+
 def describe_status(words) -> str | None:
     """Human-readable abort description for one rank's status words, or
-    None when the status is OK. Unit-testable host-side."""
+    None when the status is OK. Unit-testable host-side. A stamped mesh
+    epoch older than the live one is itself an abort — the executable
+    predates a membership reconfiguration — even when the code word is OK."""
     w = np.asarray(words).reshape(-1)
+    stamped = _stamped_epoch(w)
+    if stamped is not None and stamped != mesh_epoch():
+        return (
+            f"fenced at stale mesh epoch {stamped} (live epoch "
+            f"{mesh_epoch()}): executable predates a reconfiguration"
+        )
     if int(w[0]) != STATUS_ABORT:
         return None
     phase = phase_name(int(w[1]))
@@ -656,11 +833,35 @@ def describe_status(words) -> str | None:
 def record_status(words, *, feature: str, kernel: str) -> None:
     """Host callback body: record an abort (degradation + AbortInfo) and
     raise CollectiveAbortError naming the stalled phase and peer rank.
-    No-op on an OK status."""
+    No-op on an OK status. A stale stamped epoch raises
+    :class:`StaleEpochError` deterministically, before the code word is
+    even consulted."""
+    w = np.asarray(words).reshape(-1)
+    stamped = _stamped_epoch(w)
+    if stamped is not None and stamped != mesh_epoch():
+        reason = (
+            f"{feature} collective ({kernel}) fenced: status stamped at "
+            f"mesh epoch {stamped}, live epoch is {mesh_epoch()}"
+        )
+        info = AbortInfo(
+            feature=feature, kernel=kernel, phase="stale_epoch",
+            peer=-1, polls=0, reason=reason,
+        )
+        with _LOCK:
+            _ABORTS.append(info)
+        telemetry.inc(
+            "tdt_resilience_stale_epoch_total", feature=feature, kernel=kernel
+        )
+        telemetry.emit(
+            "stale_epoch_abort",
+            feature=feature, kernel=kernel,
+            stamped=stamped, live=mesh_epoch(),
+        )
+        mark_degraded(feature, reason)
+        raise StaleEpochError(reason)
     desc = describe_status(words)
     if desc is None:
         return
-    w = np.asarray(words).reshape(-1)
     reason = f"{feature} collective ({kernel}) {desc}"
     info = AbortInfo(
         feature=feature,

@@ -4,6 +4,7 @@ See ``docs/serving.md`` for the state machines, the admission contract,
 and the ``tdt_serving_*`` metrics reference.
 """
 
+from triton_dist_tpu.serving.journal import ReplayedRequest, RequestJournal
 from triton_dist_tpu.serving.scheduler import (
     Request,
     RequestState,
@@ -15,7 +16,9 @@ from triton_dist_tpu.serving.server import InferenceServer
 
 __all__ = [
     "InferenceServer",
+    "ReplayedRequest",
     "Request",
+    "RequestJournal",
     "RequestState",
     "Scheduler",
     "Slot",

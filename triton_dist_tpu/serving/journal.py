@@ -1,0 +1,270 @@
+"""Write-ahead request journal: crash-resumable serving state.
+
+All serving state — queue, slots, token history — lives in process memory,
+so a server crash loses every in-flight request. The journal makes the
+request lifecycle durable with an append-only JSONL file the server writes
+as it goes and ``InferenceServer.recover`` replays on startup:
+
+``submit``   request admitted: id, prompt, max_new, priority, deadlines
+``prefill``  first sampled token streamed (position 0)
+``chunk``    a decode chunk's streamed tokens, with their start position
+``cancel``   client cancel observed
+``finish``   terminal: reason + final token count (always fsynced)
+
+Replay (:meth:`RequestJournal.replay`) is a pure fold over the records into
+per-request end states. Token records carry their absolute ``start``
+position, so applying a record that is already reflected in the state is a
+no-op — replaying a journal twice (or a journal that was rotated mid-write)
+yields the same state as replaying it once, which is what makes recovery
+idempotent and the crash-at-any-record-boundary sweep in
+``tests/test_journal.py`` a property rather than a hope.
+
+Durability contract: records are buffered and fsynced every
+``TDT_JOURNAL_FSYNC`` appends (``finish`` records always force the fsync —
+a completed stream must never replay). A torn final line from a crash
+mid-append is detected and dropped by :meth:`read`. ``rotate()`` compacts
+away terminal requests via write-temp + fsync + ``os.replace`` so a crash
+mid-rotation leaves either the old or the new file, never a mix.
+
+The token-level guarantee on recovery is the same zero-drop/zero-dup
+mechanism as degraded-mode recovery: an in-flight request re-prefills from
+``prompt + journaled tokens`` and greedy sampling regenerates any token
+that was streamed but not yet durable, byte-identically (see
+``docs/serving.md``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+
+from triton_dist_tpu.runtime import telemetry
+from triton_dist_tpu.runtime.utils import get_int_env, tdt_log
+
+#: Appends between fsyncs (``TDT_JOURNAL_FSYNC`` overrides; 1 = every record).
+DEFAULT_FSYNC_EVERY = 8
+
+#: Record kinds, in the only order they can legally appear per request.
+RECORD_KINDS = ("submit", "prefill", "chunk", "cancel", "finish")
+
+
+@dataclasses.dataclass
+class ReplayedRequest:
+    """Fold state for one request after replaying its records."""
+
+    req_id: int
+    prompt: list[int]
+    max_new: int
+    arrival_time_s: float | None = None
+    priority: int = 0
+    ttft_deadline_s: float | None = None
+    deadline_s: float | None = None
+    tokens: list[int] = dataclasses.field(default_factory=list)
+    done: bool = False
+    finish_reason: str = ""
+    cancelled: bool = False
+
+    @property
+    def terminal(self) -> bool:
+        return self.done or self.cancelled
+
+
+class RequestJournal:
+    """Append-only JSONL write-ahead journal for the serving loop.
+
+    One journal maps to one server process; pass a path (or set
+    ``TDT_JOURNAL_DIR`` and let the server derive one). Thread-safe: the
+    serving loop and client ``submit``/``cancel`` threads may interleave.
+    """
+
+    def __init__(self, path: str | os.PathLike, fsync_every: int | None = None):
+        self.path = os.fspath(path)
+        self.fsync_every = (
+            get_int_env("TDT_JOURNAL_FSYNC", DEFAULT_FSYNC_EVERY)
+            if fsync_every is None
+            else int(fsync_every)
+        )
+        parent = os.path.dirname(self.path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        self._lock = threading.Lock()
+        self._f = open(self.path, "a", encoding="utf-8")
+        self._since_fsync = 0
+        self._closed = False
+
+    # ------------------------------------------------------------- appending
+
+    def append(self, kind: str, **fields) -> None:
+        """Durably-intended append of one record. ``finish`` always forces
+        the fsync; other kinds batch up to ``fsync_every``."""
+        if kind not in RECORD_KINDS:
+            raise ValueError(f"unknown journal record kind {kind!r}")
+        line = json.dumps({"kind": kind, **fields}, separators=(",", ":"))
+        with self._lock:
+            if self._closed:
+                return
+            self._f.write(line + "\n")
+            self._since_fsync += 1
+            force = kind == "finish" or (
+                self.fsync_every > 0 and self._since_fsync >= self.fsync_every
+            )
+            if force:
+                self._fsync_locked()
+        telemetry.inc("tdt_serving_journal_records_total", kind=kind)
+        telemetry.set_gauge(
+            "tdt_serving_journal_lag_records", float(self._since_fsync)
+        )
+
+    def _fsync_locked(self) -> None:
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        self._since_fsync = 0
+        telemetry.inc("tdt_serving_journal_fsyncs_total")
+
+    def flush(self) -> None:
+        """Force buffered records to disk."""
+        with self._lock:
+            if not self._closed:
+                self._fsync_locked()
+        telemetry.set_gauge("tdt_serving_journal_lag_records", 0.0)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._fsync_locked()
+            self._f.close()
+            self._closed = True
+
+    @property
+    def lag_records(self) -> int:
+        """Appended records not yet fsynced (the journal-lag signal)."""
+        with self._lock:
+            return self._since_fsync
+
+    def stats(self) -> dict:
+        """JSON-safe view for the ``/requests`` introspection route."""
+        with self._lock:
+            return {
+                "path": self.path,
+                "fsync_every": self.fsync_every,
+                "lag_records": self._since_fsync,
+                "closed": self._closed,
+            }
+
+    # -------------------------------------------------------------- rotation
+
+    def rotate(self) -> int:
+        """Atomically compact the journal: drop every record of a terminal
+        (finished/cancelled) request, keep live requests' records verbatim.
+        Returns the number of records dropped. Crash-safe via write-temp +
+        fsync + ``os.replace``."""
+        with self._lock:
+            if self._closed:
+                return 0
+            self._fsync_locked()
+            records = self.read(self.path)
+            state = self.replay(records)
+            live = {rid for rid, rr in state.items() if not rr.terminal}
+            kept = [r for r in records if r.get("req_id") in live]
+            dropped = len(records) - len(kept)
+            tmp = self.path + ".rotate"
+            with open(tmp, "w", encoding="utf-8") as out:
+                for rec in kept:
+                    out.write(json.dumps(rec, separators=(",", ":")) + "\n")
+                out.flush()
+                os.fsync(out.fileno())
+            self._f.close()
+            os.replace(tmp, self.path)
+            self._f = open(self.path, "a", encoding="utf-8")
+            self._since_fsync = 0
+        telemetry.inc("tdt_serving_journal_rotations_total")
+        telemetry.emit(
+            "journal_rotate", path=self.path, kept=len(kept), dropped=dropped
+        )
+        return dropped
+
+    # --------------------------------------------------------------- reading
+
+    @staticmethod
+    def read(path: str | os.PathLike) -> list[dict]:
+        """Load records, dropping a torn/corrupt tail. A crash mid-append
+        can only tear the FINAL line (appends are sequential); a bad line
+        followed by good ones means external corruption, which is logged
+        and skipped line-by-line rather than aborting recovery."""
+        records: list[dict] = []
+        if not os.path.exists(path):
+            return records
+        with open(path, "r", encoding="utf-8") as f:
+            for lineno, line in enumerate(f, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    tdt_log(
+                        f"[journal] dropping torn/corrupt record at "
+                        f"{path}:{lineno}",
+                        level="warn",
+                    )
+                    continue
+                if isinstance(rec, dict) and rec.get("kind") in RECORD_KINDS:
+                    records.append(rec)
+        return records
+
+    def read_records(self) -> list[dict]:
+        """Flush, then read this journal's own records."""
+        self.flush()
+        return self.read(self.path)
+
+    # ---------------------------------------------------------------- replay
+
+    @staticmethod
+    def replay(records: list[dict]) -> dict[int, ReplayedRequest]:
+        """Pure fold of records into per-request end states, keyed by
+        req_id. Idempotent under re-application: token records are applied
+        by absolute position (``start``), so positions already present are
+        skipped and ``replay(r + r) == replay(r)``."""
+        state: dict[int, ReplayedRequest] = {}
+        for rec in records:
+            rid = rec.get("req_id")
+            kind = rec["kind"]
+            if kind == "submit":
+                if rid in state:
+                    continue
+                state[rid] = ReplayedRequest(
+                    req_id=rid,
+                    prompt=list(rec.get("prompt", [])),
+                    max_new=int(rec.get("max_new", 0)),
+                    arrival_time_s=rec.get("arrival_time_s"),
+                    priority=int(rec.get("priority", 0)),
+                    ttft_deadline_s=rec.get("ttft_deadline_s"),
+                    deadline_s=rec.get("deadline_s"),
+                )
+                continue
+            rr = state.get(rid)
+            if rr is None:
+                # Tokens/finish for a request whose submit was rotated away
+                # or torn: nothing to resume — skip.
+                continue
+            if kind in ("prefill", "chunk"):
+                start = int(rec.get("start", 0))
+                toks = rec.get("tokens", [])
+                if start > len(rr.tokens):
+                    # A gap means records were lost between start and here;
+                    # resuming past it would fabricate tokens. Treat the
+                    # known prefix as the durable truth.
+                    continue
+                for i, t in enumerate(toks):
+                    pos = start + i
+                    if pos == len(rr.tokens):
+                        rr.tokens.append(int(t))
+            elif kind == "cancel":
+                rr.cancelled = True
+            elif kind == "finish":
+                rr.done = True
+                rr.finish_reason = rec.get("reason", "ok")
+        return state

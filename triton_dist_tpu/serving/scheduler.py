@@ -59,7 +59,6 @@ from __future__ import annotations
 import collections
 import dataclasses
 import enum
-import itertools
 import threading
 import time
 from typing import Callable
@@ -73,7 +72,7 @@ EWMA_ALPHA = 0.3
 
 
 def _env_deadline(name: str) -> float | None:
-    v = get_float_env(name, 0.0)
+    v = get_float_env(name, 0.0)  # env-knob-ok: forwards documented TDT_DEADLINE_* literals
     return v if v > 0 else None
 
 
@@ -199,10 +198,19 @@ class Scheduler:
         self.shed_health_s = get_float_env("TDT_SHED_HEALTH_S", 5.0)
         self.slots = [Slot(idx=i) for i in range(num_slots)]
         self._pending: collections.deque[Request] = collections.deque()
-        self._ids = itertools.count()
+        self._next_id = 0
         self._lock = threading.Lock()
         self._ewma_tps = 0.0
         self._last_shed_now_s: float | None = None
+        #: Set by ``InferenceServer.shutdown``: every subsequent submit is
+        #: rejected with reason "shutting_down" while admitted work drains.
+        self.shutting_down = False
+
+    def _new_id(self) -> int:
+        with self._lock:
+            rid = self._next_id
+            self._next_id += 1
+            return rid
 
     # ------------------------------------------------------------- admission
     def submit(self, prompt, max_new: int, arrival_time_s: float = 0.0,
@@ -216,7 +224,7 @@ class Scheduler:
         when not given (unset/non-positive env = no bound)."""
         prompt = [int(t) for t in prompt]
         req = Request(
-            req_id=next(self._ids), prompt=prompt, max_new=int(max_new),
+            req_id=self._new_id(), prompt=prompt, max_new=int(max_new),
             arrival_time_s=float(arrival_time_s),
             on_token=on_token, on_finish=on_finish,
             priority=int(priority),
@@ -236,6 +244,10 @@ class Scheduler:
             prompt_len=len(prompt), max_new=req.max_new,
         )
         telemetry.inc("tdt_serving_requests_total")
+        if self.shutting_down:
+            # Graceful shutdown: admitted work drains, new joins bounce with
+            # a distinct reason so clients can retry against another server.
+            return self._reject(req, "shutting_down")
         if not prompt or req.max_new < 1:
             return self._reject(req, "empty")
         if len(prompt) + req.max_new > self.max_len:
@@ -261,6 +273,21 @@ class Scheduler:
         with self._lock:
             if self.queue_limit and len(self._pending) >= self.queue_limit:
                 return self._reject(req, "queue_full")
+            self._pending.append(req)
+            depth = len(self._pending)
+        telemetry.set_gauge("tdt_serving_queue_depth", float(depth))
+        return req
+
+    def restore(self, req: Request) -> Request:
+        """Re-admit a journal-recovered request (``InferenceServer.recover``).
+
+        Bypasses admission — the request was admitted before the crash —
+        and preserves its original ``req_id``, advancing the id counter
+        past it so post-recovery submissions never collide. Call in
+        ``req_id`` order to preserve the original FCFS order."""
+        req.state = RequestState.QUEUED
+        with self._lock:
+            self._next_id = max(self._next_id, req.req_id + 1)
             self._pending.append(req)
             depth = len(self._pending)
         telemetry.set_gauge("tdt_serving_queue_depth", float(depth))
@@ -483,6 +510,22 @@ class Scheduler:
             if not self._pending:
                 return None
             return min(r.arrival_time_s for r in self._pending)
+
+    def queued_summary(self, now_s: float, limit: int = 32) -> list[dict]:
+        """JSON-safe head of the pending queue (the `/requests` payload)."""
+        with self._lock:
+            head = list(self._pending)[:limit]
+        return [
+            {
+                "req_id": r.req_id,
+                "waited_s": round(
+                    max(now_s - max(r.submitted_at, r.arrival_time_s), 0.0), 3
+                ),
+                "n_tokens": len(r.tokens),
+                "priority": r.priority,
+            }
+            for r in head
+        ]
 
     def _occupancy_gauge(self) -> None:
         telemetry.set_gauge("tdt_serving_slot_occupancy", float(self.occupancy()))

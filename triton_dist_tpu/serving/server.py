@@ -51,6 +51,23 @@ overload shedding before admission, and :meth:`cancel` — the server's half
 is :meth:`_reap_slots`, which frees cancelled and total-deadline-expired
 slots at each chunk boundary with distinct finish reasons.
 
+**Crash recovery via the write-ahead journal** (``serving/journal.py``):
+with a journal attached (``journal=`` or ``TDT_JOURNAL_DIR``) the server
+journals every request lifecycle transition; after a process crash a fresh
+server pointed at the same journal calls :meth:`recover` — queued requests
+are re-admitted, in-flight requests re-prefill from ``prompt + journaled
+tokens`` (the recovery branch of :meth:`_prefill_slot`), and completed
+requests are skipped idempotently. **Rank death** (heartbeat lease expiry
+on the ``mesh.HealthBoard``, or a scripted chaos ``die@<rank>``) is
+discovered by the per-step health sweep or by the trace-time ``dead_peer``
+fail-fast; either way survivors rebuild once on xla at the new mesh epoch —
+no per-collective timeout storm — and resume every stream from history.
+
+**Graceful shutdown**: :meth:`shutdown` (or SIGTERM via
+:meth:`install_signal_handlers`, or Ctrl-C inside :meth:`run`) rejects new
+joins with reason ``shutting_down``, drains (or journals) running slots,
+flushes the journal + dumps telemetry, and stops the introspect endpoint.
+
 Env knobs::
 
     TDT_SERVE_SLOTS       fixed slot-batch size B (default 4)
@@ -61,6 +78,9 @@ Env knobs::
     TDT_SHED_PRIORITY     min priority class eligible for shedding (def. 1)
     TDT_SHED_HEALTH_S     /healthz not-ready window after a shed (def. 5)
     TDT_DEGRADE_PROBE_S   breaker probe backoff base, s (def. 30; <=0 off)
+    TDT_JOURNAL_DIR       directory for the write-ahead journal (unset = off)
+    TDT_JOURNAL_FSYNC     journal appends between fsyncs (default 8)
+    TDT_DRAIN_TIMEOUT_S   shutdown drain budget, s (0 = unbounded)
 
 Metrics (``tdt_serving_*``, see ``docs/serving.md`` and
 ``docs/observability.md``): request/completion/reject/preemption/recovery
@@ -70,6 +90,7 @@ histograms.
 
 from __future__ import annotations
 
+import os
 import time
 
 import jax
@@ -77,7 +98,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from triton_dist_tpu.runtime import resilience, telemetry, tracing
-from triton_dist_tpu.runtime.utils import get_int_env
+from triton_dist_tpu.runtime.utils import get_float_env, get_int_env
 from triton_dist_tpu.serving.scheduler import (
     Request,
     RequestState,
@@ -98,7 +119,8 @@ class InferenceServer:
                  chunk: int | None = None, queue_limit: int = 0,
                  key: jax.Array | None = None, watchdog=None,
                  shed_wait_s: float | None = None,
-                 shed_priority: int | None = None):
+                 shed_priority: int | None = None,
+                 journal=None):
         self.engine = engine
         self.num_slots = (
             get_int_env("TDT_SERVE_SLOTS", 4) if num_slots is None else int(num_slots)
@@ -139,20 +161,78 @@ class InferenceServer:
         # The health provider makes /healthz reflect shed pressure and the
         # degraded/preferred backend split regardless of who started the
         # endpoint.
+        # Write-ahead journal: explicit handle/path wins, else TDT_JOURNAL_DIR
+        # opts in. No journal = the pre-crash-recovery behavior, zero cost.
+        if journal is None:
+            jdir = os.environ.get("TDT_JOURNAL_DIR", "").strip()
+            if jdir:
+                journal = os.path.join(jdir, "journal.jsonl")
+        if isinstance(journal, (str, os.PathLike)):
+            from triton_dist_tpu.serving.journal import RequestJournal
+
+            journal = RequestJournal(journal)
+        self._journal = journal
+        #: req_ids already replayed by :meth:`recover` (idempotence guard).
+        self._recovered_ids: set[int] = set()
+        self._shutdown = False
+        #: Set by the SIGTERM handler; :meth:`run` converts it into a drain.
+        self._shutdown_requested = False
         from triton_dist_tpu.runtime import introspect
 
         self._introspect = introspect.maybe_start()
         introspect.set_health_provider(self._health_info)
+        introspect.set_requests_provider(self._requests_info)
 
     def _health_info(self) -> dict:
         shedding = self.scheduler.shedding(self._now())
         return {
-            "ready": not shedding,
+            "ready": not (shedding or self._shutdown),
             "shedding": shedding,
+            "shutting_down": self._shutdown,
             "backend": self.engine.backend,
             "preferred_backend": self._preferred_backend,
             "queue_depth": self.scheduler.queue_depth(),
             "slot_occupancy": self.scheduler.occupancy(),
+            "mesh_epoch": resilience.mesh_epoch(),
+        }
+
+    def _requests_info(self) -> dict:
+        """The `/requests` introspection payload: queue depth, per-slot
+        state-machine position, remaining deadline budgets, journal lag."""
+        now = self._now()
+        slots = []
+        for slot in self.scheduler.slots:
+            entry: dict = {"idx": slot.idx, "state": slot.state.value}
+            req = slot.request
+            if req is not None:
+                entry.update(
+                    req_id=req.req_id,
+                    request_state=req.state.value,
+                    prompt_len=len(req.prompt),
+                    n_tokens=len(req.tokens),
+                    max_new=req.max_new,
+                    remaining=int(self._remaining[slot.idx]),
+                    deadline_remaining_s=(
+                        round(req.deadline_s - (now - req.arrived_at), 3)
+                        if req.deadline_s is not None else None
+                    ),
+                    ttft_deadline_remaining_s=(
+                        round(req.ttft_deadline_s - (now - req.arrived_at), 3)
+                        if req.ttft_deadline_s is not None
+                        and req.first_token_at is None else None
+                    ),
+                )
+            slots.append(entry)
+        return {
+            "mesh_epoch": resilience.mesh_epoch(),
+            "backend": self.engine.backend,
+            "shutting_down": self._shutdown,
+            "queue_depth": self.scheduler.queue_depth(),
+            "queued": self.scheduler.queued_summary(now),
+            "slots": slots,
+            "journal": (
+                self._journal.stats() if self._journal is not None else None
+            ),
         }
 
     # ------------------------------------------------------------------ clock
@@ -166,18 +246,31 @@ class InferenceServer:
                ttft_deadline_s: float | None = None,
                deadline_s: float | None = None) -> Request:
         """Admission-check and enqueue one request; returns its handle
-        (``state=REJECTED`` + ``reject_reason`` when not admitted)."""
-        return self.scheduler.submit(
+        (``state=REJECTED`` + ``reject_reason`` when not admitted). Admitted
+        requests are journaled (write-ahead) when a journal is attached."""
+        req = self.scheduler.submit(
             prompt, max_new, arrival_time_s=arrival_time_s,
             on_token=on_token, on_finish=on_finish, now_s=self._now(),
             priority=priority, ttft_deadline_s=ttft_deadline_s,
             deadline_s=deadline_s,
         )
+        if self._journal is not None and req.state is RequestState.QUEUED:
+            # Rejections are never journaled: there is nothing to resume.
+            self._journal.append(
+                "submit", req_id=req.req_id, prompt=req.prompt,
+                max_new=req.max_new, arrival_time_s=req.arrival_time_s,
+                priority=req.priority, ttft_deadline_s=req.ttft_deadline_s,
+                deadline_s=req.deadline_s,
+            )
+        return req
 
     def cancel(self, req_id: int) -> bool:
         """Client cancellation: a queued request finalizes immediately; a
         running one frees its slot at the next chunk boundary."""
-        return self.scheduler.cancel(int(req_id))
+        ok = self.scheduler.cancel(int(req_id))
+        if ok and self._journal is not None:
+            self._journal.append("cancel", req_id=int(req_id))
+        return ok
 
     # ------------------------------------------------------------------- loop
     def step(self) -> bool:
@@ -185,8 +278,11 @@ class InferenceServer:
         the preferred backend on success), join arrived requests into free
         slots (prefill + first token), reap cancelled/expired slots, then
         one masked decode chunk over the slot batch. Returns True when any
-        work was done."""
-        worked = self._maybe_probe()
+        work was done. A health sweep runs first: an expired heartbeat
+        lease (or a chaos ``die@<rank>``) triggers ONE proactive rebuild at
+        the new epoch instead of a timeout per collective."""
+        worked = self._health_sweep()
+        worked = self._maybe_probe() or worked
         worked = self._join_ready() or worked
         self._reap_slots()
         if not self.scheduler.decoding_slots():
@@ -198,18 +294,27 @@ class InferenceServer:
         """Serve until the queue is drained and every slot is free.
         Requests submitted from other threads while running are picked up;
         with synthetic ``arrival_time_s`` offsets the loop sleeps (bounded
-        by ``poll_s``) until the next arrival is due."""
-        while True:
-            if self.step():
-                continue
-            nxt = self.scheduler.next_arrival_s()
-            if nxt is None:
-                if self.scheduler.queue_depth() == 0 and not self.scheduler.occupancy():
+        by ``poll_s``) until the next arrival is due. A pending SIGTERM
+        (see :meth:`install_signal_handlers`) converts into a draining
+        :meth:`shutdown`; Ctrl-C shuts down WITHOUT draining — the journal
+        holds the in-flight state for :meth:`recover`."""
+        try:
+            while True:
+                if self._shutdown_requested and not self._shutdown:
+                    self.shutdown(drain=True)
                     return
-                continue
-            wait = nxt - self._now()
-            if wait > 0:
-                time.sleep(min(wait, poll_s))
+                if self.step():
+                    continue
+                nxt = self.scheduler.next_arrival_s()
+                if nxt is None:
+                    if self.scheduler.queue_depth() == 0 and not self.scheduler.occupancy():
+                        return
+                    continue
+                wait = nxt - self._now()
+                if wait > 0:
+                    time.sleep(min(wait, poll_s))
+        except KeyboardInterrupt:
+            self.shutdown(drain=False)
 
     # ------------------------------------------------------------------ joins
     def _join_ready(self) -> bool:
@@ -217,8 +322,11 @@ class InferenceServer:
         for slot in joined:
             # A recovery triggered by an EARLIER slot's failed prefill
             # already re-prefilled every occupied slot, this one included
-            # (or finished+released it) — do not stream its first token twice.
-            if slot.request is None or slot.request.tokens:
+            # (or finished+released it) — do not stream its first token
+            # twice. State is the discriminator, not token history: a
+            # journal-recovered request joins WITH tokens but still in
+            # PREFILL, and must re-prefill from them.
+            if slot.request is None or slot.state is not SlotState.PREFILL:
                 continue
             self._guarded(lambda s=slot: self._prefill_slot(s),
                           what=f"join of request {slot.request.req_id}")
@@ -250,14 +358,26 @@ class InferenceServer:
             )
         if req.tokens:
             self._last[slot.idx] = req.tokens[-1]
+            # Host decode state must derive from the durable history, not
+            # from retained process memory: a journal-recovered request
+            # arrives in a FRESH process where _remaining is all zeros.
+            self._remaining[slot.idx] = max(req.max_new - len(req.tokens), 0)
             if slot.state is SlotState.PREFILL:
                 self.scheduler.start_decode(slot)
+            if self._remaining[slot.idx] == 0:
+                # Fully generated before the crash, only the finish record
+                # was lost — finalize now, nothing to decode.
+                self._finish(slot)
             return
         tok = int(token0)
         self._last[slot.idx] = tok
         self._remaining[slot.idx] = req.max_new - 1
         self.scheduler.start_decode(slot)
         self._stream(req, tok)
+        if self._journal is not None:
+            self._journal.append(
+                "prefill", req_id=req.req_id, start=0, tokens=[tok]
+            )
         if self._remaining[slot.idx] == 0:
             self._finish(slot)
 
@@ -298,13 +418,19 @@ class InferenceServer:
                 slot=slot.idx, n_tokens=n_valid, dispatch=dispatch_id,
             )
             s_start = tracing.now_s()
-            for j in range(n_valid):
-                self._stream(req, int(out_np[slot.idx, j]))
+            toks = [int(out_np[slot.idx, j]) for j in range(n_valid)]
+            for t in toks:
+                self._stream(req, t)
             if n_valid:
                 req.trace.record(
                     "tdt_serving_stream", s_start, tracing.now_s(),
                     slot=slot.idx, n_tokens=n_valid,
                 )
+                if self._journal is not None:
+                    self._journal.append(
+                        "chunk", req_id=req.req_id,
+                        start=len(req.tokens) - n_valid, tokens=toks,
+                    )
             self._remaining[slot.idx] -= n_valid
             n_streamed += n_valid
             if self._remaining[slot.idx] == 0:
@@ -349,6 +475,13 @@ class InferenceServer:
         self.scheduler.finish(slot)
         self.scheduler.release(slot)
         self._remaining[slot.idx] = 0
+        if self._journal is not None:
+            # "finish" always forces the fsync: a completed stream must be
+            # durable so recovery can skip it idempotently.
+            self._journal.append(
+                "finish", req_id=req.req_id, reason=reason,
+                n_tokens=len(req.tokens),
+            )
         if req.on_finish is not None:
             try:
                 req.on_finish(req)
@@ -381,6 +514,27 @@ class InferenceServer:
                     now - req.arrived_at - req.deadline_s,
                 )
                 self._finish(slot, reason="deadline")
+
+    # ----------------------------------------------------------- rank health
+    def _health_sweep(self) -> bool:
+        """Per-step liveness check: expire heartbeat leases on the installed
+        ``mesh.HealthBoard`` (if any), and — when ranks are dead while the
+        engine still runs a fused backend — rebuild ONCE at the new epoch.
+        This is the no-timeout-storm property: discovery costs one sweep,
+        not one bounded-wait abort per collective per step."""
+        from triton_dist_tpu.runtime import mesh
+
+        board = mesh.health_board()
+        if board is not None:
+            board.sweep()
+        dead = resilience.dead_ranks()
+        if dead and self.engine.backend != "xla":
+            self._recover(
+                f"dead rank(s) {sorted(dead)} at mesh epoch "
+                f"{resilience.mesh_epoch()}"
+            )
+            return True
+        return False
 
     # --------------------------------------------------------------- recovery
     def _guarded(self, fn, what: str):
@@ -477,6 +631,11 @@ class InferenceServer:
         probe runs on a throwaway 1-slot cache."""
         if self.engine.backend == self._preferred_backend:
             return False
+        if resilience.dead_ranks():
+            # Membership is still short: the fused path cannot be healthy
+            # until the dead rank is revived (epoch bump), so don't burn
+            # the breaker's backoff on a probe that must fail.
+            return False
         due = resilience.probe_due()
         if not due:
             return False
@@ -538,3 +697,159 @@ class InferenceServer:
             "tdt_serving_restore", r_start, r_end,
             to_backend=to_backend, in_flight=len(occupied),
         )
+
+    # --------------------------------------------------------- crash recovery
+    def recover(self, journal=None, *, on_token=None, on_finish=None) -> list:
+        """Replay a write-ahead journal into the queue (call BEFORE
+        :meth:`run`). Terminal requests are skipped idempotently; queued
+        ones re-enter the pending queue; in-flight ones re-enter with their
+        journaled token history pre-seeded, so the join sweep re-prefills
+        them from ``prompt + tokens`` and decoding resumes exactly where
+        the journal left off — journaled tokens are NOT re-streamed to the
+        new callbacks. Deadline budgets restart at recovery time (the
+        original server's clock died with it).
+
+        ``journal`` defaults to this server's own attached journal; a path
+        or :class:`~triton_dist_tpu.serving.journal.RequestJournal` handle
+        replays someone else's. Replaying twice is a no-op (per-process id
+        guard on top of the journal's positional idempotence). Returns the
+        restored request handles in ``req_id`` (original FCFS) order."""
+        from triton_dist_tpu.serving.journal import RequestJournal
+
+        if journal is None:
+            journal = self._journal
+        if journal is None:
+            return []
+        if isinstance(journal, (str, os.PathLike)):
+            records = RequestJournal.read(journal)
+            path = os.fspath(journal)
+        else:
+            records = journal.read_records()
+            path = journal.path
+        state = RequestJournal.replay(records)
+        restored = []
+        now = self._now()
+        t0 = time.monotonic()
+        for rid in sorted(state):
+            rr = state[rid]
+            if rr.terminal:
+                telemetry.inc(
+                    "tdt_serving_journal_replayed_total",
+                    outcome="skipped_terminal",
+                )
+                continue
+            if rid in self._recovered_ids:
+                telemetry.inc(
+                    "tdt_serving_journal_replayed_total",
+                    outcome="skipped_duplicate",
+                )
+                continue
+            if len(rr.prompt) + rr.max_new > self.engine.max_len:
+                # The journal came from a server with a bigger KV row;
+                # resuming here would abort mid-decode. Drop loudly.
+                telemetry.inc(
+                    "tdt_serving_journal_replayed_total",
+                    outcome="dropped_kv_budget",
+                )
+                continue
+            req = Request(
+                req_id=rid, prompt=list(rr.prompt), max_new=rr.max_new,
+                arrival_time_s=0.0, on_token=on_token, on_finish=on_finish,
+                priority=rr.priority,
+                ttft_deadline_s=rr.ttft_deadline_s,
+                deadline_s=rr.deadline_s,
+                tokens=list(rr.tokens),
+            )
+            req.submitted_at = now
+            req.trace = tracing.start_trace(
+                "tdt_serving_request", req_id=rid,
+                prompt_len=len(rr.prompt), max_new=rr.max_new,
+                recovered=True, journaled_tokens=len(rr.tokens),
+            )
+            self.scheduler.restore(req)
+            self._recovered_ids.add(rid)
+            restored.append(req)
+            telemetry.inc(
+                "tdt_serving_journal_replayed_total",
+                outcome="reprefill" if rr.tokens else "requeued",
+            )
+        telemetry.observe(
+            "tdt_serving_journal_replay_seconds", time.monotonic() - t0
+        )
+        telemetry.emit(
+            "serving_journal_replay", path=path, records=len(records),
+            restored=len(restored),
+            terminal=sum(1 for rr in state.values() if rr.terminal),
+        )
+        return restored
+
+    # ------------------------------------------------------ graceful shutdown
+    def shutdown(self, drain: bool = True, timeout_s: float | None = None) -> None:
+        """Stop serving cleanly: reject new joins (``shutting_down``),
+        drain admitted work (or leave it journaled when ``drain=False`` /
+        the ``TDT_DRAIN_TIMEOUT_S`` budget lapses — either way the journal
+        holds everything :meth:`recover` needs), flush+close the journal,
+        dump telemetry (``TDT_TELEMETRY_DUMP``), and stop the introspect
+        endpoint. Idempotent."""
+        if self._shutdown:
+            return
+        self._shutdown = True
+        self.scheduler.shutting_down = True
+        t0 = time.monotonic()
+        if timeout_s is None:
+            timeout_s = get_float_env("TDT_DRAIN_TIMEOUT_S", 0.0)
+        telemetry.emit(
+            "serving_shutdown", drain=drain,
+            in_flight=self.scheduler.occupancy(),
+            queued=self.scheduler.queue_depth(),
+        )
+        if drain:
+            while self.scheduler.occupancy() or self.scheduler.queue_depth():
+                if timeout_s > 0 and time.monotonic() - t0 > timeout_s:
+                    telemetry.emit(
+                        "serving_drain_timeout",
+                        in_flight=self.scheduler.occupancy(),
+                        queued=self.scheduler.queue_depth(),
+                    )
+                    break
+                if not self.step():
+                    time.sleep(0.005)
+        if self._journal is not None:
+            self._journal.flush()
+            self._journal.close()
+        drain_s = time.monotonic() - t0
+        telemetry.observe("tdt_serving_drain_seconds", drain_s)
+        dump_path = os.environ.get("TDT_TELEMETRY_DUMP", "").strip()
+        if dump_path:
+            try:
+                telemetry.dump(dump_path)
+            except Exception:  # shutdown must not die on a bad dump path
+                telemetry.inc("tdt_serving_callback_errors_total", kind="dump")
+        from triton_dist_tpu.runtime import introspect
+
+        introspect.set_health_provider(None)
+        introspect.set_requests_provider(None)
+        if self._introspect is not None:
+            self._introspect.stop()
+            self._introspect = None
+        self._trace.finish(status="shutdown", drained=drain)
+        telemetry.emit(
+            "serving_shutdown_done", drain_s=round(drain_s, 3),
+            in_flight=self.scheduler.occupancy(),
+            queued=self.scheduler.queue_depth(),
+        )
+
+    def install_signal_handlers(self, signums=None) -> None:
+        """Route SIGTERM/SIGINT into a graceful drain: the handler only
+        sets a flag; :meth:`run` notices it at the next loop iteration and
+        calls :meth:`shutdown(drain=True)` from the serving thread (signal
+        handlers must not run device work). Main-thread only."""
+        import signal as _signal
+
+        if signums is None:
+            signums = (_signal.SIGTERM, _signal.SIGINT)
+        for s in signums:
+            _signal.signal(s, self._on_signal)
+
+    def _on_signal(self, signum, frame) -> None:
+        self._shutdown_requested = True

@@ -132,6 +132,12 @@ def dist_pallas_call(
     signalling: it forces ``has_side_effects`` and assigns a collective id.
     """
     if collective:
+        # Dead-peer fail-fast: a launch whose membership includes a dead
+        # rank is refused at TRACE time — one DeadPeerError here instead of
+        # a bounded-wait timeout per collective per step. Raised before any
+        # id is allocated or counter ticked, so a refused launch leaves no
+        # trace-side state behind.
+        resilience.check_dead_peers(kernel=kernel_base_name(kernel))
         # Trace-time launch counter per collective name: one tick per traced
         # launch site (retraces included), the signal that shows WHICH
         # collective kernels a program actually routed into (AUTO flips,
@@ -175,15 +181,17 @@ def dist_pallas_call(
 # threaded — follows it as the final output) holding [0]=code
 # (STATUS_OK/STATUS_ABORT), [1]=phase id (resilience.phase_name), [2]=peer
 # rank along the collective axis (-1 when unattributable, e.g. a barrier),
-# [3]=polls spent. Bounded waits write an abort record instead of spinning
-# forever; the host surfaces it via resilience.consume_status. SMEM outputs
-# start uninitialized — call init_status() first thing in the kernel (once
-# per launch under a grid). Adopters: allgather / allreduce / reduce_scatter
+# [3]=polls spent, [4]=mesh epoch the kernel was traced at (the fence: the
+# host aborts with stale_epoch when it no longer matches the live epoch).
+# Bounded waits write an abort record instead of spinning forever; the host
+# surfaces it via resilience.consume_status. SMEM outputs start
+# uninitialized — call init_status() first thing in the kernel (once per
+# launch under a grid). Adopters: allgather / allreduce / reduce_scatter
 # / gemm_allreduce / ep_a2a (PR 2) + allgather_gemm / gemm_reduce_scatter /
 # ag_attention (prefill overlap v2).
 
 #: Number of int32 words in a collective status buffer.
-STATUS_WORDS = 4
+STATUS_WORDS = 5
 STATUS_OK = resilience.STATUS_OK
 STATUS_ABORT = resilience.STATUS_ABORT
 
@@ -211,6 +219,11 @@ def init_status(status_ref, *, axis: str | Sequence[str] = "tp") -> None:
     status_ref[1] = jnp.int32(-1)
     status_ref[2] = jnp.int32(-1)
     status_ref[3] = jnp.int32(0)
+    # Epoch fence: the LIVE epoch at trace time becomes a compile-time
+    # constant in the executable. A cached executable replayed after a
+    # membership reconfiguration carries the old value, and the host-side
+    # consume_status aborts it deterministically (stale_epoch).
+    status_ref[4] = jnp.int32(resilience.mesh_epoch())
     plan = resilience.active_plan()
     if plan is not None and plan.kind is resilience.FaultKind.CORRUPT_FLAG:
         me = tpl.rank(axis)

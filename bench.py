@@ -830,6 +830,98 @@ def bench_serving(on_tpu):
     return out
 
 
+def bench_serving_paged(on_tpu):
+    """Paged-KV serving benchmark (the block-pool subsystem): 16 requests
+    sharing a long common prompt prefix (512 tokens on TPU, 128 in smoke
+    mode) are served through the paged pool with chunked prefill, so every
+    request after the first borrows the registered prefix chain instead of
+    recomputing it. Gated by check_bench_regression.py:
+    ``serving_paged_tokens_per_s`` (higher better) and the TTFT
+    percentiles (lower better). ``serving_paged_prefix_hit_rate`` is
+    informational but must stay > 0 — zero means the radix index broke —
+    and ``serving_paged_kv_peak_blocks`` must sit strictly below
+    ``serving_paged_slot_baseline_blocks``, the contiguous footprint
+    (``num_slots * ceil(max_len / block_size)``) the same sweep would pin
+    in slot mode."""
+    import os
+    import time
+
+    from triton_dist_tpu.models import PRESETS, DenseLLM, Engine
+    from triton_dist_tpu.runtime import telemetry
+    from triton_dist_tpu.runtime.mesh import initialize_distributed
+    from triton_dist_tpu.serving import InferenceServer
+
+    ctx = initialize_distributed(
+        devices=jax.devices()[:1], axis_names=("tp",), set_default=False
+    )
+    model = DenseLLM(PRESETS["test-dense"], ctx, key=jax.random.PRNGKey(1))
+
+    prefix_len = 512 if on_tpu else 128
+    max_len = prefix_len + 64
+    eng = Engine(model, backend="xla", max_len=max_len)
+
+    slots, chunk = 4, 8
+    prefix = [(5 * j + 3) % 256 for j in range(prefix_len)]
+    reqs = [
+        (prefix + [(7 * i + j) % 256 for j in range(2 + i % 3)],
+         6 + (5 * i) % 8)
+        for i in range(16)
+    ]
+    out = {
+        "serving_paged_requests": len(reqs),
+        "serving_paged_prefix_len": prefix_len,
+    }
+
+    prev_chunk = os.environ.get("TDT_PREFILL_CHUNK")
+    os.environ["TDT_PREFILL_CHUNK"] = str(prefix_len // 4)
+    try:
+        # Warmup compiles the chunk-prefill program per distinct (C, P)
+        # shape pair plus the paged gather/scatter and decode programs, so
+        # the timed sweep measures the serving loop, not compilation.
+        warm = InferenceServer(eng, num_slots=slots, chunk=chunk)
+        for plen in sorted({len(p) for p, _ in reqs}):
+            warm.submit(list(range(plen)), 2)
+        warm.run()
+
+        hits0 = telemetry.counter_total("tdt_kv_prefix_hits_total")
+        srv = InferenceServer(eng, num_slots=slots, chunk=chunk)
+        handles = [srv.submit(p, g) for p, g in reqs]
+        # Drive step() by hand (rather than run()) to sample the pool's
+        # peak in-flight block count between scheduler iterations.
+        peak_blocks = 0
+        t0 = time.perf_counter()
+        while True:
+            worked = srv.step()
+            if srv.kv_ledger is not None:
+                peak_blocks = max(
+                    peak_blocks, srv.kv_ledger.stats()["blocks_used"]
+                )
+            if (not worked and srv.scheduler.queue_depth() == 0
+                    and not srv.scheduler.occupancy()):
+                break
+        wall = time.perf_counter() - t0
+    finally:
+        if prev_chunk is None:
+            os.environ.pop("TDT_PREFILL_CHUNK", None)
+        else:
+            os.environ["TDT_PREFILL_CHUNK"] = prev_chunk
+
+    toks = sum(len(h.tokens) for h in handles)
+    ttfts = sorted(h.ttft_s for h in handles if h.ttft_s is not None)
+    hits = telemetry.counter_total("tdt_kv_prefix_hits_total") - hits0
+    out["serving_paged_tokens_per_s"] = round(toks / wall, 1)
+    out["serving_paged_ttft_p50_ms"] = round(1e3 * ttfts[len(ttfts) // 2], 2)
+    out["serving_paged_ttft_p99_ms"] = round(
+        1e3 * ttfts[min(len(ttfts) - 1, int(len(ttfts) * 0.99))], 2
+    )
+    out["serving_paged_prefix_hit_rate"] = round(hits / len(reqs), 3)
+    if srv.kv_ledger is not None:
+        bs = srv.kv_ledger.block_size
+        out["serving_paged_kv_peak_blocks"] = peak_blocks
+        out["serving_paged_slot_baseline_blocks"] = slots * (-(-max_len // bs))
+    return out
+
+
 def bench_serving_chaos(on_tpu):
     """Chaos-arc serving benchmark (the SLO-guardrail subsystem): drive the
     ``dist_ar`` server through a scripted abort → degraded-XLA recovery →
@@ -1605,6 +1697,15 @@ def main():
         emit()
     else:
         extra["serving_rank_loss_skipped"] = "budget"
+    if remaining() > 45:
+        phase("serving_paged")
+        try:
+            absorb(bench_serving_paged(on_tpu))
+        except Exception as e:  # noqa: BLE001
+            extra["serving_paged_error"] = f"{type(e).__name__}"
+        emit()
+    else:
+        extra["serving_paged_skipped"] = "budget"
     if remaining() > 60:
         phase("dma_overlap")
         try:

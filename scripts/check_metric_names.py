@@ -88,6 +88,16 @@ REQUIRED_NAMES = {
     "tdt_serving_journal_replayed_total",
     "tdt_serving_journal_replay_seconds",
     "tdt_serving_drain_seconds",
+    # paged KV: block pool / prefix reuse / chunked prefill (serving)
+    "tdt_kv_blocks_free",
+    "tdt_kv_blocks_used",
+    "tdt_kv_blocks_shared",
+    "tdt_kv_prefix_hits_total",
+    "tdt_kv_prefix_blocks_reused_total",
+    "tdt_kv_evictions_total",
+    "tdt_kv_cow_copies_total",
+    "tdt_serving_prefill_chunks",
+    "tdt_serving_kv_budget_wait_total",
     # span names
     "tdt_serving_probe",
     "tdt_serving_restore",

@@ -383,6 +383,35 @@ def test_crash_at_every_record_boundary(engine, tmp_path):
             assert list(r.tokens) == ref_by_id[r.req_id], (
                 f"cut={cut} req={r.req_id}: recovery diverged"
             )
+        if srv_b.kv_ledger is not None:
+            # Paged pool hygiene: after the drain only the prefix index may
+            # hold blocks — any extra used block is a chain the recovery
+            # path reserved but never released.
+            st = srv_b.kv_ledger.stats()
+            assert st["blocks_used"] == st["blocks_indexed"], f"cut={cut}"
+
+
+@pytest.mark.chaos
+def test_kill_and_recover_slot_mode_fallback(engine, tmp_path, monkeypatch):
+    """The legacy contiguous slot cache (``TDT_SERVING_PAGED=0``) keeps the
+    full recovery contract: same journal format, same zero-drop/zero-dup
+    byte parity — the journal is token-level, so either KV layout can
+    resume the other's work."""
+    monkeypatch.setenv("TDT_SERVING_PAGED", "0")
+    refs = _references(engine)
+    path = tmp_path / "journal.jsonl"
+    srv1, handles1, _ = _serve_journaled(engine, path, partial=True)
+    assert srv1.kv_ledger is None            # the knob actually took
+    pre = RequestJournal.replay(RequestJournal.read(path))
+    live = {rid for rid, rr in pre.items() if not rr.terminal}
+    assert live
+    srv2 = InferenceServer(engine, num_slots=3, chunk=2)
+    restored = srv2.recover(path)
+    assert sorted(r.req_id for r in restored) == sorted(live)
+    srv2.run()
+    by_id = {h.req_id: ref for h, ref in zip(handles1, refs)}
+    for r in restored:
+        assert r.done and list(r.tokens) == by_id[r.req_id]
 
 
 def test_recover_drops_oversized_requests(engine, tmp_path):

@@ -190,6 +190,180 @@ def flash_decode(
     return o
 
 
+def _paged_decode_kernel(
+    tables_ref,  # scalar-prefetch (B, max_blocks) int32
+    lengths_ref,  # SMEM (B,)
+    q_ref,  # (1, group, d)
+    k_ref,  # (1, 1, bs, d) — one physical pool block
+    v_ref,  # (1, 1, bs, d)
+    o_ref,  # (1, group, d)
+    lse_ref,  # (1, 1, group)
+    acc_scr,  # VMEM (group, d) f32
+    m_scr,  # VMEM (group, LANES) f32
+    l_scr,  # VMEM (group, LANES) f32
+    *,
+    scale: float,
+    block_size: int,
+    n_kv: int,
+    hkv: int,
+):
+    """Online-softmax decode walking a block TABLE instead of a contiguous
+    row. Identical math to ``_decode_kernel`` with ``block_k=block_size`` —
+    the BlockSpec index_map does the page walk (physical block id prefetched
+    from ``tables_ref``), so the compute body never changes and bitwise
+    parity with the contiguous kernel at the same block partition holds by
+    construction."""
+    bh = pl.program_id(0)
+    ik = pl.program_id(1)
+    length = lengths_ref[bh // hkv]
+
+    @pl.when(ik == 0)
+    def _():
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+
+    @pl.when(ik * block_size < length)  # logical blocks past the cache end skip
+    def _():
+        q = q_ref[0]  # (group, d)
+        k = k_ref[0, 0]  # (bs, d)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # (group, bs)
+        k_ids = ik * block_size + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(k_ids < length, s, NEG_INF)
+
+        m_prev = m_scr[...]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, jnp.broadcast_to(m_cur, m_prev.shape))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, :1])
+        l_scr[...] = l_scr[...] * alpha + jnp.broadcast_to(
+            jnp.sum(p, axis=1, keepdims=True), m_prev.shape
+        )
+        m_scr[...] = m_new
+        acc_scr[...] = acc_scr[...] * alpha[:, :1] + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0, 0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(ik == n_kv - 1)
+    def _():
+        l = l_scr[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[...] / l_safe).astype(o_ref.dtype)
+        lse = jnp.where(
+            l_scr[:, 0] == 0.0,
+            NEG_INF,
+            m_scr[:, 0] + jnp.log(jnp.maximum(l_scr[:, 0], 1e-30)),
+        )
+        lse_ref[0, 0] = lse.astype(lse_ref.dtype)
+
+
+def gather_paged_kv(k_pool: jax.Array, tables: jax.Array) -> jax.Array:
+    """Materialize a contiguous (B, Hkv, max_blocks*bs, D) cache view from a
+    (num_blocks, Hkv, bs, D) pool and a (B, max_blocks) int32 block table.
+    Pure gather — unmapped table entries point at the null block (zeros) and
+    sit past ``lengths``, so the view feeds the contiguous kernel unchanged.
+    This is the interpret-mode parity ORACLE for the paged kernel and the
+    engine's gather-based decode fallback."""
+    b, mb = tables.shape
+    _, hkv, bs, d = k_pool.shape
+    gathered = jnp.take(k_pool, tables.reshape(-1), axis=0)  # (B*MB, Hkv, bs, D)
+    gathered = gathered.reshape(b, mb, hkv, bs, d).transpose(0, 2, 1, 3, 4)
+    return gathered.reshape(b, hkv, mb * bs, d)
+
+
+def paged_flash_decode(
+    q: jax.Array,  # (B, Hq, D) — single decode step
+    k_pool: jax.Array,  # (num_blocks, Hkv, bs, D) — global block pool
+    v_pool: jax.Array,
+    tables: jax.Array,  # (B, max_blocks) int32 physical block ids
+    lengths: jax.Array,  # (B,) int32 valid cache length per sequence
+    *,
+    scale: float | None = None,
+    impl: str = "pallas",
+    return_lse: bool = False,
+):
+    """One-token GQA decode against a PAGED cache.
+
+    ``impl="pallas"`` walks the block table inside the kernel grid: the
+    physical block id for grid step ``(bh, ik)`` is scalar-prefetched from
+    ``tables`` and becomes the BlockSpec index — logical position is grid
+    position, physical position is table data, shapes stay fixed.
+    ``impl="gather"`` is the oracle: gather the pool into a contiguous view
+    and run the proven contiguous kernel at ``block_k=block_size`` (the
+    same KV partition → bitwise-identical accumulation order)."""
+    b, hq, d = q.shape
+    nb, hkv, bs, _ = k_pool.shape
+    assert hq % hkv == 0
+    group = hq // hkv
+    mb = tables.shape[1]
+    scale = scale if scale is not None else d ** -0.5
+
+    if impl == "gather":
+        kc = gather_paged_kv(k_pool, tables)
+        vc = gather_paged_kv(v_pool, tables)
+        return flash_decode(
+            q, kc, vc, lengths, scale=scale, block_k=bs, return_lse=return_lse
+        )
+    if impl != "pallas":
+        raise ValueError(f"unknown paged decode impl {impl!r}")
+
+    qr = q.reshape(b, hkv, group, d).reshape(b * hkv, group, d)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,  # tables ride ahead of the grid for index maps
+        grid=(b * hkv, mb),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, group, d), lambda bh, ik, tab: (bh, 0, 0)),
+            pl.BlockSpec(
+                (1, 1, bs, d),
+                lambda bh, ik, tab: (tab[bh // hkv, ik], bh % hkv, 0, 0),
+            ),
+            pl.BlockSpec(
+                (1, 1, bs, d),
+                lambda bh, ik, tab: (tab[bh // hkv, ik], bh % hkv, 0, 0),
+            ),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, group, d), lambda bh, ik, tab: (bh, 0, 0)),
+            pl.BlockSpec((1, 1, group), lambda bh, ik, tab: (bh, 0, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((group, d), jnp.float32),
+            pltpu.VMEM((group, LANES), jnp.float32),
+            pltpu.VMEM((group, LANES), jnp.float32),
+        ],
+    )
+    o, lse = pl.pallas_call(
+        functools.partial(
+            _paged_decode_kernel, scale=scale, block_size=bs, n_kv=mb, hkv=hkv
+        ),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((b * hkv, group, d), q.dtype),
+            jax.ShapeDtypeStruct((b * hkv, 1, group), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret_mode_default(),
+    )(
+        tables.astype(jnp.int32).reshape(b, mb),
+        lengths.astype(jnp.int32),
+        qr,
+        k_pool,
+        v_pool,
+    )
+
+    o = o.reshape(b, hq, d)
+    if return_lse:
+        return o, lse.reshape(b, hq)
+    return o
+
+
 def combine_partials(o_parts: jax.Array, lse_parts: jax.Array) -> jax.Array:
     """Numerically-stable combine of per-shard attention partials.
 

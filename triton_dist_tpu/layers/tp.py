@@ -204,6 +204,47 @@ class TP_Attn:
             out = gemm_ar_shard(o, self.wo, axis=axis, mesh_axes=self.mesh_axes)
         return out, (k, v)
 
+    def prefill_chunk(self, x, pos, k_buf, v_buf, off, mode: str = "dist_ar",
+                      bsz: int = 1):
+        """One prefill CHUNK against a running per-request KV buffer.
+
+        x: (bsz·C, d) replicated chunk tokens; pos: (bsz, C) absolute
+        positions (``off + arange(C)``); ``k_buf``/``v_buf``: (B, Hkv_l, P,
+        D) context buffers holding every previously prefilled row of this
+        prompt; ``off``: traced int32 chunk start. Inserts the chunk's K/V
+        rows at ``off + arange(C)`` (``mode="drop"`` — a partial final
+        chunk's padding rows index past P and must vanish, where a clamping
+        ``dynamic_update_slice`` would overwrite real rows) and attends the
+        chunk's queries over the WHOLE buffer with the dynamic-offset causal
+        mask (``q_offset=off``): rows past ``off + C`` are zeros but sit in
+        the causal future, so they never contribute. Replicated modes only
+        (``xla``/``dist_ar``) — chunks are decode-regime sized, the
+        seq-sharded ``dist`` contract does not apply. Returns
+        (out (bsz·C, d), (k_buf, v_buf) updated)."""
+        mode = _tp_mode(mode)
+        if mode not in ("xla", "dist_ar"):
+            raise ValueError(f"prefill_chunk supports xla/dist_ar, got {mode}")
+        seq = pos.shape[1]
+        qkv = jnp.dot(x, self.wqkv, preferred_element_type=jnp.float32).astype(x.dtype)
+        q, k, v = self._split_qkv(qkv, bsz, seq)
+        q = apply_rope(q, pos, self.rope_theta)
+        k = apply_rope(k, pos, self.rope_theta)
+        idx = off + jnp.arange(seq, dtype=jnp.int32)
+        k_buf = k_buf.at[:, :, idx].set(k, mode="drop")
+        v_buf = v_buf.at[:, :, idx].set(v, mode="drop")
+        o = flash_attention(
+            q, k_buf, v_buf, causal=True,
+            q_offset=off.astype(jnp.int32), kv_offset=jnp.int32(0),
+        )
+        o = o.transpose(0, 2, 1, 3).reshape(bsz * seq, -1)
+        if mode == "xla":
+            out = jax.lax.psum(
+                jnp.dot(o, self.wo, preferred_element_type=jnp.float32), self.axis
+            ).astype(x.dtype)
+        else:
+            out = gemm_ar_shard(o, self.wo, axis=self.axis, mesh_axes=self.mesh_axes)
+        return out, (k_buf, v_buf)
+
     def decode(self, x, pos, k_cache, v_cache, lengths, mode: str = "dist_ar"):
         """One-token decode. x: (bsz, d) replicated; pos: (bsz,) positions;
         caches (B, Hkv_l, S, D) fixed-size. Writes the new k/v into the cache

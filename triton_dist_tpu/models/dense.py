@@ -217,6 +217,59 @@ class DenseLLM:
         logits = jnp.dot(x, p.lm_head, preferred_element_type=jnp.float32)
         return logits, (ks, vs)
 
+    def prefill_chunk_shard(self, p: DenseParams, tokens: jax.Array, kbufs, vbufs,
+                            off: jax.Array, last_idx: jax.Array, mode: str):
+        """Inside shard_map. One chunk of an incremental prefill.
+
+        tokens (B, C) replicated chunk; ``kbufs``/``vbufs`` (L, B, Hkv_l, P,
+        D) running context buffers carried across chunks; ``off`` traced
+        int32 absolute start of this chunk; ``last_idx`` traced int32 row
+        (within the chunk) whose logits the caller wants — the prompt's
+        final token on the last chunk, ignored elsewhere. Returns (logits
+        (B, V_local), updated (kbufs, vbufs)). Replicated modes only —
+        chunks are small, so this rides the decode-regime collectives; the
+        per-row math (RoPE at absolute positions, causal attention over the
+        buffer, rowwise norms/MLP) matches ``prefill_shard`` row for row,
+        which is what makes chunked prefill byte-parity with one-shot
+        prefill testable rather than aspirational. (MoE capacity is the
+        exception: routing is per-call, so an over-capacity MoE prefill may
+        drop different tokens chunked vs one-shot.)"""
+        c = self.config
+        bsz, seq = tokens.shape
+        x = p.embed[tokens].reshape(bsz * seq, c.hidden_size)
+        pos = jnp.broadcast_to(
+            off.astype(jnp.int32) + jnp.arange(seq, dtype=jnp.int32)[None], (bsz, seq)
+        )
+        eps = c.rms_eps
+
+        def layer_fn(x, layer):
+            lp, k_b, v_b = layer
+            attn = self._attn(lp)
+            h = RMSNorm(weight=lp["ln1"], eps=eps)(x)
+            a, (k_b, v_b) = attn.prefill_chunk(
+                h, pos, k_b, v_b, off, mode=mode, bsz=bsz
+            )
+            x = x + a
+            h = RMSNorm(weight=lp["ln2"], eps=eps)(x)
+            if c.is_moe:
+                m = self._mlp(lp)(h, mode="xla" if mode == "xla" else "dist_ar")
+            else:
+                m = self._mlp(lp)(h, mode=mode)
+            return x + m, (k_b, v_b)
+
+        x, (kbufs, vbufs) = jax.lax.scan(
+            lambda carry, layer: layer_fn(carry, layer),
+            x, (self._layer_stack(p), kbufs, vbufs),
+        )
+        x = RMSNorm(weight=p.final_norm, eps=eps)(x)
+        x = x.reshape(bsz, seq, -1)
+        x_last = jax.lax.dynamic_slice(
+            x, (0, jnp.clip(last_idx.astype(jnp.int32), 0, seq - 1), 0),
+            (bsz, 1, x.shape[-1]),
+        )[:, 0]
+        logits = jnp.dot(x_last, p.lm_head, preferred_element_type=jnp.float32)
+        return logits, (kbufs, vbufs)
+
     def split_layer_params(self) -> list[dict]:
         """Materialize per-layer parameter dicts from the stacked pytree —
         ONCE, outside jit. The megakernel decode path needs this: a Pallas

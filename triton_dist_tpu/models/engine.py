@@ -27,7 +27,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from triton_dist_tpu.models.dense import DenseLLM
-from triton_dist_tpu.models.kv_cache import KVCache
+from triton_dist_tpu.models.kv_cache import KVCache, PagedKVCache
 from triton_dist_tpu.runtime import telemetry, tracing
 
 
@@ -293,6 +293,131 @@ class Engine:
 
         self._decode_chunk = decode_chunk
 
+        # ---- paged-KV serving programs (block pool + tables) --------------
+        # The paged layout splits the slot cache into a global block pool;
+        # everything below keeps the fixed-shape discipline: block tables
+        # are DATA (int32 operands), pool/buffer shapes are static, and the
+        # decode math still runs through self._decode_chunk — the paged
+        # path is gather → proven contiguous chunk → masked scatter-back,
+        # so every decode guarantee (active masks, chaos hooks, donation)
+        # carries over unchanged.
+        chunk_mode = {"xla": "xla", "dist": "dist_ar", "dist_ar": "dist_ar",
+                      "mega": "dist_ar"}[backend]
+        pool_spec = P(None, None, "tp")  # (L, blocks, Hkv over tp, bs, D)
+        self._pool_sharding = ctx.sharding(*pool_spec)
+
+        def chunk_fn(params, toks, kb, vb, off, last_idx):
+            logits, (kb, vb) = model.prefill_chunk_shard(
+                params, toks, kb, vb, off, last_idx, chunk_mode
+            )
+            return jax.lax.all_gather(logits, axis, axis=1, tiled=True), kb, vb
+
+        # One jitted object; jit's shape cache keys each (chunk_len, P)
+        # combination. kbuf/vbuf are donated — the running context buffer
+        # threads through the chunk loop in place.
+        self._prefill_chunk_prog = jax.jit(
+            jax.shard_map(
+                chunk_fn, mesh=mesh,
+                in_specs=(p_specs, tok_spec, kv_spec, kv_spec, P(), P()),
+                out_specs=(tok_spec, kv_spec, kv_spec),
+                check_vma=False,
+            ),
+            donate_argnums=(2, 3),
+        )
+
+        def paged_gather(pk, pv, tables):
+            nl, _, hkv_l, bs, hd = pk.shape
+            b, mb = tables.shape
+
+            def g(pool):
+                x = jnp.take(pool, tables.reshape(-1), axis=1)
+                x = x.reshape(nl, b, mb, hkv_l, bs, hd).transpose(0, 1, 3, 2, 4, 5)
+                return x.reshape(nl, b, hkv_l, mb * bs, hd)
+
+            return g(pk), g(pv)
+
+        self._paged_gather = jax.jit(
+            paged_gather, out_shardings=(self._kv_sharding, self._kv_sharding)
+        )
+
+        @partial(jax.jit, static_argnums=(7,), donate_argnums=(0, 1))
+        def paged_scatter_decode(pk, pv, kc, vc, tables, lengths0, remaining0, chunk):
+            """Write the decode chunk's freshly-written contiguous rows back
+            into the pool. Row r of slot b landed at position lengths0[b]+r
+            and is real only while r < remaining0[b] (the chunk's active
+            mask); masked rows redirect to the NULL block — a freed slot's
+            old blocks may already belong to another tenant, so the
+            contiguous mode's "harmless junk write" would be cross-slot
+            corruption here."""
+            bs = pk.shape[3]
+            b = tables.shape[0]
+            smax = kc.shape[3]
+            nv = jnp.clip(remaining0, 0, chunk)
+            b_ids = jnp.arange(b)
+            for r in range(chunk):
+                pos = jnp.minimum(lengths0 + r, smax - 1)
+                blk = jnp.take_along_axis(tables, (pos // bs)[:, None], axis=1)[:, 0]
+                phys = jnp.where(r < nv, blk, 0)
+                sub = pos % bs
+                pk = pk.at[:, phys, :, sub, :].set(kc[:, b_ids, :, pos])
+                pv = pv.at[:, phys, :, sub, :].set(vc[:, b_ids, :, pos])
+            return pk, pv
+
+        self._paged_scatter_decode = paged_scatter_decode
+
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def paged_scatter_prefill(pk, pv, kbuf, vbuf, table_row, start_block):
+            """Block-granular scatter of a COMPLETED prefill buffer into the
+            pool: one advanced-index write per pool, not one per row.
+            Blocks below ``start_block`` are prefix-shared (owned by the
+            radix index, possibly by other slots) — they redirect to NULL
+            instead of being rewritten."""
+            bs = pk.shape[3]
+            p_len = kbuf.shape[3]
+            mbf = -(-p_len // bs)
+            pad = mbf * bs - p_len
+
+            def blocks_of(buf):
+                x = buf[:, 0]  # (L, Hkv, P, D)
+                if pad:
+                    x = jnp.pad(x, ((0, 0), (0, 0), (0, pad), (0, 0)))
+                x = x.reshape(x.shape[0], x.shape[1], mbf, bs, x.shape[-1])
+                return x.transpose(0, 2, 1, 3, 4)  # (L, MBf, Hkv, bs, D)
+
+            owned = jnp.arange(mbf) >= start_block
+            phys = jnp.where(owned, table_row[:mbf], 0)
+            pk = pk.at[:, phys].set(blocks_of(kbuf))
+            pv = pv.at[:, phys].set(blocks_of(vbuf))
+            return pk, pv
+
+        self._paged_scatter_prefill = paged_scatter_prefill
+
+        def paged_seed_kbuf(pk, pv, table_row, shared_rows, p_len):
+            """Start a prefix-sharing prefill: gather the slot's table chain
+            into a fresh (L, 1, Hkv, P, D) context buffer, keeping only the
+            first ``shared_rows`` rows (the reused prefix) and zeroing the
+            rest — recycled blocks hold stale tenants' values, and the
+            chunk attention needs finite-but-masked garbage, not arbitrary
+            reads standing in for zeros."""
+            bs = pk.shape[3]
+            mbf = -(-p_len // bs)
+
+            def g(pool):
+                nl, _, hkv_l, _, hd = pool.shape
+                x = jnp.take(pool, table_row[:mbf], axis=1)  # (L, MBf, Hkv, bs, D)
+                x = x.transpose(0, 2, 1, 3, 4).reshape(nl, hkv_l, mbf * bs, hd)
+                x = x[:, :, :p_len]
+                row = jnp.arange(p_len)
+                x = jnp.where(row[None, None, :, None] < shared_rows, x, 0)
+                return x[:, None]  # (L, 1, Hkv, P, D)
+
+            return g(pk), g(pv)
+
+        self._paged_seed_kbuf = jax.jit(
+            paged_seed_kbuf, static_argnums=(4,),
+            out_shardings=(self._kv_sharding, self._kv_sharding),
+        )
+
     # ------------------------------------------------------------------ kv
     def _make_cache(self, ks: jax.Array, vs: jax.Array, seq: int) -> KVCache:
         """Pad prefill caches to max_len into a KVCache handle.
@@ -343,6 +468,97 @@ class Engine:
         key, sub = jax.random.split(key)
         token0 = sample_token(logits, sub, self.sample_method, self.temperature, self.top_p)
         return token0[0], KVCache(k=k2, v=v2, lengths=lengths)
+
+    # ------------------------------------------------ serving (paged blocks)
+    def alloc_paged(self, num_slots: int, *, block_size: int,
+                    num_blocks: int) -> PagedKVCache:
+        """Fresh paged KV: a global (num_blocks, block_size) pool + per-slot
+        block tables sized for ``max_len``. Block 0 is the reserved NULL
+        block (see ``BlockAllocator``); the pool is zeroed so null reads are
+        finite."""
+        c = self.model.config
+        return PagedKVCache.create(
+            c.num_layers, num_slots, c.num_kv_heads, c.head_dim,
+            block_size=block_size, num_blocks=num_blocks, max_len=self.max_len,
+            dtype=jnp.dtype(c.dtype), sharding=self._pool_sharding,
+        )
+
+    def paged_kbuf_zeros(self, p_len: int):
+        """Zeroed (L, 1, Hkv, p_len, D) chunk-prefill context buffers.
+        Two independent allocations — kbuf and vbuf are donated separately
+        through the chunk program."""
+        c = self.model.config
+        shape = (c.num_layers, 1, c.num_kv_heads, p_len, c.head_dim)
+        mk = jax.jit(lambda: jnp.zeros(shape, jnp.dtype(c.dtype)),
+                     out_shardings=self._kv_sharding)
+        return mk(), mk()
+
+    def paged_seed_kbuf(self, paged: PagedKVCache, table_row, shared_rows: int,
+                        p_len: int):
+        """Context buffers seeded with a reused prefix: the first
+        ``shared_rows`` rows gathered from the slot's block chain, the rest
+        zeros (see the in-jit docstring)."""
+        return self._paged_seed_kbuf(
+            paged.k, paged.v, jnp.asarray(table_row, jnp.int32),
+            jnp.int32(shared_rows), int(p_len),
+        )
+
+    def prefill_chunk(self, kbuf, vbuf, chunk_ids: jax.Array, off: int,
+                      last_idx: int):
+        """One chunk of an incremental prefill against the running context
+        buffers. ``chunk_ids`` (1, C) — the final chunk arrives padded to C;
+        ``off`` is the chunk's absolute start, ``last_idx`` the row whose
+        logits matter (the prompt's last token, on the final chunk). One
+        compiled program per (C, P) shape pair; kbuf/vbuf are donated.
+        Returns (logits (1, V), kbuf', vbuf')."""
+        return self._prefill_chunk_prog(
+            self.model.params, chunk_ids, kbuf, vbuf,
+            jnp.int32(off), jnp.int32(last_idx),
+        )
+
+    def complete_paged_prefill(self, paged: PagedKVCache, kbuf, vbuf, table_row,
+                               start_block: int) -> PagedKVCache:
+        """Scatter a finished prefill's context buffer into the pool along
+        the slot's block chain (blocks below ``start_block`` are shared and
+        skipped). Pool buffers are donated; tables/lengths are the host's to
+        update (they travel as data with the next dispatch)."""
+        pk, pv = self._paged_scatter_prefill(
+            paged.k, paged.v, kbuf, vbuf,
+            jnp.asarray(table_row, jnp.int32), jnp.int32(start_block),
+        )
+        return dataclasses.replace(paged, k=pk, v=pv)
+
+    def decode_steps_paged(self, paged: PagedKVCache, tokens: jax.Array,
+                           remaining: jax.Array, chunk: int,
+                           key: jax.Array | None = None):
+        """Paged analog of ``decode_steps``: gather the block pool into the
+        contiguous layout, run the SAME ``self._decode_chunk`` program (every
+        contiguous-mode decode guarantee — active masks, donation, the chaos
+        suite's dispatch hook — applies verbatim), then scatter the chunk's
+        written rows back into the pool with the null-block mask. Returns
+        ``(out, last_tokens, paged', remaining')``."""
+        if key is None:
+            key = jax.random.PRNGKey(0)
+        kc, vc = self._paged_gather(paged.k, paged.v, paged.tables)
+        out, tok, k2, v2, lengths, rem = self._decode_chunk(
+            self.model.params, self._decode_extra, tokens, kc, vc,
+            paged.lengths, remaining, int(chunk), key,
+        )
+        pk, pv = self._paged_scatter_decode(
+            paged.k, paged.v, k2, v2, paged.tables, paged.lengths, remaining,
+            int(chunk),
+        )
+        return out, tok, dataclasses.replace(
+            paged, k=pk, v=pv, lengths=lengths
+        ), rem
+
+    def sample_logits(self, logits: jax.Array, key: jax.Array) -> jax.Array:
+        """Sample with the engine's configured method — the chunked-prefill
+        token-0 sample must go through the exact same path as
+        ``prefill_into_slot``'s for byte parity."""
+        return sample_token(
+            logits, key, self.sample_method, self.temperature, self.top_p
+        )
 
     def decode_steps(self, cache: KVCache, tokens: jax.Array, remaining: jax.Array,
                      chunk: int, key: jax.Array | None = None):

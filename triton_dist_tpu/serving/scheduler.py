@@ -20,13 +20,20 @@ request whose arrival time has passed into the lowest-indexed free slot —
 a request whose (synthetic) arrival lies in the future never blocks one
 behind it that has already arrived.
 
-Admission contract (KV-budget aware): a request is admitted only when
-``len(prompt) + max_new <= max_len`` — the whole generation must fit the
-slot's fixed KV row, so a running request can NEVER run out of cache
-mid-decode (no preemption-by-eviction; the only preemption in the system is
-the degraded-mode rebuild, see ``serving/server.py``). Oversized requests
-are rejected at submit time with ``reason="kv_budget"``; a full bounded
-queue rejects with ``reason="queue_full"``.
+Admission contract (KV-budget aware). Without a :class:`KVLedger` (legacy
+slot mode), a request is admitted only when ``len(prompt) + max_new <=
+max_len`` — the whole generation must fit the slot's fixed KV row — and
+oversized requests are rejected at submit with ``reason="kv_budget"``.
+With a ledger attached (paged mode), the budget is BLOCKS:
+``blocks_needed(prompt, max_new) = ceil((len(prompt)+max_new)/block_size)``
+must fit the pool outright (else ``kv_budget_hard`` at submit — it can
+NEVER fit), and at join time the ledger must actually reserve the chain —
+prefix-index eviction runs first, and a request that would fit after
+in-flight frees is *parked* (``kv_wait``), not rejected, and is exempt
+from queue-time deadline expiry while parked (it is one eviction away
+from admission, not doomed). A full bounded queue still rejects with
+``reason="queue_full"``. Either way a running request can NEVER run out
+of cache mid-decode.
 
 SLO guardrails (all optional, all enforced BEFORE a slot is spent):
 
@@ -63,6 +70,7 @@ import threading
 import time
 from typing import Callable
 
+from triton_dist_tpu.models.kv_cache import NULL_BLOCK, BlockAllocator
 from triton_dist_tpu.runtime import telemetry, tracing
 from triton_dist_tpu.runtime.utils import get_float_env, get_int_env
 
@@ -125,6 +133,15 @@ class Request:
     #: Set by :meth:`Scheduler.cancel` on a RUNNING request; the server
     #: honors it at the next chunk boundary.
     cancel_requested: bool = False
+    #: Paged-KV reservation (ledger mode only). ``kv_blocks`` is the
+    #: physical block chain backing this request (reserved at join time,
+    #: released at finish); the first ``kv_shared`` of them are borrowed
+    #: from the prefix index (donor-written, never written by this
+    #: request); ``kv_wait`` marks a request parked for BLOCKS rather than
+    #: for a slot — exempt from queue-time expiry while parked.
+    kv_blocks: list[int] = dataclasses.field(default_factory=list)
+    kv_shared: int = 0
+    kv_wait: bool = False
     tokens: list[int] = dataclasses.field(default_factory=list)
     #: Per-request trace handle (``runtime.tracing``). ``submit`` opens it;
     #: the server closes it at completion. Defaults to the no-op handle so
@@ -169,6 +186,230 @@ class Slot:
     request: Request | None = None
 
 
+class _PrefixNode:
+    """One radix-trie node: an edge of ``block_size`` prompt tokens mapping
+    to the physical block that holds their KV rows."""
+
+    __slots__ = ("children", "block", "last_used")
+
+    def __init__(self, block: int):
+        self.children: dict[tuple, "_PrefixNode"] = {}
+        self.block = int(block)
+        self.last_used = 0
+
+
+class PrefixIndex:
+    """Radix trie over full prompt-token blocks (RadixAttention-style,
+    Zheng et al.). Each indexed node pins its block with one allocator ref
+    of its own, so a donor finishing (and freeing its chain) cannot recycle
+    a block that a later prompt may still match. Eviction drops
+    least-recently-used LEAVES only — an interior node's block backs every
+    chain below it. LRU uses a logical clock (ticked per lookup/register),
+    not wall time, so behavior is deterministic under test."""
+
+    def __init__(self, allocator: BlockAllocator, block_size: int):
+        self.allocator = allocator
+        self.block_size = int(block_size)
+        self._root = _PrefixNode(-1)
+        self._clock = 0
+        self.num_blocks_indexed = 0
+
+    def _tick(self) -> int:
+        self._clock += 1
+        return self._clock
+
+    def lookup(self, prompt: list[int]) -> list[int]:
+        """Longest indexed chain of full prompt blocks, root-down. Touches
+        LRU stamps; takes NO refs — the caller pins before any eviction."""
+        bs = self.block_size
+        node = self._root
+        chain: list[int] = []
+        t = self._tick()
+        for i in range(len(prompt) // bs):
+            child = node.children.get(tuple(prompt[i * bs:(i + 1) * bs]))
+            if child is None:
+                break
+            child.last_used = t
+            chain.append(child.block)
+            node = child
+        return chain
+
+    def register(self, prompt: list[int], blocks: list[int]) -> int:
+        """Index a finished prefill's FULL prompt blocks (``len(prompt) //
+        block_size`` of them — decode writes only ever land past that
+        boundary, so indexed content is immutable). Existing nodes win on
+        collision (their content is equivalent); each new node takes one
+        allocator ref. Returns the number of newly indexed blocks."""
+        bs = self.block_size
+        node = self._root
+        t = self._tick()
+        added = 0
+        for i in range(min(len(prompt) // bs, len(blocks))):
+            key = tuple(prompt[i * bs:(i + 1) * bs])
+            child = node.children.get(key)
+            if child is None:
+                blk = int(blocks[i])
+                if blk == NULL_BLOCK:
+                    break
+                self.allocator.incref([blk])
+                child = _PrefixNode(blk)
+                node.children[key] = child
+                self.num_blocks_indexed += 1
+                added += 1
+            child.last_used = t
+            node = child
+        return added
+
+    def evict(self, need_free: int) -> int:
+        """Drop LRU leaves until the allocator has ``need_free`` free blocks
+        or the index is empty. Dropping a leaf only frees its block when no
+        running slot still holds a ref — the loop keeps going either way.
+        Returns the number of index entries dropped."""
+        dropped = 0
+        while self.allocator.num_free < need_free:
+            lru = self._lru_leaf()
+            if lru is None:
+                break
+            parent, key, node = lru
+            del parent.children[key]
+            self.num_blocks_indexed -= 1
+            self.allocator.free([node.block])
+            dropped += 1
+        return dropped
+
+    def _lru_leaf(self) -> tuple["_PrefixNode", tuple, "_PrefixNode"] | None:
+        best = None
+        stack = [self._root]
+        while stack:
+            node = stack.pop()
+            for key, child in node.children.items():
+                if child.children:
+                    stack.append(child)
+                elif best is None or child.last_used < best[2].last_used:
+                    best = (node, key, child)
+        return best
+
+    def clear(self) -> None:
+        """Drop every index entry (and its ref). Recovery-path reset."""
+        stack = [self._root]
+        while stack:
+            node = stack.pop()
+            for child in node.children.values():
+                stack.append(child)
+                self.allocator.free([child.block])
+            node.children.clear()
+        self.num_blocks_indexed = 0
+
+
+class KVLedger:
+    """Host-side paged-KV bookkeeping: block-budget admission, prefix
+    reuse, and copy-on-write — owns the :class:`BlockAllocator` and the
+    :class:`PrefixIndex` over it.
+
+    ``reserve`` runs INSIDE the scheduler's join walk so the allocation is
+    atomic with admission (no stale can-admit answer when several slots
+    join in one sweep): it pins any prefix hit first, evicts LRU index
+    leaves if the pool is short, then allocates the fresh tail
+    all-or-nothing. The shared prefix is capped at ``(len(prompt)-1) //
+    block_size`` blocks so prefill always computes at least the last
+    prompt row (its logits seed decode)."""
+
+    def __init__(self, num_blocks: int, block_size: int, *,
+                 prefix_reuse: bool = True):
+        self.allocator = BlockAllocator(num_blocks)
+        self.block_size = int(block_size)
+        self.prefix_reuse = bool(prefix_reuse)
+        self.prefix = PrefixIndex(self.allocator, self.block_size)
+
+    def blocks_needed(self, prompt_len: int, max_new: int) -> int:
+        return -(-(int(prompt_len) + int(max_new)) // self.block_size)
+
+    def can_ever_fit(self, prompt_len: int, max_new: int) -> bool:
+        """Could the chain fit an EMPTY pool? (Block 0 is the null block.)"""
+        need = self.blocks_needed(prompt_len, max_new)
+        return need <= self.allocator.num_blocks - 1
+
+    def reserve(self, req: Request) -> bool:
+        """Reserve ``req``'s full block chain (shared prefix + fresh tail).
+        On success ``req.kv_blocks``/``req.kv_shared`` are set and True is
+        returned; on False nothing is held (park the request, do not
+        reject — in-flight frees will eventually satisfy it)."""
+        bs = self.block_size
+        need_total = self.blocks_needed(len(req.prompt), req.max_new)
+        shared: list[int] = []
+        if self.prefix_reuse:
+            chain = self.prefix.lookup(req.prompt)
+            shared = chain[: (len(req.prompt) - 1) // bs]
+        if shared:
+            # Pin BEFORE eviction so evicting a leaf on our own chain
+            # cannot recycle a block we are about to borrow.
+            self.allocator.incref(shared)
+        fresh_need = need_total - len(shared)
+        if self.allocator.num_free < fresh_need:
+            dropped = self.prefix.evict(fresh_need)
+            if dropped:
+                telemetry.inc("tdt_kv_evictions_total", float(dropped))
+        fresh = self.allocator.alloc(fresh_need) if fresh_need > 0 else []
+        if fresh is None:
+            if shared:
+                self.allocator.free(shared)
+            return False
+        if shared:
+            telemetry.inc("tdt_kv_prefix_hits_total")
+            telemetry.inc(
+                "tdt_kv_prefix_blocks_reused_total", float(len(shared))
+            )
+        req.kv_blocks = shared + fresh
+        req.kv_shared = len(shared)
+        return True
+
+    def release(self, req: Request) -> None:
+        """Return ``req``'s chain (one ref per block — shared blocks stay
+        alive under the index's / other slots' refs). Idempotent."""
+        if req.kv_blocks:
+            self.allocator.free(req.kv_blocks)
+        req.kv_blocks = []
+        req.kv_shared = 0
+
+    def register_prefix(self, req: Request) -> int:
+        """Index ``req``'s full prompt blocks after its prefill completes
+        (content now valid — both the donor-written shared head and the
+        freshly prefilled tail)."""
+        if not self.prefix_reuse:
+            return 0
+        return self.prefix.register(req.prompt, req.kv_blocks)
+
+    def make_writable(self, req: Request, block_idx: int) -> tuple[int, bool]:
+        """Copy-on-write guard: ensure chain position ``block_idx`` is
+        exclusively owned before a write. Structurally the serving path
+        never writes a shared block (indexing stops at full prompt blocks,
+        decode writes past them), so this is a safety net; a copy updates
+        the chain in place and the caller must re-push the device table."""
+        blk, copied = self.allocator.ensure_exclusive(req.kv_blocks[block_idx])
+        if copied:
+            req.kv_blocks[block_idx] = blk
+            telemetry.inc("tdt_kv_cow_copies_total")
+        return blk, copied
+
+    def stats(self) -> dict:
+        a = self.allocator
+        return {
+            "blocks_total": a.num_blocks - 1,
+            "blocks_free": a.num_free,
+            "blocks_used": a.num_used,
+            "blocks_shared": a.num_shared,
+            "blocks_indexed": self.prefix.num_blocks_indexed,
+            "block_size": self.block_size,
+        }
+
+    def reset(self) -> None:
+        """Drop every reservation and index entry (engine-rebuild path:
+        the device pool is recreated from scratch, so host bookkeeping
+        restarts empty)."""
+        self.allocator = BlockAllocator(self.allocator.num_blocks)
+        self.prefix = PrefixIndex(self.allocator, self.block_size)
+
+
 class Scheduler:
     """FCFS admission + join-on-free-slot over ``num_slots`` fixed slots.
 
@@ -178,10 +419,15 @@ class Scheduler:
 
     def __init__(self, num_slots: int, max_len: int, queue_limit: int = 0,
                  shed_wait_s: float | None = None,
-                 shed_priority: int | None = None):
+                 shed_priority: int | None = None,
+                 kv_ledger: KVLedger | None = None):
         assert num_slots >= 1 and max_len >= 2
         self.num_slots = num_slots
         self.max_len = max_len
+        #: Paged-KV block ledger (None = legacy slot-row budget). When set,
+        #: ``join_free_slots`` reserves each request's block chain
+        #: atomically with admission.
+        self.kv_ledger = kv_ledger
         self.queue_limit = queue_limit  # 0 = unbounded
         #: Global projected-wait shed budget, seconds (0 = only per-request
         #: TTFT deadlines trigger overload shedding).
@@ -250,7 +496,15 @@ class Scheduler:
             return self._reject(req, "shutting_down")
         if not prompt or req.max_new < 1:
             return self._reject(req, "empty")
-        if len(prompt) + req.max_new > self.max_len:
+        if self.kv_ledger is not None:
+            if len(prompt) + req.max_new > self.max_len or (
+                not self.kv_ledger.can_ever_fit(len(prompt), req.max_new)
+            ):
+                # Hard block budget: the chain exceeds the slot's block
+                # table or the ENTIRE pool — no amount of frees or
+                # evictions can ever admit it, so reject at submit.
+                return self._reject(req, "kv_budget_hard")
+        elif len(prompt) + req.max_new > self.max_len:
             # KV budget: the whole generation must fit the slot's fixed
             # max_len KV row — admitting anything larger would guarantee an
             # out-of-cache abort mid-decode.
@@ -414,6 +668,18 @@ class Scheduler:
                 if req.arrival_time_s > now_s or not free:
                     deferred.append(req)  # not offered yet / no capacity —
                     continue              # keep its order
+                if self.kv_ledger is not None and not self.kv_ledger.reserve(req):
+                    # Pool dry even after prefix-index eviction. Blocks WILL
+                    # free as running slots finish, so this is a deferral
+                    # (kv_budget_wait), not a reject; the walk keeps going —
+                    # a smaller request behind may still fit (work-conserving
+                    # at the cost of strict FCFS under block pressure).
+                    if not req.kv_wait:
+                        req.kv_wait = True
+                        telemetry.inc("tdt_serving_kv_budget_wait_total")
+                    deferred.append(req)
+                    continue
+                req.kv_wait = False
                 slot = free.pop(0)
                 req.state = RequestState.RUNNING
                 req.arrived_at = max(req.submitted_at, req.arrival_time_s)
@@ -448,6 +714,11 @@ class Scheduler:
         past its TTFT (or total) budget? Not-yet-arrived requests cannot
         expire — their clock has not started."""
         if req.arrival_time_s > now_s:
+            return False
+        if req.kv_wait:
+            # Parked for blocks, not for capacity it can't use: the request
+            # is one eviction/free away from admission — expiring it here
+            # would shed work the pool is about to be able to serve.
             return False
         waited = now_s - max(req.submitted_at, req.arrival_time_s)
         return (
@@ -523,6 +794,7 @@ class Scheduler:
                 ),
                 "n_tokens": len(r.tokens),
                 "priority": r.priority,
+                "kv_wait": r.kv_wait,
             }
             for r in head
         ]

@@ -68,10 +68,30 @@ no per-collective timeout storm — and resume every stream from history.
 joins with reason ``shutting_down``, drains (or journals) running slots,
 flushes the journal + dumps telemetry, and stops the introspect endpoint.
 
+**Paged KV with prefix reuse and chunked prefill** (default ON,
+``TDT_SERVING_PAGED=0`` restores the slot-row cache): the serving cache
+becomes a global block pool + per-slot block tables
+(:class:`~triton_dist_tpu.models.kv_cache.PagedKVCache`), admission becomes
+a block-budget reservation through the scheduler's
+:class:`~triton_dist_tpu.serving.scheduler.KVLedger` (prefix-index eviction,
+``kv_wait`` parking), prompts sharing a block-aligned prefix reuse the
+donor's KV blocks via the radix index, and prefill runs as incremental
+chunks (``TDT_PREFILL_CHUNK`` rows per dispatch) interleaved with decode —
+a long prompt joining mid-decode stalls the decode stream at most ONE chunk
+boundary. Prompts no longer than the chunk knob prefill in one chunk sized
+exactly to the prompt, which is bitwise-identical to the one-shot prefill
+program; see ``docs/serving.md`` for the full parity contract.
+
 Env knobs::
 
     TDT_SERVE_SLOTS       fixed slot-batch size B (default 4)
     TDT_SERVE_CHUNK       decode steps per device dispatch (default 8)
+    TDT_SERVING_PAGED     paged block-pool serving (default 1; 0 = slot rows)
+    TDT_KV_BLOCK_SIZE     KV block size, token rows per block (default 16)
+    TDT_KV_BLOCKS         pool size incl. the null block (default: every
+                          slot can hold a full max_len chain, + 1)
+    TDT_PREFILL_CHUNK     prefill rows per chunk dispatch (default max_len)
+    TDT_PREFIX_REUSE      share block-aligned prompt-prefix KV (default 1)
     TDT_DEADLINE_TTFT_S   default TTFT budget, s (<=0/unset = none)
     TDT_DEADLINE_TOTAL_S  default total budget, s (<=0/unset = none)
     TDT_SHED_WAIT_S       global projected-wait shed budget, s (0 = off)
@@ -90,6 +110,7 @@ histograms.
 
 from __future__ import annotations
 
+import dataclasses
 import os
 import time
 
@@ -100,6 +121,7 @@ import numpy as np
 from triton_dist_tpu.runtime import resilience, telemetry, tracing
 from triton_dist_tpu.runtime.utils import get_float_env, get_int_env
 from triton_dist_tpu.serving.scheduler import (
+    KVLedger,
     Request,
     RequestState,
     Scheduler,
@@ -132,11 +154,43 @@ class InferenceServer:
         #: The backend the operator asked for — the restore target whenever
         #: a breaker closes while the engine is running degraded.
         self._preferred_backend = engine.backend
+        #: Paged-KV serving (block pool + prefix reuse + chunked prefill).
+        #: Default ON; TDT_SERVING_PAGED=0 restores the slot-row cache.
+        self.paged = get_int_env("TDT_SERVING_PAGED", 1) != 0
+        self.kv_ledger: KVLedger | None = None
+        if self.paged:
+            self.block_size = get_int_env("TDT_KV_BLOCK_SIZE", 16)
+            assert self.block_size >= 1
+            max_blocks = -(-engine.max_len // self.block_size)
+            # Default pool: every slot can hold a FULL max_len chain at
+            # once (+1 for the reserved null block) — zero eviction
+            # pressure, strictly more admittable than slot mode. Size it
+            # down (TDT_KV_BLOCKS) to trade capacity for memory; prefix
+            # sharing and kv_wait parking absorb the overcommit.
+            self.num_blocks = get_int_env(
+                "TDT_KV_BLOCKS", self.num_slots * max_blocks + 1
+            )
+            self.prefill_chunk = get_int_env(
+                "TDT_PREFILL_CHUNK", engine.max_len
+            )
+            assert self.prefill_chunk >= 1
+            self.kv_ledger = KVLedger(
+                self.num_blocks, self.block_size,
+                prefix_reuse=get_int_env("TDT_PREFIX_REUSE", 1) != 0,
+            )
         self.scheduler = Scheduler(
             self.num_slots, engine.max_len, queue_limit,
             shed_wait_s=shed_wait_s, shed_priority=shed_priority,
+            kv_ledger=self.kv_ledger,
         )
-        self.cache = engine.alloc_slots(self.num_slots)
+        #: In-flight chunked prefills: slot idx -> cursor state (ids, row
+        #: offset, context buffers, sampling key). One chunk per slot per
+        #: step keeps decode within one chunk boundary of a long prompt.
+        self._prefilling: dict[int, dict] = {}
+        #: Host mirror of per-slot KV lengths (paged mode: the device
+        #: ``lengths`` travel as data the host re-pushes with the tables).
+        self._lengths = np.zeros((self.num_slots,), np.int32)
+        self.cache = self._fresh_cache()
         # Host-authoritative per-slot decode state (tiny, synced per chunk).
         self._last = np.zeros((self.num_slots,), np.int32)
         self._remaining = np.zeros((self.num_slots,), np.int32)
@@ -222,8 +276,16 @@ class InferenceServer:
                         and req.first_token_at is None else None
                     ),
                 )
+                if self.paged:
+                    entry.update(
+                        kv_blocks=len(req.kv_blocks),
+                        kv_prefix_shared=req.kv_shared,
+                        kv_len=int(self._lengths[slot.idx]),
+                        prefilling=slot.idx in self._prefilling,
+                    )
             slots.append(entry)
         return {
+            **({"kv": self.kv_ledger.stats()} if self.kv_ledger else {}),
             "mesh_epoch": resilience.mesh_epoch(),
             "backend": self.engine.backend,
             "shutting_down": self._shutdown,
@@ -284,6 +346,7 @@ class InferenceServer:
         worked = self._health_sweep()
         worked = self._maybe_probe() or worked
         worked = self._join_ready() or worked
+        worked = self._advance_prefills() or worked
         self._reap_slots()
         if not self.scheduler.decoding_slots():
             return worked
@@ -316,6 +379,74 @@ class InferenceServer:
         except KeyboardInterrupt:
             self.shutdown(drain=False)
 
+    # --------------------------------------------------------------- paged KV
+    def _fresh_cache(self):
+        """Allocate the serving KV cache — and, on the paged path, resync
+        every piece of host bookkeeping to the empty pool (recovery and
+        restore reallocate mid-flight).
+
+        A fresh pool holds NO valid content, so the prefix index must
+        forget its donor blocks and every surviving tenant must own its
+        WHOLE chain — a shared head would re-prefill over a donor's
+        garbage. Chains are released and re-reserved all-fresh; a tenant
+        the shrunk effective pool can no longer hold (possible only with an
+        overcommitted ``TDT_KV_BLOCKS``) is preempted back to the queue
+        with its token history intact — the next join re-prefills it."""
+        if not self.paged:
+            return self.engine.alloc_slots(self.num_slots)
+        self._prefilling.clear()
+        self._lengths = np.zeros((self.num_slots,), np.int32)
+        led = self.kv_ledger
+        led.prefix.clear()
+        occupied = self.scheduler.occupied_slots()
+        for slot in occupied:
+            led.release(slot.request)
+        for slot in occupied:
+            req = slot.request
+            req.kv_shared = 0
+            if led.reserve(req):
+                continue
+            self.scheduler.finish(slot)
+            self.scheduler.release(slot)
+            self._remaining[slot.idx] = 0
+            req.state = RequestState.QUEUED
+            telemetry.emit("serving_kv_requeue", req_id=req.req_id)
+            self.scheduler.restore(req)
+        self.cache = self.engine.alloc_paged(
+            self.num_slots, block_size=self.block_size,
+            num_blocks=self.num_blocks,
+        )
+        self._push_tables()
+        self._publish_kv_gauges()
+        return self.cache
+
+    def _table_row(self, req: Request) -> np.ndarray:
+        """``req``'s block chain as one padded device-table row."""
+        row = np.zeros((self.cache.max_blocks,), np.int32)
+        row[: len(req.kv_blocks)] = req.kv_blocks
+        return row
+
+    def _push_tables(self) -> None:
+        """Re-push every slot's block table + KV length to the device. The
+        tables are DATA operands of the (fixed-shape) paged programs, so
+        this never recompiles anything."""
+        mb = self.cache.max_blocks
+        tables = np.zeros((self.num_slots, mb), np.int32)
+        for slot in self.scheduler.occupied_slots():
+            chain = slot.request.kv_blocks
+            tables[slot.idx, : len(chain)] = chain
+        self.cache = dataclasses.replace(
+            self.cache,
+            tables=jnp.asarray(tables),
+            lengths=jnp.asarray(self._lengths, dtype=jnp.int32),
+        )
+
+    def _publish_kv_gauges(self) -> None:
+        s = self.kv_ledger.stats()
+        telemetry.set_gauge("tdt_kv_blocks_free", float(s["blocks_free"]))
+        telemetry.set_gauge("tdt_kv_blocks_used", float(s["blocks_used"]))
+        telemetry.set_gauge("tdt_kv_blocks_shared", float(s["blocks_shared"]))
+
     # ------------------------------------------------------------------ joins
     def _join_ready(self) -> bool:
         joined = self.scheduler.join_free_slots(self._now())
@@ -328,7 +459,10 @@ class InferenceServer:
             # PREFILL, and must re-prefill from them.
             if slot.request is None or slot.state is not SlotState.PREFILL:
                 continue
-            self._guarded(lambda s=slot: self._prefill_slot(s),
+            # Paged mode only ARMS the chunked prefill here; the per-step
+            # _advance_prefills sweep advances it one chunk at a time.
+            target = self._begin_prefill if self.paged else self._prefill_slot
+            self._guarded(lambda s=slot: target(s),
                           what=f"join of request {slot.request.req_id}")
         return bool(joined)
 
@@ -339,6 +473,13 @@ class InferenceServer:
         Recovery re-prefill: history is ``prompt + tokens[:-1]`` (the last
         streamed token's KV is pending, exactly like a resumed decode) —
         the prefill-sampled token is discarded, nothing streams twice."""
+        if self.paged:
+            # Synchronous variant for the recovery/restore paths: run the
+            # chunked prefill to completion before the next slot's turn.
+            self._begin_prefill(slot)
+            while slot.idx in self._prefilling:
+                self._advance_prefill(slot)
+            return
         req = slot.request
         ids = req.prompt + req.tokens[:-1]
         # Scripted chaos site: "recovery" when re-prefilling from history
@@ -381,6 +522,119 @@ class InferenceServer:
         if self._remaining[slot.idx] == 0:
             self._finish(slot)
 
+    # ------------------------------------------------------- chunked prefill
+    def _begin_prefill(self, slot: Slot) -> None:
+        """Arm a paged (chunked) prefill: seed the context buffer — from the
+        reused prefix chain when the ledger found one, zeros otherwise — and
+        queue the slot on the prefill cursor map. The sampling key is split
+        HERE, in join order, so the token stream matches the slot-mode
+        server byte-for-byte."""
+        req = slot.request
+        ids = req.prompt + req.tokens[:-1]
+        # Scripted chaos site: same discriminator as the slot-mode prefill.
+        resilience.chaos_check("recovery" if req.tokens else "prefill")
+        self._key, sub = jax.random.split(self._key)
+        p_len = len(ids)
+        shared_rows = min(req.kv_shared * self.block_size, max(p_len - 1, 0))
+        if shared_rows > 0:
+            kbuf, vbuf = self.engine.paged_seed_kbuf(
+                self.cache, self._table_row(req), shared_rows, p_len
+            )
+        else:
+            kbuf, vbuf = self.engine.paged_kbuf_zeros(p_len)
+        self._prefilling[slot.idx] = {
+            "req": req, "ids": ids, "off": shared_rows,
+            "kbuf": kbuf, "vbuf": vbuf, "key": sub, "n_chunks": 0,
+        }
+
+    def _advance_prefills(self) -> bool:
+        """Advance every in-flight chunked prefill by ONE chunk (the decode
+        stall bound: a long prompt joining mid-decode delays the next decode
+        dispatch by at most one chunk's work)."""
+        if not self._prefilling:
+            return False
+        for idx in list(self._prefilling):
+            if idx not in self._prefilling:
+                continue  # a recovery mid-sweep rebuilt the cursor map
+            slot = self.scheduler.slots[idx]
+            self._guarded(lambda s=slot: self._advance_prefill(s),
+                          what=f"prefill chunk for slot {idx}")
+        return True
+
+    def _advance_prefill(self, slot: Slot) -> None:
+        st = self._prefilling.get(slot.idx)
+        if st is None:
+            return
+        ids, off, req = st["ids"], st["off"], st["req"]
+        p_len = len(ids)
+        # Chunk geometry: C = min(knob, P). A prompt no longer than the
+        # knob prefills in ONE chunk sized exactly to it — no padding, and
+        # bitwise-identical to the one-shot prefill program. The final
+        # chunk of a longer prompt arrives PADDED to C; the drop-mode
+        # insert in the kernel discards rows past P.
+        c = min(self.prefill_chunk, p_len)
+        take = ids[off:off + c]
+        chunk_ids = np.zeros((1, c), np.int32)
+        chunk_ids[0, : len(take)] = take
+        final = off + len(take) >= p_len
+        last_idx = (p_len - 1 - off) if final else (c - 1)
+        with req.trace.span(
+            "tdt_serving_prefill", slot=slot.idx, hist_len=p_len,
+            off=off, chunk_len=len(take), recovery=bool(req.tokens),
+        ):
+            logits, st["kbuf"], st["vbuf"] = self.engine.prefill_chunk(
+                st["kbuf"], st["vbuf"], jnp.asarray(chunk_ids), off, last_idx,
+            )
+        st["off"] = off + len(take)
+        st["n_chunks"] += 1
+        if final:
+            self._complete_prefill(slot, st, logits)
+
+    def _complete_prefill(self, slot: Slot, st: dict, logits) -> None:
+        """Finish a chunked prefill: scatter the context buffer into the
+        pool along the slot's chain (shared prefix blocks stay the donor's),
+        publish the table row, then sample/stream token0 exactly as the
+        slot-mode join does."""
+        req = st["req"]
+        del self._prefilling[slot.idx]
+        p_len = len(st["ids"])
+        self.cache = self.engine.complete_paged_prefill(
+            self.cache, st["kbuf"], st["vbuf"], self._table_row(req),
+            req.kv_shared,
+        )
+        self._lengths[slot.idx] = p_len
+        self.kv_ledger.register_prefix(req)
+        # CoW safety net over decode's write range. Structurally dead (the
+        # index stops at full PROMPT blocks; decode writes past them) but
+        # it turns a future invariant slip into a copy, not corruption.
+        for j in range(p_len // self.block_size, len(req.kv_blocks)):
+            self.kv_ledger.make_writable(req, j)
+        self._push_tables()
+        self._publish_kv_gauges()
+        telemetry.observe("tdt_serving_prefill_chunks", float(st["n_chunks"]))
+        if req.tokens:
+            # Recovery re-prefill: mirror the slot-mode branch — the last
+            # streamed token's KV is pending, nothing streams twice.
+            self._last[slot.idx] = req.tokens[-1]
+            self._remaining[slot.idx] = max(req.max_new - len(req.tokens), 0)
+            if slot.state is SlotState.PREFILL:
+                self.scheduler.start_decode(slot)
+            if self._remaining[slot.idx] == 0:
+                self._finish(slot)
+            return
+        _, sub = jax.random.split(st["key"])
+        tok = int(self.engine.sample_logits(logits, sub)[0])
+        self._last[slot.idx] = tok
+        self._remaining[slot.idx] = req.max_new - 1
+        self.scheduler.start_decode(slot)
+        self._stream(req, tok)
+        if self._journal is not None:
+            self._journal.append(
+                "prefill", req_id=req.req_id, start=0, tokens=[tok]
+            )
+        if self._remaining[slot.idx] == 0:
+            self._finish(slot)
+
     # ----------------------------------------------------------------- decode
     def _decode_once(self) -> None:
         resilience.chaos_check("decode")
@@ -397,8 +651,12 @@ class InferenceServer:
         with self._trace.span(
             "tdt_serving_dispatch", n_active=len(decoding), chunk=self.chunk
         ) as dsp:
+            decode = (
+                self.engine.decode_steps_paged if self.paged
+                else self.engine.decode_steps
+            )
             out, tok, cache, _ = self._watchdog.call(
-                self.engine.decode_steps, self.cache,
+                decode, self.cache,
                 jnp.asarray(self._last), jnp.asarray(self._remaining),
                 self.chunk, sub,
             )
@@ -432,6 +690,8 @@ class InferenceServer:
                         start=len(req.tokens) - n_valid, tokens=toks,
                     )
             self._remaining[slot.idx] -= n_valid
+            if self.paged:
+                self._lengths[slot.idx] += n_valid  # device updated in-chunk
             n_streamed += n_valid
             if self._remaining[slot.idx] == 0:
                 self._finish(slot)
@@ -475,6 +735,14 @@ class InferenceServer:
         self.scheduler.finish(slot)
         self.scheduler.release(slot)
         self._remaining[slot.idx] = 0
+        if self.paged:
+            # A cancel can land mid-prefill: drop the cursor (its context
+            # buffers die with it), return the chain, null the table row.
+            self._prefilling.pop(slot.idx, None)
+            self._lengths[slot.idx] = 0
+            self.kv_ledger.release(req)
+            self._push_tables()
+            self._publish_kv_gauges()
         if self._journal is not None:
             # "finish" always forces the fsync: a completed stream must be
             # durable so recovery can skip it idempotently.
@@ -568,6 +836,10 @@ class InferenceServer:
         while True:
             try:
                 for slot in occupied:
+                    if slot.request is None:
+                        # Preempted back to the queue by the paged pool
+                        # fixup (_fresh_cache) — nothing to re-prefill.
+                        continue
                     self._prefill_slot(slot)
                 return
             except (resilience.CollectiveAbortError,
@@ -584,7 +856,7 @@ class InferenceServer:
                     self.engine._degrade_to_xla(
                         f"{type(e).__name__} during recovery re-prefill"
                     )
-                self.cache = self.engine.alloc_slots(self.num_slots)
+                self.cache = self._fresh_cache()
 
     def _recover(self, why: str) -> None:
         eng = self.engine
@@ -604,7 +876,7 @@ class InferenceServer:
         # The aborted dispatch consumed (donated) or may have poisoned the
         # old slot cache — rebuild it whole from each tenant's durable
         # token history. Queued requests ride along untouched.
-        self.cache = eng.alloc_slots(self.num_slots)
+        self.cache = self._fresh_cache()
         self._reprefill_occupied(occupied)
         r_end = tracing.now_s()
         telemetry.observe("tdt_serving_recovery_seconds", r_end - r_start)
@@ -683,7 +955,7 @@ class InferenceServer:
             in_flight=len(occupied), queued=self.scheduler.queue_depth(),
         )
         r_start = tracing.now_s()
-        self.cache = self.engine.alloc_slots(self.num_slots)
+        self.cache = self._fresh_cache()
         self._reprefill_occupied(occupied)
         r_end = tracing.now_s()
         telemetry.observe("tdt_serving_restore_seconds", r_end - r_start)
@@ -744,9 +1016,13 @@ class InferenceServer:
                     outcome="skipped_duplicate",
                 )
                 continue
-            if len(rr.prompt) + rr.max_new > self.engine.max_len:
-                # The journal came from a server with a bigger KV row;
-                # resuming here would abort mid-decode. Drop loudly.
+            if len(rr.prompt) + rr.max_new > self.engine.max_len or (
+                self.kv_ledger is not None
+                and not self.kv_ledger.can_ever_fit(len(rr.prompt), rr.max_new)
+            ):
+                # The journal came from a server with a bigger KV row (or
+                # block pool); resuming here would abort mid-decode. Drop
+                # loudly.
                 telemetry.inc(
                     "tdt_serving_journal_replayed_total",
                     outcome="dropped_kv_budget",

@@ -98,6 +98,14 @@ REQUIRED_NAMES = {
     "tdt_kv_cow_copies_total",
     "tdt_serving_prefill_chunks",
     "tdt_serving_kv_budget_wait_total",
+    # expert-parallel MoE: AUTO routing + per-expert load (models/moe.py,
+    # kernels/low_latency_a2a.py) — surfaced on /metrics and /requests
+    "tdt_ep_auto_route_total",
+    "tdt_ep_dispatch_total",
+    "tdt_ep_expert_tokens_total",
+    "tdt_ep_expert_load",
+    "tdt_ep_dropped_tokens_total",
+    "tdt_ep_wire_bytes_total",
     # span names
     "tdt_serving_probe",
     "tdt_serving_restore",

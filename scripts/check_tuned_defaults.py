@@ -47,6 +47,16 @@ STATIC_ALLOWLIST = {
     "allgather.py",  # 128 KiB one-shot/ring split, fixed by ICI latency
 }
 
+# Drift guard (default sweep only): these AUTO resolvers MUST exist under
+# the default root — each gates a tuned collective-composition split, so a
+# rename/delete that dodges the per-function reach check would silently
+# un-govern its routing. Growing the set is the point; shrinking it means a
+# tuned crossover was retired on purpose.
+REQUIRED_RESOLVERS = {
+    "get_auto_gemm_ar_method",  # gemm_allreduce.py (dense decode)
+    "get_auto_ep_moe_method",  # low_latency_a2a.py (EP MoE route)
+}
+
 
 def _called_names(fn: ast.AST) -> set[str]:
     """Names this function calls: bare ``f(...)`` and the attr of ``m.f(...)``
@@ -147,6 +157,23 @@ def main(argv: list[str]) -> int:
         # sweep relaxes allowlisted modules to the raw-cache-read check.
         static = len(argv) == 0 and f.name in STATIC_ALLOWLIST
         errors.extend(check_file(f, static=static))
+
+    if not argv:
+        defined: set[str] = set()
+        for f in files:
+            try:
+                tree = ast.parse(f.read_text())
+            except SyntaxError:
+                continue
+            defined |= {
+                n.name for n in tree.body if isinstance(n, ast.FunctionDef)
+            }
+        for name in sorted(REQUIRED_RESOLVERS - defined):
+            errors.append(
+                f"(default sweep): required AUTO resolver {name!r} not found "
+                f"under {DEFAULT_ROOT.name}/ — renamed or deleted without "
+                "updating REQUIRED_RESOLVERS"
+            )
 
     if errors:
         print(f"check_tuned_defaults: {len(errors)} violation(s)")

@@ -5,11 +5,36 @@ Parity model: reference ``test/nvidia/test_ep_a2a.py --check`` /
 one-hot einsum, bitwise/tolerance assertions.
 """
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import PartitionSpec as P
+
+from triton_dist_tpu.runtime.platform import tpu_interpret_available
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _single_device_kernels():
+    """On jax builds without the TPU interpret classes, run the
+    single-device Pallas kernels (group_gemm_swiglu) under the generic HLO
+    interpreter — same escape hatch as the serving tests. The collective
+    ``dist_pallas_call`` kernels still need real TPU interpret machinery;
+    their ``use_pallas=True`` variants are unaffected by this flag."""
+    if tpu_interpret_available():
+        yield
+        return
+    prev = os.environ.get("TDT_INTERPRET_FALLBACK")
+    os.environ["TDT_INTERPRET_FALLBACK"] = "1"
+    jax.clear_caches()
+    yield
+    if prev is None:
+        os.environ.pop("TDT_INTERPRET_FALLBACK", None)
+    else:
+        os.environ["TDT_INTERPRET_FALLBACK"] = prev
+    jax.clear_caches()
 
 from triton_dist_tpu.kernels.moe_utils import (
     capacity_for,
@@ -389,3 +414,83 @@ def test_ep_moe_fused_kernel_vs_dense(ctx4, rng, variant):
     for r in range(WORLD):
         ref = moe_dense_ref(x[r], wr, wg, wu, wd, k)
         np.testing.assert_allclose(out[r], ref, rtol=tol, atol=tol, err_msg=f"rank {r}")
+
+
+# --------------------------------------------- capacity overflow semantics
+
+
+def test_combine_dropped_tokens_are_zero_not_garbage():
+    """Dropped assignments alias slot 0 in ``plan.slot``; the combine must
+    mask them by SELECTION. The old ``weights * keep`` multiply masking let
+    ``0 × non-finite = NaN`` leak: one pathological value in expert 0/slot 0
+    (activation overflow on an unrelated KEPT token, or a stale row in an
+    aborted-transfer landing buffer) poisoned every capacity-dropped token."""
+    # 3 of 4 tokens pick expert 0 at capacity 1: tokens 1 and 3 are dropped.
+    idx = jnp.asarray([[0], [0], [1], [0]], jnp.int32)
+    plan = make_routing_plan(idx, 2, 1)
+    np.testing.assert_array_equal(
+        np.asarray(plan.keep).ravel(), [True, False, True, False]
+    )
+    y = jnp.asarray([[[np.nan, np.inf]], [[2.0, 3.0]]], jnp.float32)
+    out = np.asarray(combine(y, plan, jnp.ones((4, 1), jnp.float32), 4))
+    # Token 0 legitimately owns the poisoned slot; its output is its own.
+    assert not np.isfinite(out[0]).all()
+    # Dropped tokens contribute exact zeros — no NaN/garbage leak.
+    np.testing.assert_array_equal(out[1], [0.0, 0.0])
+    np.testing.assert_array_equal(out[3], [0.0, 0.0])
+    # The kept expert-1 token is untouched.
+    np.testing.assert_array_equal(out[2], [2.0, 3.0])
+
+
+@pytest.mark.parametrize("path", ["plain", "low_latency"])
+def test_ep_moe_capacity_starved_parity(ctx4, rng, path):
+    """Capacity_factor-starved EP MoE (drops on every rank) matches the
+    keep-masked dense reference: dropped tokens contribute zeros, kept
+    tokens full precision. ``low_latency`` runs with the fp8 wire OFF so
+    the bound isolates overflow handling from quantization noise."""
+    from triton_dist_tpu.layers import EP_MoE
+    from triton_dist_tpu.kernels.low_latency_a2a import ep_moe_ll_shard
+    from moe_ref import moe_dense_ref
+
+    WORLD, d, ff, e, t, k = 4, 32, 48, 8, 32, 2
+    CF = 0.5  # cap = 8 < worst per-expert load: every rank drops tokens
+    x = jnp.asarray(rng.standard_normal((WORLD, t, d)), jnp.float32) * 0.3
+    wr = jnp.asarray(rng.standard_normal((d, e)), jnp.float32) * 2.0  # skewed
+    wg = jnp.asarray(rng.standard_normal((e, d, ff)), jnp.float32) * 0.1
+    wu = jnp.asarray(rng.standard_normal((e, d, ff)), jnp.float32) * 0.1
+    wd = jnp.asarray(rng.standard_normal((e, ff, d)), jnp.float32) * 0.1
+    cap = capacity_for(t, k, e, CF)
+
+    def fn(x_, wr_, wg_, wu_, wd_):
+        if path == "plain":
+            moe = EP_MoE(
+                w_router=wr_, w_gate=wg_, w_up=wu_, w_down=wd_,
+                num_experts=e, top_k=k, capacity_factor=CF, axis="tp",
+                mesh_axes=("tp",),
+            )
+            return moe(x_[0])[None]
+        return ep_moe_ll_shard(
+            x_[0], wr_, wg_, wu_, wd_, num_experts=e, top_k=k,
+            capacity_factor=CF, axis="tp", mesh_axes=("tp",),
+            use_pallas=False, wire_fp8=False,
+        )[None]
+
+    out = np.asarray(
+        jax.jit(
+            jax.shard_map(
+                fn, mesh=ctx4.mesh,
+                in_specs=(P("tp"), P(), P("tp"), P("tp"), P("tp")),
+                out_specs=P("tp"), check_vma=False,
+            )
+        )(x, wr, wg, wu, wd)
+    )
+    dropped_somewhere = False
+    for r in range(WORLD):
+        idx, _ = topk_routing(jnp.dot(x[r], wr), k)
+        plan = make_routing_plan(idx, e, cap)
+        dropped_somewhere |= not bool(plan.keep.all())
+        from moe_ref import moe_dense_ref as _ref
+
+        ref = _ref(x[r], wr, wg, wu, wd, k, keep=np.asarray(plan.keep))
+        np.testing.assert_allclose(out[r], ref, rtol=1e-5, atol=1e-5, err_msg=f"rank {r}")
+    assert dropped_somewhere, "starvation regime must actually drop tokens"

@@ -682,6 +682,82 @@ def test_tuned_defaults_lint_flags_violations(tmp_path):
     assert r.returncode == 0, r.stdout + r.stderr
 
 
+def test_tuned_defaults_lint_ep_resolver_fixture(tmp_path):
+    """The EP-MoE resolver shape specifically: a rank-local read of the
+    ``ep_a2a_crossover|world=N`` key is flagged; the blessed
+    ``agreed_cfg_value`` read (the shape ``low_latency_a2a.py`` ships)
+    passes."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    bad = tmp_path / "bad_ep_resolver.py"
+    bad.write_text(
+        "DEFAULT_EP_A2A_CROSSOVER_T = 32\n"
+        "\n"
+        "def get_auto_ep_moe_method(tokens, world):\n"
+        "    cache = get_cache()\n"
+        "    t = cache.get('ep_a2a_crossover|world=4', DEFAULT_EP_A2A_CROSSOVER_T)\n"
+        "    return 'low_latency' if tokens <= t else 'fused'\n"
+    )
+    r = subprocess.run(
+        [sys.executable, "scripts/check_tuned_defaults.py", str(bad)],
+        capture_output=True, text=True, cwd=repo,
+    )
+    assert r.returncode == 1
+    assert "rank-local cache read" in r.stdout
+    assert "get_auto_ep_moe_method" in r.stdout
+
+    good = tmp_path / "good_ep_resolver.py"
+    good.write_text(
+        "DEFAULT_EP_A2A_CROSSOVER_T = 32\n"
+        "\n"
+        "def ep_a2a_crossover_tokens(world):\n"
+        "    from triton_dist_tpu.tools.tune import agreed_cfg_value\n"
+        "    return agreed_cfg_value(\n"
+        "        f'ep_a2a_crossover|world={world}', 'crossover_t',\n"
+        "        DEFAULT_EP_A2A_CROSSOVER_T)\n"
+        "\n"
+        "def get_auto_ep_moe_method(tokens, world):\n"
+        "    return ('low_latency' if tokens <= ep_a2a_crossover_tokens(world)\n"
+        "            else 'fused')\n"
+    )
+    r = subprocess.run(
+        [sys.executable, "scripts/check_tuned_defaults.py", str(good)],
+        capture_output=True, text=True, cwd=repo,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_tuned_defaults_required_resolver_drift_guard(capsys, monkeypatch):
+    """The default sweep pins the EP resolver by NAME: renaming or deleting
+    ``get_auto_ep_moe_method`` (dodging the per-function reach check
+    entirely) must fail the lint, and the guard set must actually contain
+    both shipped resolvers."""
+    import importlib.util
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "_ctd_drift", os.path.join(repo, "scripts", "check_tuned_defaults.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    assert "get_auto_ep_moe_method" in mod.REQUIRED_RESOLVERS
+    assert "get_auto_gemm_ar_method" in mod.REQUIRED_RESOLVERS
+    assert mod.main([]) == 0
+
+    monkeypatch.setattr(
+        mod, "REQUIRED_RESOLVERS",
+        set(mod.REQUIRED_RESOLVERS) | {"get_auto_vanished_method"},
+    )
+    capsys.readouterr()
+    assert mod.main([]) == 1
+    out = capsys.readouterr().out
+    assert "get_auto_vanished_method" in out
+    assert "REQUIRED_RESOLVERS" in out
+
+
 # ---------------------------------------------------- bench regression gate
 
 

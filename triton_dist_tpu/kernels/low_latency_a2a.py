@@ -25,11 +25,12 @@ gradient-of-quality choice, ``low_latency_all_to_all_v2.py`` combine path).
 from __future__ import annotations
 
 import dataclasses
+import enum
 
 import jax
 import jax.numpy as jnp
 
-from triton_dist_tpu.runtime import resilience
+from triton_dist_tpu.runtime import resilience, telemetry
 from triton_dist_tpu.kernels.ep_a2a import all_to_all_single_shard
 from triton_dist_tpu.kernels.group_gemm import group_gemm, group_gemm_swiglu
 from triton_dist_tpu.kernels.moe_utils import (
@@ -44,6 +45,71 @@ from triton_dist_tpu.kernels.moe_utils import (
 )
 
 FP8_MAX = 448.0  # float8_e4m3fn finite max
+
+
+class EPMoEMethod(enum.Enum):
+    """Which EP MoE data path a token batch takes (models/moe.py routing)."""
+
+    AUTO = "auto"
+    #: Fused dispatch→grouped-GEMM→combine composition (prefill regime):
+    #: the one-kernel mega-EP path (``ep_fused.py``) when the Pallas a2a
+    #: transport is up, else the same composition at jit level.
+    FUSED = "fused"
+    #: Low-latency fp8-wire a2a (``ep_moe_ll_shard``) — the decode regime.
+    LOW_LATENCY = "low_latency"
+    #: Sticky degraded fallback: plain composition on the XLA a2a
+    #: transport, no fp8 wire.
+    XLA = "xla"
+
+
+#: Static fallback crossover (tokens per rank): at or below it the fp8-wire
+#: low-latency a2a wins (per-transfer latency dominates, half the wire
+#: bytes); above it the fused dispatch→grouped-GEMM→combine composition's
+#: overlap takes over. 32 tokens is the analytic guess the bench's
+#: ``moe_decode`` section refines (decode chunks are 1-to-few tokens/rank,
+#: prefill hundreds-plus).
+DEFAULT_EP_A2A_CROSSOVER_T = 32
+
+
+def ep_a2a_crossover_tokens(world: int) -> int:
+    """low_latency↔fused routing threshold (tokens per rank), fed from the
+    tune cache (``ep_a2a_crossover|world=<w>``, emitted by bench.py's
+    ``moe_decode`` section) through ``agreed_cfg_value`` — resolved once per
+    process and gated by cross-rank agreement: the two sides of the
+    crossover are different collective compositions, so a per-rank split
+    decision would deadlock the mesh (same schema-v2 contract as
+    ``gemm_ar_crossover_m``)."""
+    from triton_dist_tpu.tools.tune import agreed_cfg_value
+
+    return agreed_cfg_value(
+        f"ep_a2a_crossover|world={world}", "crossover_t",
+        DEFAULT_EP_A2A_CROSSOVER_T,
+    )
+
+
+def get_auto_ep_moe_method(num_tokens: int, world: int) -> EPMoEMethod:
+    """Reference ``get_auto_method`` analog for the EP MoE data path:
+    decode-sized token batches → the fp8-wire low-latency a2a; prefill-sized
+    batches → the fused dispatch→grouped-GEMM→combine composition.
+
+    Degradation check FIRST — before the crossover lookup, which is itself
+    a collective (``agreed_cfg_value``) that must not be dispatched once
+    the process is degraded. Sticky: AUTO keeps routing the XLA a2a
+    transport until ``resilience.reset_degradation()`` (circuit-breaker
+    probe/restore runs through the serving layer's usual arc)."""
+    if resilience.is_degraded("a2a"):
+        resilience.note_fallback_once(
+            "ep_moe.auto", "routing AUTO EP MoE to the XLA a2a transport"
+        )
+        method = EPMoEMethod.XLA
+    elif num_tokens <= ep_a2a_crossover_tokens(world):
+        method = EPMoEMethod.LOW_LATENCY
+    else:
+        method = EPMoEMethod.FUSED
+    telemetry.inc(
+        "tdt_ep_auto_route_total", collective="ep_a2a", method=method.value
+    )
+    return method
 
 
 def quantize_fp8(x: jax.Array):
@@ -91,6 +157,11 @@ def ll_dispatch_shard(
     # dispatch rides the same transport. The bounded waits themselves live
     # in the shared ``ep_a2a._a2a_kernel`` all legs route through.
     use_pallas = use_pallas and not resilience.is_degraded("a2a")
+    # No wire at world==1: the a2a legs are identity, so fp8 quantization
+    # would be pure precision loss for zero byte savings. Skipping it keeps
+    # the low-latency path bit-identical to the plain composition on a
+    # single rank — the serving parity/chaos tests' byte-equality contract.
+    wire_fp8 = wire_fp8 and world > 1
 
     plan = make_routing_plan(expert_idx, num_experts, capacity)
     buf = local_dispatch(x, plan)  # (E, C, d) destination-major

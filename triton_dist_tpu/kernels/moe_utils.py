@@ -119,11 +119,19 @@ def combine(
 ) -> jax.Array:
     """Weighted gather back to token order: out[t] = Σ_k w[t,k]·y[slot[t,k]]
     (dropped assignments contribute zero). ``out_dtype=jnp.float32`` keeps the
-    fp32 accumulation on the wire (ring-RS partial sums)."""
+    fp32 accumulation on the wire (ring-RS partial sums).
+
+    Dropped assignments are masked by SELECTION, not by a zero weight:
+    their ``slot`` aliases slot 0, and ``0 × non-finite = NaN`` — a single
+    pathological value landing in expert 0/slot 0 (activation overflow on
+    an unrelated kept token, or a stale row in an aborted-transfer landing
+    buffer) would otherwise poison every capacity-dropped token's output."""
     flat = y.reshape(-1, y.shape[-1])  # (E*C, d)
     gathered = flat[plan.slot.reshape(-1)]  # (T*K, d)
-    w = (weights * plan.keep).reshape(-1, 1).astype(jnp.float32)
-    out = (gathered.astype(jnp.float32) * w).reshape(num_tokens, -1, y.shape[-1]).sum(axis=1)
+    keep = plan.keep.reshape(-1, 1)
+    gathered = jnp.where(keep, gathered.astype(jnp.float32), 0.0)
+    w = jnp.where(keep, weights.reshape(-1, 1).astype(jnp.float32), 0.0)
+    out = (gathered * w).reshape(num_tokens, -1, y.shape[-1]).sum(axis=1)
     return out.astype(out_dtype or y.dtype)
 
 

@@ -7,6 +7,7 @@ loading HF checkpoints into the TP layout).
 from triton_dist_tpu.models.config import ModelConfig, PRESETS
 from triton_dist_tpu.models.kv_cache import KVCache
 from triton_dist_tpu.models.dense import DenseLLM, Qwen3MoE, DenseParams, init_params
+from triton_dist_tpu.models.moe import EPMoELLM, ep_specs
 from triton_dist_tpu.models.engine import Engine
 from triton_dist_tpu.models.weights import AutoLLM, load_hf_weights
 from triton_dist_tpu.models import checkpoint
@@ -17,6 +18,8 @@ __all__ = [
     "KVCache",
     "DenseLLM",
     "Qwen3MoE",
+    "EPMoELLM",
+    "ep_specs",
     "DenseParams",
     "init_params",
     "Engine",

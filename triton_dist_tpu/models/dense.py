@@ -68,9 +68,13 @@ def _specs(config: ModelConfig) -> DenseParams:
     )
 
 
-def init_params(config: ModelConfig, key: jax.Array, ctx: DistContext) -> DenseParams:
+def init_params(config: ModelConfig, key: jax.Array, ctx: DistContext,
+                specs: DenseParams | None = None) -> DenseParams:
     """Random init with mesh shardings applied (test/bench weights; real
-    weights come from ``AutoLLM``/HF loading, ``models/__init__.py``)."""
+    weights come from ``AutoLLM``/HF loading, ``models/__init__.py``).
+    ``specs`` overrides the placement pytree — the EP MoE model passes its
+    expert-sharded layout (``models/moe.py:ep_specs``) so each rank holds
+    ``(E_local, …)`` expert slabs instead of ffe-sharded slices."""
     c = config
     dt = jnp.dtype(c.dtype)
     L, d, hd = c.num_layers, c.hidden_size, c.head_dim
@@ -109,7 +113,7 @@ def init_params(config: ModelConfig, key: jax.Array, ctx: DistContext) -> DenseP
         final_norm=jnp.ones((d,), dt),
         lm_head=mk(keys[7], (d, c.vocab_size)),
     )
-    specs = _specs(c)
+    specs = specs if specs is not None else _specs(c)
     return jax.tree.map(
         lambda x, s: jax.device_put(x, ctx.sharding(*s)) if x is not None else None,
         params,

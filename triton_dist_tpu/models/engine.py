@@ -124,6 +124,17 @@ class Engine:
                 float(gemm_rs_crossover_m(world)), op="gemm_rs",
             )
 
+        ep_xover = getattr(model, "ep_crossover_tokens", None)
+        if ep_xover is not None and backend != "xla":
+            # Same build-time contract for the EP MoE AUTO route: resolving
+            # low_latency↔fused here warms agreed_cfg_value's memo (a host
+            # collective that must not fire mid-trace) and surfaces the
+            # threshold the compiled programs will route by.
+            telemetry.set_gauge(
+                "tdt_engine_prefill_crossover_rows",
+                float(ep_xover()), op="ep_a2a",
+            )
+
         p_specs = jax.tree.map(
             lambda s: s, modelspecs(model), is_leaf=lambda x: isinstance(x, P) or x is None
         )
@@ -158,9 +169,7 @@ class Engine:
             self._mega_layers = model.split_layer_params()
             # Per-layer specs = the stacked specs minus the leading L dim
             # (derived, so DenseParams sharding changes can't drift).
-            from triton_dist_tpu.models.dense import _specs
-
-            s = _specs(model.config)
+            s = modelspecs(model)
             stacked = {
                 "ln1": s.ln1, "wqkv": s.wqkv, "wo": s.wo, "q_norm": s.q_norm,
                 "k_norm": s.k_norm, "ln2": s.ln2, "mlp_gate": s.mlp_gate,
@@ -760,6 +769,12 @@ def bench_decode_table(model: DenseLLM, backends=_BACKENDS, bsz: int = 1,
 
 
 def modelspecs(model: DenseLLM):
+    """Parameter PartitionSpec pytree for ``model``. Models with a custom
+    layout (the EP MoE model's expert-sharded slabs, ``models/moe.py``)
+    override via a ``param_specs`` method; default is the dense/TP layout."""
+    fn = getattr(model, "param_specs", None)
+    if fn is not None:
+        return fn()
     from triton_dist_tpu.models.dense import _specs
 
     return _specs(model.config)

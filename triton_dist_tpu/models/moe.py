@@ -1,0 +1,218 @@
+"""Expert-parallel Qwen3MoE — the EP serving model (TP attention × EP MLP).
+
+Reference: ``python/triton_dist/models/qwen_moe.py:108`` (``Qwen3MoE`` with
+the EP a2a layers swapped in per backend mode, ``layers/nvidia/ep_*.py``)
+and the e2e MoE engine wiring (``models/engine.py``). TPU redesign:
+
+* Same skeleton as ``DenseLLM`` (stacked-layer scan, one shard_map over
+  ``tp``) but the MLP is :class:`~triton_dist_tpu.layers.ep.EP_MoE`: rank r
+  owns expert slabs ``[r·E_local, (r+1)·E_local)`` of shape ``(E_local, …)``
+  — expert-parallel over the SAME mesh axis the attention is
+  tensor-parallel on (TP×EP, the reference's single-group deployment).
+* The data path per call is picked by the AUTO resolver
+  (``low_latency_a2a.get_auto_ep_moe_method``): decode-sized token batches
+  route the fp8-wire low-latency a2a (``ep_moe_ll_shard``), prefill-sized
+  batches the fused dispatch→grouped-GEMM→combine composition, with the
+  crossover read from the cross-rank-agreed tune cache
+  (``ep_a2a_crossover|world=N``) and a sticky circuit-breaker fallback to
+  the XLA a2a transport once ``resilience`` marks the feature degraded.
+* Per-expert load telemetry (``tdt_ep_*``) rides the dispatch path via a
+  ``jax.debug.callback`` — real runtime routing counts (tokens per expert,
+  capacity-overflow drops, route taken, wire bytes), not trace-time guesses.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from triton_dist_tpu.layers.ep import EP_MoE
+from triton_dist_tpu.layers.tp import MOE_CAPACITY_FACTOR
+from triton_dist_tpu.kernels.low_latency_a2a import (
+    EPMoEMethod,
+    ep_a2a_crossover_tokens,
+    get_auto_ep_moe_method,
+)
+from triton_dist_tpu.kernels.moe_utils import (
+    capacity_for,
+    make_routing_plan,
+    topk_routing,
+)
+from triton_dist_tpu.models.config import ModelConfig
+from triton_dist_tpu.models.dense import DenseLLM, DenseParams, _specs, init_params
+from triton_dist_tpu.runtime import telemetry
+from triton_dist_tpu.runtime.mesh import DistContext
+
+
+def ep_specs(config: ModelConfig) -> DenseParams:
+    """Expert-parallel PartitionSpec pytree: the dense/TP layout with the
+    expert slabs sharded on their leading E dim instead of ffe — each rank
+    holds whole experts ``(E_local, d, ffe)`` / ``(E_local, ffe, d)``, the
+    layout ``EP_MoE`` and the a2a dispatch kernels are written against."""
+    assert config.is_moe, "ep_specs needs a MoE config"
+    return dataclasses.replace(
+        _specs(config),
+        mlp_gate=P(None, "tp", None, None),
+        mlp_up=P(None, "tp", None, None),
+        mlp_down=P(None, "tp", None, None),
+    )
+
+
+def _publish_ep_stats(counts, dropped, rank, *, method, wire_bytes, replicated):
+    """Host-side telemetry sink for the dispatch-path debug callback.
+
+    ``replicated`` inputs (decode / replicated prefill) run the identical
+    routing on every rank — publish from rank 0 only so counters reflect
+    unique tokens; seq-sharded prefill chunks are distinct per rank, so
+    every rank contributes."""
+    if replicated and int(rank) != 0:
+        return
+    counts = np.asarray(counts)
+    total = int(counts.sum())
+    for e, n in enumerate(counts.tolist()):
+        if n:
+            telemetry.inc("tdt_ep_expert_tokens_total", float(n), expert=e)
+        if total:
+            telemetry.set_gauge("tdt_ep_expert_load", n / total, expert=e)
+    if float(dropped):
+        telemetry.inc("tdt_ep_dropped_tokens_total", float(dropped), route=method)
+    telemetry.inc("tdt_ep_dispatch_total", 1.0, route=method)
+    if wire_bytes:
+        telemetry.inc("tdt_ep_wire_bytes_total", wire_bytes, route=method)
+
+
+class EPMoELLM(DenseLLM):
+    """Qwen3MoE-class transformer with the MLP expert-parallel over ``tp``.
+
+    Construction contract: ``config.num_experts % world == 0`` (whole
+    experts per rank). ``use_pallas_a2a`` opts the non-degraded routes into
+    the one-sided Pallas a2a transport (TPU); the default False rides the
+    XLA collectives, which is also what every route degrades to when the
+    circuit breaker opens.
+
+    Mode → path mapping (``mode`` as the dense forward passes it):
+
+    * ``"xla"``  — forced ``EPMoEMethod.XLA``: plain composition on the XLA
+      a2a transport (the degraded/reference backend).
+    * ``"dist"`` — seq-sharded prefill chunks; ``"dist_ar"`` — replicated
+      tokens (decode, chunked/replicated prefill). Both consult the AUTO
+      resolver per traced token count: at or below the agreed crossover →
+      low-latency fp8-wire a2a, above it → fused composition.
+    """
+
+    def __init__(self, config: ModelConfig, ctx: DistContext,
+                 params: DenseParams | None = None, key=None, *,
+                 use_pallas_a2a: bool = False):
+        assert config.is_moe, "EPMoELLM needs a MoE config"
+        world = ctx.num_ranks("tp")
+        assert config.num_experts % world == 0, (
+            f"num_experts={config.num_experts} must divide over world={world}"
+        )
+        self.use_pallas_a2a = use_pallas_a2a
+        if params is None:
+            params = init_params(
+                config, key if key is not None else jax.random.PRNGKey(0),
+                ctx, specs=ep_specs(config),
+            )
+        super().__init__(config, ctx, params)
+
+    # Engine hooks -----------------------------------------------------
+    def param_specs(self) -> DenseParams:
+        """Engine ``modelspecs`` hook: the EP placement pytree."""
+        return ep_specs(self.config)
+
+    def ep_crossover_tokens(self) -> int:
+        """Engine build-time hook: resolve (and memo-warm) the agreed
+        low_latency↔fused crossover for this mesh."""
+        return ep_a2a_crossover_tokens(self.world)
+
+    # Forward ----------------------------------------------------------
+    def _mlp(self, lp):
+        model = self
+
+        def run(x, mode="dist_ar"):
+            return model._ep_mlp(lp, x, mode)
+
+        return run
+
+    def _ep_mlp(self, lp, x, mode):
+        c = self.config
+        t = x.shape[0]
+        if mode == "xla":
+            method = EPMoEMethod.XLA
+        else:
+            # Trace-time resolution: t is static per compiled program, so
+            # each engine program (prefill shape, chunk shape, decode batch)
+            # bakes in ONE route — same cross-rank agreement contract as the
+            # dense AG-GEMM/GEMM-RS prefill routing.
+            method = get_auto_ep_moe_method(t, self.world)
+        use_pallas = self.use_pallas_a2a and method is not EPMoEMethod.XLA
+        self._note_ep_stats(lp, x, method, replicated=mode != "dist")
+        moe = EP_MoE(
+            w_router=lp["router"], w_gate=lp["mlp_gate"], w_up=lp["mlp_up"],
+            w_down=lp["mlp_down"], num_experts=c.num_experts, top_k=c.top_k,
+            capacity_factor=MOE_CAPACITY_FACTOR, axis=self.axis,
+            mesh_axes=self.ctx.axis_names,
+            use_pallas_a2a=use_pallas,
+            low_latency=method is EPMoEMethod.LOW_LATENCY,
+            # Without the Pallas transport the fused method lowers to the
+            # same dispatch→grouped-GEMM→combine composition under one jit
+            # scope (EP_MoE's plain path) — XLA fuses what profits.
+            fused_kernel=method is EPMoEMethod.FUSED and use_pallas,
+        )
+        return moe(x)
+
+    def _note_ep_stats(self, lp, x, method: EPMoEMethod, *, replicated: bool):
+        """Per-expert load telemetry on the dispatch path: recompute the
+        (cheap, d×E) routing decision and ship real counts to the host.
+        Trace-time gate on ``telemetry.enabled()`` — disabled telemetry
+        compiles to nothing, same contract as the kernel-trace callback."""
+        if not telemetry.enabled():
+            return
+        c = self.config
+        t = x.shape[0]
+        cap = capacity_for(t, c.top_k, c.num_experts, MOE_CAPACITY_FACTOR)
+        logits = jnp.dot(x, lp["router"], preferred_element_type=jnp.float32)
+        idx, _ = topk_routing(logits, c.top_k)
+        plan = make_routing_plan(idx, c.num_experts, cap)
+        counts = jnp.zeros((c.num_experts,), jnp.int32).at[idx.reshape(-1)].add(1)
+        dropped = (~plan.keep).sum().astype(jnp.int32)
+        # Wire bytes from static shapes: zero at world==1 (the a2a legs are
+        # identity — and the fp8 wire is skipped, ll_dispatch_shard). The
+        # LL dispatch leg crosses as e4m3 payload + fp32 per-token scale;
+        # every other leg (and every combine) is model dtype.
+        e_local = c.num_experts // self.world
+        slots = self.world * e_local * cap
+        itemsize = jnp.dtype(c.dtype).itemsize
+        if self.world == 1:
+            wire = 0.0
+        elif method is EPMoEMethod.LOW_LATENCY:
+            wire = float(slots * (c.hidden_size + 4) + slots * c.hidden_size * itemsize)
+        else:
+            wire = float(2 * slots * c.hidden_size * itemsize)
+        jax.debug.callback(
+            partial(
+                _publish_ep_stats, method=method.value, wire_bytes=wire,
+                replicated=replicated,
+            ),
+            counts, dropped, jax.lax.axis_index(self.axis),
+        )
+
+    # Unsupported backends ---------------------------------------------
+    def decode_shard_mega(self, *args, **kwargs):
+        raise NotImplementedError(
+            "mega decode is not supported for the EP-sharded MoE model: the "
+            "megakernel graph lowers MoE through TP_MoE (ffe-sharded "
+            "slabs); use backend 'dist_ar' (AUTO-routed low-latency EP a2a)."
+        )
+
+    def split_layer_params(self) -> list[dict]:
+        # The mega build pre-splits params BEFORE tracing anything, so
+        # raising here rejects backend="mega" at Engine construction
+        # instead of at the first (lazy) decode trace.
+        return self.decode_shard_mega()

@@ -84,8 +84,15 @@ def config_from_hf(path: str) -> ModelConfig:
     )
 
 
-def load_hf_weights(path: str, config: ModelConfig, ctx: DistContext, dtype=None) -> DenseParams:
-    """Build the sharded DenseParams pytree from a local HF checkpoint dir."""
+def load_hf_weights(path: str, config: ModelConfig, ctx: DistContext, dtype=None,
+                    expert_parallel: bool = False) -> DenseParams:
+    """Build the sharded DenseParams pytree from a local HF checkpoint dir.
+
+    ``expert_parallel=True`` (MoE configs only) places the stacked expert
+    slabs with the EP layout (``models/moe.py:ep_specs``): each rank holds
+    whole experts ``(E_local, d, ffe)`` instead of ffe-sharded slices — the
+    layout ``EPMoELLM``/``layers/ep.EP_MoE`` serve from. The host-side
+    tensor build is identical; only the ``device_put`` placement differs."""
     sd = _load_state_dict(path)
     c = config
     dt = jnp.dtype(dtype or c.dtype)
@@ -141,7 +148,13 @@ def load_hf_weights(path: str, config: ModelConfig, ctx: DistContext, dtype=None
         final_norm=jnp.asarray(sd["model.norm.weight"].astype(np.float32), dt),
         lm_head=jnp.asarray(lm_head, dt),
     )
-    specs = _specs(c)
+    if expert_parallel:
+        assert c.is_moe, "expert_parallel load needs a MoE config"
+        from triton_dist_tpu.models.moe import ep_specs
+
+        specs = ep_specs(c)
+    else:
+        specs = _specs(c)
     return jax.tree.map(
         lambda x, s: jax.device_put(x, ctx.sharding(*s)) if x is not None else None,
         params,
@@ -155,8 +168,17 @@ class AutoLLM:
     model class from a local HF checkpoint directory."""
 
     @staticmethod
-    def from_pretrained(path: str, ctx: DistContext, dtype=None) -> DenseLLM:
+    def from_pretrained(path: str, ctx: DistContext, dtype=None,
+                        expert_parallel: bool = False) -> DenseLLM:
+        """``expert_parallel=True`` builds the EP MoE serving model
+        (``EPMoELLM``: TP attention × EP experts, AUTO-routed a2a) instead
+        of the ffe-sharded ``Qwen3MoE``; ignored for dense configs."""
         config = config_from_hf(path)
-        params = load_hf_weights(path, config, ctx, dtype=dtype)
+        ep = expert_parallel and config.is_moe
+        params = load_hf_weights(path, config, ctx, dtype=dtype, expert_parallel=ep)
+        if ep:
+            from triton_dist_tpu.models.moe import EPMoELLM
+
+            return EPMoELLM(config, ctx, params=params)
         cls = Qwen3MoE if config.is_moe else DenseLLM
         return cls(config, ctx, params=params)

@@ -286,6 +286,7 @@ class InferenceServer:
             slots.append(entry)
         return {
             **({"kv": self.kv_ledger.stats()} if self.kv_ledger else {}),
+            **({"ep": self._ep_info()} if self._is_ep_model() else {}),
             "mesh_epoch": resilience.mesh_epoch(),
             "backend": self.engine.backend,
             "shutting_down": self._shutdown,
@@ -295,6 +296,33 @@ class InferenceServer:
             "journal": (
                 self._journal.stats() if self._journal is not None else None
             ),
+        }
+
+    def _is_ep_model(self) -> bool:
+        return getattr(self.engine.model, "ep_crossover_tokens", None) is not None
+
+    def _ep_info(self) -> dict:
+        """Expert-parallel MoE introspection: which a2a route the AUTO
+        resolver took, live per-expert load shares, overflow drops and wire
+        bytes — the ``tdt_ep_*`` series reshaped for the `/requests` view
+        (Prometheus `/metrics` carries the same series raw)."""
+        snap = telemetry.snapshot()
+        routes = {
+            e["labels"].get("method", "?"): e["value"]
+            for e in snap["counters"].get("tdt_ep_auto_route_total", [])
+        }
+        load = {
+            str(e["labels"].get("expert", "?")): round(e["value"], 4)
+            for e in snap["gauges"].get("tdt_ep_expert_load", [])
+        }
+        return {
+            "routes": routes,
+            "expert_load": load,
+            "dropped_tokens": telemetry.counter_total(
+                "tdt_ep_dropped_tokens_total"
+            ),
+            "wire_bytes": telemetry.counter_total("tdt_ep_wire_bytes_total"),
+            "crossover_t": self.engine.model.ep_crossover_tokens(),
         }
 
     # ------------------------------------------------------------------ clock
@@ -435,10 +463,14 @@ class InferenceServer:
         for slot in self.scheduler.occupied_slots():
             chain = slot.request.kv_blocks
             tables[slot.idx, : len(chain)] = chain
+        # Snapshot the mirror: jnp.asarray on CPU may zero-copy ALIAS an
+        # aligned numpy buffer, so pushing self._lengths directly would let
+        # later host-side `+=` mutations leak into (or race with) device
+        # reads depending on buffer alignment — a run-to-run coin flip.
         self.cache = dataclasses.replace(
             self.cache,
             tables=jnp.asarray(tables),
-            lengths=jnp.asarray(self._lengths, dtype=jnp.int32),
+            lengths=jnp.asarray(self._lengths.copy(), dtype=jnp.int32),
         )
 
     def _publish_kv_gauges(self) -> None:
@@ -693,7 +725,12 @@ class InferenceServer:
             if self.paged:
                 self._lengths[slot.idx] += n_valid  # device updated in-chunk
             n_streamed += n_valid
-            if self._remaining[slot.idx] == 0:
+        # Finishes run AFTER every slot's host length mirror is advanced:
+        # _finish pushes the mirror over the device lengths (wiping the
+        # in-chunk update), so a finisher processed before a still-active
+        # slot would otherwise roll that slot's KV length back by a chunk.
+        for slot in decoding:
+            if slot.request is not None and self._remaining[slot.idx] == 0:
                 self._finish(slot)
         if n_streamed:
             telemetry.inc("tdt_serving_tokens_total", float(n_streamed))

@@ -1090,6 +1090,79 @@ def bench_serving_rank_loss(on_tpu):
     return out
 
 
+def bench_serving_fleet(on_tpu):
+    """Fleet-router benchmark (the fleet/ subsystem): boots 2 replica
+    subprocesses behind the :class:`Router` and drives a two-wave
+    shared-prefix workload over the loopback wire protocol, once with
+    prefix-affinity placement and once with the pure-load baseline
+    (``affinity=False``), then runs a rolling rebuild with a fresh burst
+    in flight. Replicas always run the CPU test-dense model (the section
+    measures the router — placement probes, positional polling, migration
+    — not model FLOPs; the per-chip sections above cover those). Gated by
+    check_bench_regression.py: ``serving_fleet_tokens_per_s`` (higher
+    better). The hit rates are informational placement-policy counters —
+    affinity must be >= the no-affinity baseline, which the fleet tests
+    assert deterministically."""
+    import shutil
+    import tempfile
+    import time
+
+    from triton_dist_tpu.fleet import Router
+    from triton_dist_tpu.runtime.utils import get_int_env
+
+    # Replicas are their own processes: force the CPU serving shape the
+    # fleet tests use regardless of the bench host's devices.
+    env = {
+        "JAX_PLATFORMS": "cpu",
+        "TDT_INTERPRET_FALLBACK": "1",
+        "TDT_SERVE_SLOTS": "2",
+        "TDT_SERVE_CHUNK": "2",
+    }
+    block = get_int_env("TDT_KV_BLOCK_SIZE", 16)
+    # Two prefix families, each one full KV block: wave 1 registers them,
+    # wave 2 must find the warm tries.
+    pa = [(5 * j + 3) % 256 for j in range(block)]
+    pb = [(11 * j + 7) % 256 for j in range(block)]
+    wave1 = [(pa + [1], 8), (pb + [2], 8)]
+    wave2 = [(p + [i + 3], 8) for i, p in enumerate([pa, pb, pa, pb, pa, pb])]
+    out = {
+        "serving_fleet_replicas": 2,
+        "serving_fleet_requests": len(wave1) + len(wave2),
+        "serving_fleet_prefix_len": block,
+    }
+
+    for label, affinity in (("affinity", True), ("noaffinity", False)):
+        workdir = tempfile.mkdtemp(prefix=f"tdt_bench_fleet_{label}_")
+        try:
+            with Router(2, workdir, env=env, affinity=affinity) as router:
+                router.start()
+                for p, g in wave1:
+                    router.submit(p, g)
+                router.serve_all(timeout_s=180)
+                t0 = time.perf_counter()
+                frs = [router.submit(p, g) for p, g in wave2]
+                router.serve_all(timeout_s=180)
+                wall = time.perf_counter() - t0
+                st = router.status()
+                out[f"serving_fleet_{label}_hit_rate"] = round(
+                    st["prefix_hits"] / max(st["placements"], 1), 3
+                )
+                if affinity:
+                    toks = sum(len(fr.tokens) for fr in frs)
+                    out["serving_fleet_tokens_per_s"] = round(toks / wall, 1)
+                    # Rolling rebuild with a burst in flight: the zero-reject
+                    # guarantee (serve_all raises on anything left behind).
+                    burst = [router.submit(p, g) for p, g in wave2[:4]]
+                    out["serving_fleet_rebuilds"] = router.rolling_rebuild()
+                    router.serve_all(timeout_s=180)
+                    out["serving_fleet_rebuild_requests_done"] = sum(
+                        1 for fr in burst if fr.done
+                    )
+        finally:
+            shutil.rmtree(workdir, ignore_errors=True)
+    return out
+
+
 def bench_moe_decode(on_tpu):
     """MoE decode benchmark (the EP subsystem, models/moe.py): serves the
     ``test-moe`` EP model through the full continuous-batching loop on the
@@ -1825,6 +1898,17 @@ def main():
         emit()
     else:
         extra["serving_paged_skipped"] = "budget"
+    if remaining() > 240:
+        # Multi-process: two replica fleets boot (and one rebuilds) inside
+        # this section, so it needs a bigger slice than the in-process ones.
+        phase("serving_fleet")
+        try:
+            absorb(bench_serving_fleet(on_tpu))
+        except Exception as e:  # noqa: BLE001
+            extra["serving_fleet_error"] = f"{type(e).__name__}"
+        emit()
+    else:
+        extra["serving_fleet_skipped"] = "budget"
     if remaining() > 45:
         phase("moe_decode")
         try:

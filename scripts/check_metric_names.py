@@ -98,6 +98,20 @@ REQUIRED_NAMES = {
     "tdt_kv_cow_copies_total",
     "tdt_serving_prefill_chunks",
     "tdt_serving_kv_budget_wait_total",
+    # fleet front door: replica router placement / migration / rebuild
+    # (fleet/router.py) plus the serving-side drain/resume hooks it drives
+    "tdt_fleet_requests_total",
+    "tdt_fleet_tokens_total",
+    "tdt_fleet_placements_total",
+    "tdt_fleet_prefix_hits_total",
+    "tdt_fleet_prefix_hit_rate",
+    "tdt_fleet_migrations_total",
+    "tdt_fleet_replica_failures_total",
+    "tdt_fleet_replicas_alive",
+    "tdt_fleet_pending_requests",
+    "tdt_fleet_rebuilds_total",
+    "tdt_serving_resumed_total",
+    "tdt_serving_drains_total",
     # expert-parallel MoE: AUTO routing + per-expert load (models/moe.py,
     # kernels/low_latency_a2a.py) — surfaced on /metrics and /requests
     "tdt_ep_auto_route_total",

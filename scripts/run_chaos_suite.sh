@@ -17,7 +17,12 @@
 #   rank-death          scripted die@<rank> mid-decode -> dead_peer fail-fast,
 #                       epoch fence, revive, fused restore (NEW)
 #   kill-and-recover    journaled server abandoned mid-serve -> fresh server
-#                       replays the journal, zero drop/dup (NEW)
+#                       replays the journal, zero drop/dup; the journal also
+#                       replays into a server with a different slot count
+#                       and KV block size (portability)
+#   fleet-migration     SIGKILL one of 3 router-fronted replicas mid-burst ->
+#                       journal-replay migration completes every stream on a
+#                       survivor byte-identically (NEW)
 #   observability       chaos arcs stay visible in traces + telemetry
 #
 # The env pins below make the arcs quick and reproducible:
@@ -66,7 +71,10 @@ run_scenario double-fault \
 run_scenario rank-death \
   tests/test_chaos.py::test_chaos_rank_death_arc_fails_fast_and_recovers "$@"
 run_scenario kill-and-recover \
-  tests/test_journal.py::test_kill_and_recover_zero_drop_zero_dup "$@"
+  tests/test_journal.py::test_kill_and_recover_zero_drop_zero_dup \
+  tests/test_journal.py::test_journal_portability_across_server_shapes "$@"
+run_scenario fleet-migration \
+  tests/test_fleet.py::test_fleet_kill_one_of_three_mid_burst "$@"
 run_scenario observability tests/test_telemetry.py tests/test_tracing.py "$@"
 
 echo

@@ -1,0 +1,411 @@
+"""Fleet tier tests: placement policy, the replica wire protocol, and the
+multi-process acceptance bars.
+
+Three tiers, cheapest first:
+
+* **host** — Router placement ranking (affinity > sticky > load,
+  round-robin cold spread) against synthetic placement hints, and the
+  read-only ``PrefixIndex.match_blocks`` probe. No model, no processes.
+* **world-1 in-process** — a real ``InferenceServer`` behind
+  :class:`ReplicaService` routes over the live introspection endpoint
+  (submit → stream → placement → drain → journal), the ``resume()``
+  mid-stream admission contract, and the ephemeral-port satellite fix.
+* **multi-process** — the ISSUE acceptance bars: 2-replica
+  prefix-affinity + byte parity + rolling rebuild with zero rejects, and
+  kill -9 one of 3 replicas mid-burst with every stream completing
+  byte-identical on a survivor (zero dropped / duplicated tokens).
+
+Every replica subprocess shares the parent's model recipe (test-dense,
+seed 1, xla, ``MAX_LEN=32``), which is the fleet determinism invariant
+migration relies on.
+"""
+
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from triton_dist_tpu.fleet import FleetRequest, ReplicaService, Router
+from triton_dist_tpu.runtime import introspect, resilience, telemetry
+from triton_dist_tpu.runtime.platform import tpu_interpret_available
+from triton_dist_tpu.serving import (
+    InferenceServer,
+    RequestJournal,
+    RequestState,
+)
+
+MAX_LEN = 32
+BLOCK = 16  # TDT_KV_BLOCK_SIZE default — one full block indexes at 16 tokens
+
+#: Env for replica subprocesses: CPU devices, interpreter fallback for
+#: single-device Pallas, small serving shape for fast boot/serve.
+REPLICA_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "TDT_INTERPRET_FALLBACK": "1",
+    "TDT_SERVE_SLOTS": "2",
+    "TDT_SERVE_CHUNK": "2",
+}
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _single_device_kernels():
+    if tpu_interpret_available():
+        yield
+        return
+    prev = os.environ.get("TDT_INTERPRET_FALLBACK")
+    os.environ["TDT_INTERPRET_FALLBACK"] = "1"
+    jax.clear_caches()
+    yield
+    if prev is None:
+        os.environ.pop("TDT_INTERPRET_FALLBACK", None)
+    else:
+        os.environ["TDT_INTERPRET_FALLBACK"] = prev
+    jax.clear_caches()
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    telemetry.reset()
+    resilience.reset_degradation()
+    introspect.set_requests_provider(None)
+    introspect.set_health_provider(None)
+    introspect.clear_json_routes()
+    yield
+    telemetry.reset()
+    resilience.reset_degradation()
+    introspect.set_requests_provider(None)
+    introspect.set_health_provider(None)
+    introspect.clear_json_routes()
+
+
+@pytest.fixture(scope="module")
+def model1():
+    from triton_dist_tpu.models import PRESETS, DenseLLM
+    from triton_dist_tpu.runtime.mesh import initialize_distributed
+    from triton_dist_tpu.runtime.platform import cpu_mesh
+
+    m = cpu_mesh((1,), ("tp",))
+    ctx = initialize_distributed(
+        devices=list(m.devices.flat), axis_names=("tp",), set_default=False
+    )
+    return DenseLLM(PRESETS["test-dense"], ctx, key=jax.random.PRNGKey(1))
+
+
+@pytest.fixture(scope="module")
+def engine(model1):
+    from triton_dist_tpu.models import Engine
+
+    return Engine(model1, backend="xla", max_len=MAX_LEN)
+
+
+def _references(eng, requests):
+    return [
+        list(np.asarray(eng.serve(jnp.asarray([p], jnp.int32), gen_len=g))[0])
+        for p, g in requests
+    ]
+
+
+def _post(url, body):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return json.loads(r.read().decode())
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=30) as r:
+        return json.loads(r.read().decode())
+
+
+# ================================================== host tier: placement
+
+
+def test_match_blocks_probe_is_readonly():
+    from triton_dist_tpu.models.kv_cache import BlockAllocator
+    from triton_dist_tpu.serving.scheduler import PrefixIndex
+
+    alloc = BlockAllocator(8)
+    idx = PrefixIndex(alloc, 4)
+    prompt = list(range(10))                 # 2 full blocks + remainder
+    idx.register(prompt, alloc.alloc(2))
+    clock = idx._clock
+    assert idx.match_blocks(prompt) == 2
+    assert idx.match_blocks(prompt[:7]) == 1
+    assert idx.match_blocks([9] * 10) == 0
+    assert idx._clock == clock               # the probe never ticks the LRU
+
+
+def _hint(warm=0, est=None, backlog=0, depth=0):
+    return {"warm_blocks": warm, "est_wait_s": est,
+            "backlog_tokens": backlog, "queue_depth": depth}
+
+
+def test_rank_affinity_then_sticky_then_load(tmp_path):
+    r = Router(3, tmp_path)
+    for h in r.replicas:
+        h.alive = True
+    prompt_a = list(range(BLOCK + 2))
+    fr = FleetRequest(0, prompt_a, 4, 1)
+
+    # Warmest replica wins outright, regardless of load.
+    infos = [(r.replicas[0], _hint(est=0.0)),
+             (r.replicas[1], _hint(warm=2, est=9.0, backlog=100)),
+             (r.replicas[2], _hint(warm=1))]
+    ranked, reason, hit = r._rank(fr, infos)
+    assert ranked[0] is r.replicas[1] and reason == "affinity" and hit
+    assert set(ranked) == set(r.replicas)    # the rest stay as fallbacks
+
+    # No warm prefix anywhere: the sticky home (recorded above) wins, so a
+    # shared prefix co-locates before any replica's trie has seen it.
+    cold = [(h, _hint()) for h in r.replicas]
+    ranked, reason, hit = r._rank(fr, cold)
+    assert ranked[0] is r.replicas[1] and reason == "sticky" and not hit
+
+    # Unknown prefix, no warm: EWMA-projected load decides.
+    fr2 = FleetRequest(1, [100 + i for i in range(BLOCK + 2)], 4, 1)
+    infos = [(r.replicas[0], _hint(est=4.0)),
+             (r.replicas[1], _hint(est=0.5)),
+             (r.replicas[2], _hint(est=2.0))]
+    ranked, reason, hit = r._rank(fr2, infos)
+    assert ranked[0] is r.replicas[1] and reason == "load" and not hit
+
+
+def test_rank_round_robin_spreads_cold_equal_load(tmp_path):
+    r = Router(3, tmp_path, affinity=False)
+    for h in r.replicas:
+        h.alive = True
+    heads = []
+    for i in range(6):
+        fr = FleetRequest(i, [200 * (i + 1) + j for j in range(BLOCK)], 4, 1)
+        ranked, reason, _ = r._rank(fr, [(h, _hint()) for h in r.replicas])
+        assert reason == "load"              # affinity=False: never affinity
+        heads.append(ranked[0].idx)
+    assert heads == [0, 1, 2, 0, 1, 2]       # cold equal load round-robins
+
+
+def test_rank_affinity_off_ignores_warm(tmp_path):
+    r = Router(2, tmp_path, affinity=False)
+    for h in r.replicas:
+        h.alive = True
+    fr = FleetRequest(0, list(range(BLOCK)), 4, 1)
+    infos = [(r.replicas[0], _hint(est=0.1)),
+             (r.replicas[1], _hint(warm=3, est=5.0))]
+    ranked, reason, _ = r._rank(fr, infos)
+    assert ranked[0] is r.replicas[0] and reason == "load"
+
+
+# =========================== world-1 in-process: replica service + resume
+
+
+def test_port_file_reports_actual_ephemeral_port(monkeypatch, tmp_path):
+    port_file = tmp_path / "port"
+    monkeypatch.setenv("TDT_HTTP_PORT", "0")
+    monkeypatch.setenv("TDT_HTTP_PORT_FILE", str(port_file))
+    ep = introspect.maybe_start()
+    assert ep is not None
+    try:
+        assert ep.port > 0                   # the kernel-assigned port
+        assert str(ep.port) in ep.url()
+        assert port_file.read_text() == str(ep.port)
+        _get(ep.url() + "healthz")           # and it is reachable there
+    finally:
+        ep.stop()
+
+
+def test_resume_admits_mid_stream_and_journals_seed(engine, tmp_path):
+    prompt, max_new = [3, 17, 42, 7, 99], 6
+    [ref] = _references(engine, [(prompt, max_new)])
+    path = tmp_path / "j.jsonl"
+    srv = InferenceServer(
+        engine, num_slots=2, chunk=2,
+        journal=RequestJournal(path, fsync_every=1),
+    )
+    streamed: list[int] = []
+    req = srv.resume(prompt, max_new, ref[:3],
+                     on_token=lambda r, t, i: streamed.append(t))
+    assert req.state is RequestState.QUEUED
+    srv.run()
+    assert req.done and list(req.tokens) == ref
+    # Seeded tokens are NOT re-streamed; the suffix regenerates exactly.
+    assert streamed == ref[3:]
+    # The seed is journaled (position-0 chunk), so THIS journal alone can
+    # resume the request again — self-contained for the next migration.
+    state = RequestJournal.replay(RequestJournal.read(path))
+    assert state[req.req_id].tokens == ref and state[req.req_id].done
+    assert telemetry.counter_value("tdt_serving_resumed_total") == 1.0
+
+    # Resuming with the FULL history completes without new tokens.
+    streamed2: list[int] = []
+    req2 = srv.resume(prompt, max_new, ref,
+                      on_token=lambda r, t, i: streamed2.append(t))
+    srv.run()
+    assert req2.done and list(req2.tokens) == ref and streamed2 == []
+    srv.shutdown(drain=True)
+
+
+def test_replica_service_routes_end_to_end(engine, monkeypatch, tmp_path):
+    monkeypatch.setenv("TDT_HTTP_PORT", "0")
+    reqs = [(list(range(BLOCK)) + [7], 4), ([8, 1, 13], 4)]
+    refs = _references(engine, reqs)
+    srv = InferenceServer(
+        engine, num_slots=2, chunk=2,
+        journal=RequestJournal(tmp_path / "j.jsonl", fsync_every=1),
+    )
+    svc = ReplicaService(srv)
+    base = srv._introspect.url().rstrip("/")
+    try:
+        # Cold placement hint: nothing warm, not draining, ready.
+        hint = _post(base + "/fleet/placement", {"prompt": reqs[0][0]})
+        assert hint["warm_blocks"] == 0 and hint["ready"]
+        assert hint["block_size"] == BLOCK
+
+        rids = []
+        for p, g in reqs:
+            resp = _post(base + "/fleet/submit", {"prompt": p, "max_new": g})
+            assert resp["state"] == "queued"
+            rids.append(resp["req_id"])
+        srv.run()
+
+        # Positional streaming: full fetch, then an offset fetch.
+        out = _post(base + "/fleet/stream",
+                    {"reqs": [[rid, 0] for rid in rids]})
+        for rid, ref in zip(rids, refs):
+            st = out["streams"][str(rid)]
+            assert st["tokens"] == ref and st["done"]
+            assert st["reason"] == "ok"
+        out = _post(base + "/fleet/stream", {"reqs": [[rids[0], 2]]})
+        assert out["streams"][str(rids[0])]["tokens"] == refs[0][2:]
+        unknown = _post(base + "/fleet/stream", {"reqs": [[999, 0]]})
+        assert unknown["streams"]["999"].get("unknown")
+
+        # The served 16-token block is now warm for a sharing prompt.
+        hint = _post(base + "/fleet/placement",
+                     {"prompt": list(range(BLOCK)) + [9, 9]})
+        assert hint["warm_blocks"] >= 1
+
+        # Cancel: unknown id is a no-op, not an error.
+        assert _post(base + "/fleet/cancel", {"req_id": 12345}) == {
+            "cancelled": False
+        }
+
+        # Drain: status flips, new admits bounce with shutting_down.
+        st = _post(base + "/fleet/drain", {})
+        assert st["draining"] and not st["ready"] and st["drained"]
+        late = _post(base + "/fleet/submit", {"prompt": [1, 2], "max_new": 2})
+        assert late["state"] == "rejected"
+        assert late["reject_reason"] == "shutting_down"
+
+        # Journal export: flushed records, replayable.
+        j = _post(base + "/fleet/journal", {})
+        state = RequestJournal.replay(j["records"])
+        assert [state[rid].tokens for rid in rids] == refs
+        assert j["path"].endswith("j.jsonl")
+
+        svc.close()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(base + "/fleet/status")     # routes unmounted with close()
+        assert ei.value.code == 404
+    finally:
+        svc.close()                          # idempotent
+        srv.shutdown(drain=True)
+
+
+# ============================================= multi-process acceptance
+
+
+def _collect(streams):
+    def on_token(fr, t, i):
+        streams.setdefault(fr.fleet_id, []).append(t)
+    return on_token
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(600)
+def test_fleet_affinity_parity_and_rolling_rebuild(engine, tmp_path):
+    """2 replicas: shared-prefix waves route to the warm replica and every
+    stream matches the one-shot reference; then a rolling rebuild with
+    fresh work in flight completes with zero rejects and zero downtime."""
+    pa, pb = [11] * BLOCK, [22] * BLOCK
+    reqs = [(pa + [1], 4), (pb + [2], 4),
+            (pa + [3], 4), (pa + [4], 4), (pb + [5], 4), (pb + [6], 4),
+            (pa + [7], 4), (pb + [8], 4), (pa + [9], 4), (pb + [10], 4)]
+    refs = _references(engine, reqs)
+    streams: dict[int, list[int]] = {}
+    with Router(2, tmp_path / "fleet", env=REPLICA_ENV) as router:
+        router.start()
+        # Wave 1 registers each prefix family on some replica (sticky
+        # keeps each family together even before the tries are warm).
+        frs = [router.submit(p, g, on_token=_collect(streams))
+               for p, g in reqs[:2]]
+        router.serve_all(timeout_s=180)
+        # Wave 2 must find the warm tries and follow them.
+        frs += [router.submit(p, g, on_token=_collect(streams))
+                for p, g in reqs[2:6]]
+        router.serve_all(timeout_s=180)
+        assert router._prefix_hits >= 1
+        assert telemetry.counter_value(
+            "tdt_fleet_placements_total", reason="affinity"
+        ) >= 1.0
+        hit_rate = telemetry.gauge_value("tdt_fleet_prefix_hit_rate")
+        assert hit_rate is not None and hit_rate > 0
+
+        # Rolling rebuild with work in flight: nothing rejected, nothing
+        # dropped, both replicas end up on a fresh generation.
+        frs += [router.submit(p, g, on_token=_collect(streams))
+                for p, g in reqs[6:]]
+        rebuilt = router.rolling_rebuild()
+        assert rebuilt == 2
+        router.serve_all(timeout_s=180)
+        assert all(h.gen == 2 and h.alive for h in router.replicas)
+        assert telemetry.counter_value("tdt_fleet_rebuilds_total") == 2.0
+
+        for fr, ref in zip(frs, refs):
+            assert fr.done and fr.finish_reason == "ok"
+            assert fr.tokens == ref, f"fleet_id={fr.fleet_id} diverged"
+            assert streams[fr.fleet_id] == ref   # zero drop / zero dup
+        # Zero rejects is structural (the router parks rather than
+        # rejecting) — every submitted request reached done above.
+        assert len(router._pending) == 0
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+@pytest.mark.timeout(600)
+def test_fleet_kill_one_of_three_mid_burst(engine, tmp_path):
+    """Acceptance: SIGKILL one of 3 replicas mid-burst. Every in-flight
+    stream completes on a survivor byte-identical to the unkilled run —
+    zero dropped, zero duplicated tokens — via journal-replay migration."""
+    reqs = [([3 + i, 17, (42 & (i + 1)) + 1, 7, 9 * i + 1], 12)
+            for i in range(9)]
+    refs = _references(engine, reqs)
+    streams: dict[int, list[int]] = {}
+    with Router(3, tmp_path / "fleet", env=REPLICA_ENV) as router:
+        router.start()
+        frs = [router.submit(p, g, on_token=_collect(streams))
+               for p, g in reqs]
+        # Let the burst get genuinely mid-flight before the kill.
+        deadline = time.monotonic() + 120
+        while sum(len(s) for s in streams.values()) < 5:
+            assert time.monotonic() < deadline, "burst never started"
+            if not router.pump():
+                time.sleep(0.01)
+        victim = max(router.replicas, key=lambda h: len(h.inflight))
+        assert victim.inflight                # the kill lands on live work
+        router.kill(victim.idx)
+
+        router.serve_all(timeout_s=300)
+        assert not victim.alive
+        assert telemetry.counter_total("tdt_fleet_migrations_total") >= 1.0
+        assert telemetry.gauge_value("tdt_fleet_replicas_alive") == 2.0
+        for fr, ref in zip(frs, refs):
+            assert fr.done
+            assert fr.tokens == ref, f"fleet_id={fr.fleet_id} diverged"
+            assert streams[fr.fleet_id] == ref   # zero drop / zero dup

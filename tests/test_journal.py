@@ -414,6 +414,44 @@ def test_kill_and_recover_slot_mode_fallback(engine, tmp_path, monkeypatch):
         assert r.done and list(r.tokens) == by_id[r.req_id]
 
 
+@pytest.mark.chaos
+def test_journal_portability_across_server_shapes(engine, tmp_path, monkeypatch):
+    """A journal is a portable request ledger, not a dump of one server's
+    internals: records written by a 3-slot server over the default paged
+    pool replay into a fresh server with a different slot count AND a
+    different KV block size, and every surviving stream still completes
+    byte-identically with zero dropped / duplicated tokens. This is the
+    invariant the fleet router leans on when it migrates work between
+    replicas that need not share serving-shape knobs."""
+    refs = _references(engine)
+    path = tmp_path / "journal.jsonl"
+    srv1, handles1, _ = _serve_journaled(engine, path, partial=True)
+    assert srv1.kv_ledger is not None        # donor ran the paged pool
+    pre = RequestJournal.replay(RequestJournal.read(path))
+    live = {rid for rid, rr in pre.items() if not rr.terminal}
+    assert live
+
+    # Fresh "replica" with a deliberately different shape: more slots and
+    # half-size KV blocks (a different paged pool geometry entirely).
+    monkeypatch.setenv("TDT_KV_BLOCK_SIZE", "8")
+    streams2: dict[int, list[int]] = {}
+    srv2 = InferenceServer(engine, num_slots=5, chunk=2)
+    assert srv2.kv_ledger is not None
+    assert srv2.kv_ledger.block_size == 8
+    restored = srv2.recover(
+        path, on_token=lambda r, t, i: streams2.setdefault(r.req_id, []).append(t)
+    )
+    assert sorted(r.req_id for r in restored) == sorted(live)
+    srv2.run()
+    by_id = {h.req_id: ref for h, ref in zip(handles1, refs)}
+    for r in restored:
+        assert r.done
+        assert list(r.tokens) == by_id[r.req_id]
+        # The journaled prefix is seeded, not re-streamed; the regenerated
+        # suffix lands exactly once.
+        assert streams2.get(r.req_id, []) == by_id[r.req_id][len(pre[r.req_id].tokens):]
+
+
 def test_recover_drops_oversized_requests(engine, tmp_path):
     """A journal from a server with a bigger KV row must not abort the
     survivors: the oversized request is dropped loudly, the rest resume."""

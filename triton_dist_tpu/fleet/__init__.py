@@ -1,0 +1,30 @@
+"""Fleet tier: N data-parallel serving replicas behind one router.
+
+One engine is one failure domain and one compile domain. This package
+stacks the existing single-engine primitives into a fleet front door:
+
+* :class:`~triton_dist_tpu.fleet.replica.ReplicaService` — mounts the
+  ``/fleet/*`` JSON routes (submit / resume / stream / placement / drain /
+  cancel / status / journal) on a replica's introspection endpoint, and
+  ``python -m triton_dist_tpu.fleet.replica`` boots one env-configured
+  replica subprocess.
+* :class:`~triton_dist_tpu.fleet.router.Router` — spawns and fronts the
+  replicas: prefix-affinity placement (warmest ``PrefixIndex`` wins, EWMA
+  load breaks ties), journal-replay migration off dead/draining replicas
+  with zero dropped or duplicated tokens, and rolling rebuild with zero
+  rejected requests.
+
+Stdlib-only on the control plane (``subprocess`` + ``urllib`` + JSON over
+the loopback introspection endpoint); the data plane is each replica's own
+``InferenceServer``. See ``docs/fleet.md``.
+"""
+
+from triton_dist_tpu.fleet.replica import ReplicaService
+from triton_dist_tpu.fleet.router import FleetRequest, ReplicaHandle, Router
+
+__all__ = [
+    "FleetRequest",
+    "ReplicaHandle",
+    "ReplicaService",
+    "Router",
+]

@@ -1,0 +1,620 @@
+"""Fleet router: prefix-affinity placement + journal-replay migration.
+
+The :class:`Router` owns N replica subprocesses (``python -m
+triton_dist_tpu.fleet.replica``) and fronts them with three behaviors:
+
+**Placement** (:meth:`Router.submit`). Every eligible replica is probed
+with the prompt (``POST /fleet/placement``); the replica whose
+``PrefixIndex`` holds the longest warm full-block prefix wins
+(*affinity*). With no warm prefix anywhere, the prompt's first-block hash
+looks up the sticky home map — the replica the router last sent this
+prefix family to — so the first wave of a shared prefix co-locates before
+any replica's trie has registered it (*sticky*). Otherwise the least
+loaded replica wins by EWMA-projected wait, then backlog, with a
+round-robin tiebreak (*load* — also the whole policy when
+``affinity=False``, the bench baseline).
+
+**Migration** (automatic, inside :meth:`Router.pump`). A replica that
+dies (``proc.poll()``/connection refused) or drains hands its in-flight
+requests to survivors: the router replays the replica's write-ahead
+journal (over ``GET /fleet/journal`` while alive, straight from the
+journal file after a kill -9), seeds each request's resume history with
+the LONGER of (journaled tokens, router-delivered tokens), and re-admits
+it via ``POST /fleet/resume``. Fleet-wide greedy determinism (same
+weights/seed on every replica) regenerates any fsync-lagged suffix
+byte-identically, and the router's positional polling (each poll asks
+from "tokens I have delivered") makes double-delivery structurally
+impossible — zero dropped, zero duplicated tokens. A request whose
+journal already shows ``finish`` completes from the journal alone.
+
+**Rolling rebuild** (:meth:`Router.rolling_rebuild`). One replica at a
+time: drain (new admits bounce replica-side, the router stops placing
+there) → migrate its in-flight away → wait drained → SIGTERM → respawn
+with a fresh journal generation → wait ready → next. Requests arriving
+meanwhile place on the other replicas, or park in the router's own
+pending queue until a replica is eligible — the client never sees a
+reject.
+
+Control plane is stdlib-only: ``subprocess`` + ``urllib`` + JSON over
+each replica's loopback introspection endpoint. The router itself is
+single-threaded — drive it with :meth:`pump` (one poll sweep) or
+:meth:`serve_all` (pump until every stream completes).
+
+Telemetry (router-process ``tdt_fleet_*`` family):
+``tdt_fleet_requests_total``, ``tdt_fleet_tokens_total``,
+``tdt_fleet_placements_total{reason}``, ``tdt_fleet_prefix_hits_total``,
+``tdt_fleet_prefix_hit_rate`` (gauge), ``tdt_fleet_migrations_total{reason}``,
+``tdt_fleet_replica_failures_total{reason}``, ``tdt_fleet_replicas_alive``
+(gauge), ``tdt_fleet_pending_requests`` (gauge), ``tdt_fleet_rebuilds_total``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+from triton_dist_tpu.runtime import telemetry
+from triton_dist_tpu.runtime.utils import get_int_env, tdt_log
+from triton_dist_tpu.serving.journal import RequestJournal
+
+
+class FleetRequest:
+    """Router-side handle for one fleet-level generation request.
+
+    ``tokens`` is the client-visible stream: exactly the tokens delivered,
+    in order, across however many replicas served the request. Callbacks
+    mirror the serving tier: ``on_token(fr, token, index)`` per delivered
+    token, ``on_finish(fr)`` once."""
+
+    __slots__ = (
+        "fleet_id", "prompt", "max_new", "priority", "on_token", "on_finish",
+        "tokens", "done", "finish_reason", "replica", "remote_id",
+        "migrations", "placed_reason", "_seed",
+    )
+
+    def __init__(self, fleet_id: int, prompt, max_new: int, priority: int,
+                 on_token=None, on_finish=None):
+        self.fleet_id = fleet_id
+        self.prompt = [int(t) for t in prompt]
+        self.max_new = int(max_new)
+        self.priority = int(priority)
+        self.on_token = on_token
+        self.on_finish = on_finish
+        self.tokens: list[int] = []
+        self.done = False
+        self.finish_reason: str | None = None
+        #: Replica idx currently serving this request (None while pending).
+        self.replica: int | None = None
+        #: The serving replica's own req_id for it (journal key).
+        self.remote_id: int | None = None
+        self.migrations = 0
+        self.placed_reason: str | None = None
+        #: Resume history to seed at the next placement (migration only):
+        #: max(journal tokens, delivered tokens) from the previous replica.
+        self._seed: list[int] = []
+
+
+class ReplicaHandle:
+    """One managed replica: its process, endpoint, journal, and in-flight
+    requests (keyed by the replica's req_id)."""
+
+    def __init__(self, idx: int, workdir: str):
+        self.idx = idx
+        self.workdir = workdir
+        #: Spawn generation — each (re)spawn gets a fresh journal/port dir,
+        #: so a rebuilt replica's req_ids can never collide with records a
+        #: previous incarnation journaled.
+        self.gen = 0
+        self.proc: subprocess.Popen | None = None
+        self.port: int | None = None
+        self.port_file = ""
+        self.journal_path = ""
+        self.log_path = ""
+        self._log_f = None
+        self.alive = False
+        self.draining = False
+        self.inflight: dict[int, FleetRequest] = {}
+
+    def url(self, path: str) -> str:
+        return f"http://127.0.0.1:{self.port}{path}"
+
+
+class Router:
+    """Front door for ``num_replicas`` data-parallel serving replicas."""
+
+    def __init__(self, num_replicas: int, workdir: str, env: dict | None = None,
+                 affinity: bool = True, request_timeout_s: float = 30.0):
+        assert num_replicas >= 1
+        self.workdir = os.fspath(workdir)
+        #: Extra env for replica subprocesses (TDT_REPLICA_*, TDT_SERVE_*…)
+        #: on top of the router's own environment.
+        self.env = dict(env or {})
+        self.affinity = bool(affinity)
+        self.request_timeout_s = float(request_timeout_s)
+        self.block_size = get_int_env("TDT_KV_BLOCK_SIZE", 16)
+        self._replicas = [
+            ReplicaHandle(i, os.path.join(self.workdir, f"r{i}"))
+            for i in range(num_replicas)
+        ]
+        self._requests: list[FleetRequest] = []
+        #: Requests with no eligible/accepting replica right now; retried
+        #: every pump — the zero-reject guarantee during rebuild windows.
+        self._pending: list[FleetRequest] = []
+        #: first-block hash -> replica idx (cold-start co-location).
+        self._prefix_home: dict[str, int] = {}
+        self._next_id = 0
+        self._placements = 0
+        self._prefix_hits = 0
+        self._rr = 0  # round-robin cursor for the load tiebreak
+
+    # ---------------------------------------------------------------- spawn
+    @property
+    def replicas(self) -> list[ReplicaHandle]:
+        return self._replicas
+
+    def start(self, ready_timeout_s: float = 240.0) -> None:
+        """Spawn every replica, then wait for all of them to serve."""
+        for h in self._replicas:
+            self._spawn(h)
+        for h in self._replicas:
+            self._wait_ready(h, ready_timeout_s)
+
+    def _spawn(self, h: ReplicaHandle) -> None:
+        h.gen += 1
+        gdir = os.path.join(h.workdir, f"gen{h.gen}")
+        os.makedirs(gdir, exist_ok=True)
+        h.port_file = os.path.join(gdir, "port")
+        h.journal_path = os.path.join(gdir, "journal.jsonl")
+        h.log_path = os.path.join(gdir, "replica.log")
+        h.port = None
+        h.alive = False
+        h.draining = False
+        h.inflight = {}
+        env = dict(os.environ)
+        env.update(self.env)
+        env.update({
+            "TDT_HTTP_PORT": "0",           # ephemeral: N replicas, one host
+            "TDT_HTTP_PORT_FILE": h.port_file,
+            "TDT_JOURNAL_DIR": gdir,
+        })
+        h._log_f = open(h.log_path, "ab")
+        h.proc = subprocess.Popen(
+            [sys.executable, "-m", "triton_dist_tpu.fleet.replica"],
+            env=env, stdout=h._log_f, stderr=subprocess.STDOUT,
+        )
+        tdt_log(f"[fleet] spawned replica {h.idx} gen{h.gen} pid={h.proc.pid}")
+
+    def _wait_ready(self, h: ReplicaHandle, timeout_s: float) -> None:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if h.proc.poll() is not None:
+                raise RuntimeError(
+                    f"replica {h.idx} exited rc={h.proc.returncode} during "
+                    f"boot; see {h.log_path}"
+                )
+            if h.port is None:
+                try:
+                    with open(h.port_file, "r", encoding="utf-8") as f:
+                        h.port = int(f.read().strip())
+                except (OSError, ValueError):
+                    time.sleep(0.1)
+                    continue
+            try:
+                st = self._http(h, "/fleet/status")
+            except OSError:
+                time.sleep(0.1)
+                continue
+            if st.get("ready"):
+                h.alive = True
+                self._alive_gauge()
+                tdt_log(f"[fleet] replica {h.idx} ready on port {h.port}")
+                return
+            time.sleep(0.1)
+        raise TimeoutError(
+            f"replica {h.idx} not ready after {timeout_s}s; see {h.log_path}"
+        )
+
+    # ----------------------------------------------------------------- http
+    def _http(self, h: ReplicaHandle, path: str, body=None,
+              timeout_s: float | None = None):
+        data = None if body is None else json.dumps(body).encode()
+        req = urllib.request.Request(
+            h.url(path), data=data,
+            headers={"Content-Type": "application/json"},
+            method="GET" if data is None else "POST",
+        )
+        with urllib.request.urlopen(
+            req, timeout=self.request_timeout_s if timeout_s is None else timeout_s
+        ) as r:
+            return json.loads(r.read().decode())
+
+    # ------------------------------------------------------------ placement
+    def submit(self, prompt, max_new: int, priority: int = 1,
+               on_token=None, on_finish=None) -> FleetRequest:
+        """Place one request on the fleet. Never rejects: with no eligible
+        or accepting replica it parks in the router queue and places at a
+        later :meth:`pump`."""
+        fr = FleetRequest(self._next_id, prompt, max_new, priority,
+                          on_token=on_token, on_finish=on_finish)
+        self._next_id += 1
+        self._requests.append(fr)
+        telemetry.inc("tdt_fleet_requests_total")
+        if not self._try_place(fr):
+            self._park(fr)
+        return fr
+
+    def _park(self, fr: FleetRequest) -> None:
+        self._pending.append(fr)
+        telemetry.set_gauge(
+            "tdt_fleet_pending_requests", float(len(self._pending))
+        )
+
+    def _eligible(self) -> list[ReplicaHandle]:
+        return [h for h in self._replicas if h.alive and not h.draining]
+
+    def _first_block_key(self, prompt: list[int]) -> str:
+        head = prompt[: self.block_size] if len(prompt) >= self.block_size \
+            else prompt
+        hsh = hashlib.sha1()
+        for t in head:
+            hsh.update(int(t).to_bytes(8, "little", signed=True))
+        return hsh.hexdigest()
+
+    def _try_place(self, fr: FleetRequest) -> bool:
+        """Probe, rank, and send to the best accepting replica. False when
+        nothing is eligible or everything rejected (shed / KV pressure)."""
+        infos = []
+        for h in self._eligible():
+            try:
+                infos.append((h, self._http(
+                    h, "/fleet/placement", {"prompt": fr.prompt}
+                )))
+            except OSError:
+                self._on_replica_failure(h, "death")
+        if not infos:
+            return False
+        ranked, reason, hit = self._rank(fr, infos)
+        for i, h in enumerate(ranked):
+            try:
+                if self._send(fr, h):
+                    fr.placed_reason = reason if i == 0 else "spill"
+                    self._note_placement(fr.placed_reason, hit and i == 0)
+                    return True
+            except OSError:
+                self._on_replica_failure(h, "death")
+        return False
+
+    def _rank(self, fr: FleetRequest, infos) -> tuple[list, str, bool]:
+        """Order candidate replicas best-first and name the policy that
+        picked the head: affinity > sticky > load (round-robin tiebreak).
+        ``hit`` is whether the head holds a warm prefix for this prompt."""
+        def load_key(item):
+            h, info = item
+            est = info.get("est_wait_s")
+            return (
+                est if est is not None else 0.0,
+                info.get("backlog_tokens", 0),
+                info.get("queue_depth", 0),
+                (h.idx - self._rr) % len(self._replicas),
+            )
+
+        by_load = sorted(infos, key=load_key)
+        self._rr = (self._rr + 1) % len(self._replicas)
+        key = self._first_block_key(fr.prompt)
+        chosen = None
+        reason = "load"
+        if self.affinity:
+            warm_h, warm_info = max(
+                infos, key=lambda item: item[1].get("warm_blocks", 0)
+            )
+            if warm_info.get("warm_blocks", 0) > 0:
+                chosen, reason = warm_h, "affinity"
+            else:
+                home = self._prefix_home.get(key)
+                for h, _ in infos:
+                    if h.idx == home:
+                        chosen, reason = h, "sticky"
+                        break
+        if chosen is None:
+            chosen = by_load[0][0]
+        self._prefix_home[key] = chosen.idx
+        ranked = [chosen] + [h for h, _ in by_load if h is not chosen]
+        warm = {h.idx: info.get("warm_blocks", 0) for h, info in infos}
+        return ranked, reason, warm.get(chosen.idx, 0) > 0
+
+    def _note_placement(self, reason: str, hit: bool) -> None:
+        self._placements += 1
+        if hit:
+            self._prefix_hits += 1
+            telemetry.inc("tdt_fleet_prefix_hits_total")
+        telemetry.inc("tdt_fleet_placements_total", reason=reason)
+        telemetry.set_gauge(
+            "tdt_fleet_prefix_hit_rate",
+            self._prefix_hits / self._placements,
+        )
+
+    def _send(self, fr: FleetRequest, h: ReplicaHandle) -> bool:
+        """Admit ``fr`` on ``h`` (resume when it carries history). True on
+        queued; False on a replica-side reject. OSError propagates."""
+        seed = fr._seed if len(fr._seed) > len(fr.tokens) else fr.tokens
+        body = {
+            "prompt": fr.prompt, "max_new": fr.max_new,
+            "priority": fr.priority,
+        }
+        if seed:
+            body["tokens"] = list(seed)
+            resp = self._http(h, "/fleet/resume", body)
+        else:
+            resp = self._http(h, "/fleet/submit", body)
+        if resp.get("state") != "queued":
+            return False
+        fr.replica = h.idx
+        fr.remote_id = int(resp["req_id"])
+        h.inflight[fr.remote_id] = fr
+        return True
+
+    # ------------------------------------------------------------- delivery
+    def _deliver(self, fr: FleetRequest, token: int) -> None:
+        fr.tokens.append(int(token))
+        telemetry.inc("tdt_fleet_tokens_total")
+        if fr.on_token is not None:
+            fr.on_token(fr, int(token), len(fr.tokens) - 1)
+
+    def _finish(self, fr: FleetRequest, reason: str | None) -> None:
+        fr.done = True
+        fr.finish_reason = reason or "ok"
+        fr.replica = None
+        fr.remote_id = None
+        if fr.on_finish is not None:
+            fr.on_finish(fr)
+
+    def pump(self) -> bool:
+        """One router iteration: detect dead replicas (migrating their
+        work), poll every live replica's streams once, retry the pending
+        queue. Returns True when anything progressed."""
+        worked = False
+        for h in self._replicas:
+            if not h.alive:
+                continue
+            if h.proc is not None and h.proc.poll() is not None:
+                self._on_replica_failure(h, "death")
+                worked = True
+                continue
+            worked = self._poll_replica(h) or worked
+        if self._pending:
+            still = []
+            for fr in self._pending:
+                if self._try_place(fr):
+                    worked = True
+                else:
+                    still.append(fr)
+            self._pending = still
+            telemetry.set_gauge(
+                "tdt_fleet_pending_requests", float(len(self._pending))
+            )
+        return worked
+
+    def _poll_replica(self, h: ReplicaHandle) -> bool:
+        if not h.inflight:
+            return False
+        try:
+            resp = self._http(h, "/fleet/stream", {
+                "reqs": [[rid, len(fr.tokens)]
+                         for rid, fr in h.inflight.items()],
+            })
+        except OSError:
+            self._on_replica_failure(h, "death")
+            return True
+        worked = False
+        for rid, fr in list(h.inflight.items()):
+            st = resp.get("streams", {}).get(str(rid))
+            if not st:
+                continue
+            for t in st["tokens"]:
+                self._deliver(fr, t)
+                worked = True
+            if st["done"]:
+                del h.inflight[rid]
+                self._finish(fr, st["reason"])
+                worked = True
+        return worked
+
+    def serve_all(self, timeout_s: float = 600.0, poll_s: float = 0.01) -> None:
+        """Pump until every submitted request has finished."""
+        deadline = time.monotonic() + timeout_s
+        while any(not fr.done for fr in self._requests):
+            if time.monotonic() > deadline:
+                left = [fr.fleet_id for fr in self._requests if not fr.done]
+                raise TimeoutError(f"fleet requests not done: {left}")
+            if not self.pump():
+                time.sleep(poll_s)
+
+    # ------------------------------------------------------------- migration
+    def _on_replica_failure(self, h: ReplicaHandle, reason: str) -> None:
+        """A replica stopped answering (or its process died): take it out
+        of rotation and journal-replay-migrate its in-flight requests."""
+        if not h.alive:
+            return
+        h.alive = False
+        h.draining = False
+        telemetry.inc("tdt_fleet_replica_failures_total", reason=reason)
+        self._alive_gauge()
+        tdt_log(f"[fleet] replica {h.idx} lost ({reason}); migrating "
+                f"{len(h.inflight)} in-flight request(s)", level="warn")
+        records = RequestJournal.read(h.journal_path)
+        self._migrate_inflight(h, records, reason=reason, cancel_donor=False)
+
+    def _migrate_inflight(self, h: ReplicaHandle, records: list[dict],
+                          reason: str, cancel_donor: bool) -> None:
+        """Move every in-flight request off ``h`` using its journal.
+
+        The resume seed is the LONGER of the journaled history (may lead
+        delivery: the router's poll lags the loop) and the delivered
+        history (may lead the journal: fsync batching). Greedy determinism
+        makes the shorter one a strict prefix of the longer, so seeding
+        the longer is always safe and always byte-exact."""
+        state = RequestJournal.replay(records)
+        moved = list(h.inflight.items())
+        h.inflight = {}
+        for rid, fr in moved:
+            rr = state.get(rid)
+            jt = [int(t) for t in rr.tokens] if rr is not None else []
+            if rr is not None and rr.done:
+                # Finished on the donor before it went away: the journal
+                # fsyncs every finish, so the full stream is durable —
+                # complete from the journal, nothing to re-place.
+                for t in jt[len(fr.tokens):]:
+                    self._deliver(fr, t)
+                telemetry.inc("tdt_fleet_migrations_total",
+                              reason=f"{reason}_journal_complete")
+                self._finish(fr, rr.finish_reason)
+                continue
+            fr._seed = jt if len(jt) > len(fr.tokens) else list(fr.tokens)
+            fr.replica = None
+            fr.remote_id = None
+            fr.migrations += 1
+            telemetry.inc("tdt_fleet_migrations_total", reason=reason)
+            if cancel_donor:
+                try:
+                    self._http(h, "/fleet/cancel", {"req_id": rid})
+                except OSError:
+                    pass
+            if not self._try_place(fr):
+                self._park(fr)
+
+    # ------------------------------------------------------- rolling rebuild
+    def drain_replica(self, idx: int, drained_timeout_s: float = 120.0) -> None:
+        """Take replica ``idx`` out of rotation without losing work: flip
+        it to drain mode, catch up its streams, migrate its in-flight to
+        the other replicas, and wait until it holds nothing. Other
+        replicas keep streaming throughout (the wait loops pump)."""
+        h = self._replicas[idx]
+        if not h.alive:
+            return
+        try:
+            self._http(h, "/fleet/drain")
+        except OSError:
+            self._on_replica_failure(h, "death")
+            return
+        h.draining = True
+        # Catch up whatever the replica already buffered, then snapshot its
+        # journal and hand the remainder to the survivors. The donor is no
+        # longer polled for these requests, so its post-snapshot tokens are
+        # discarded — the target regenerates them byte-identically.
+        self._poll_replica(h)
+        if h.inflight:
+            try:
+                records = self._http(h, "/fleet/journal")["records"]
+            except OSError:
+                self._on_replica_failure(h, "death")
+                return
+            self._migrate_inflight(h, records, reason="drain",
+                                   cancel_donor=True)
+        deadline = time.monotonic() + drained_timeout_s
+        while time.monotonic() < deadline:
+            try:
+                st = self._http(h, "/fleet/status")
+            except OSError:
+                self._on_replica_failure(h, "death")
+                return
+            if st.get("drained"):
+                return
+            self.pump()
+            time.sleep(0.02)
+        raise TimeoutError(f"replica {idx} did not drain; see {h.log_path}")
+
+    def rebuild_replica(self, idx: int, ready_timeout_s: float = 240.0) -> None:
+        """drain → stop → respawn (fresh journal generation) → rejoin."""
+        h = self._replicas[idx]
+        self.drain_replica(idx)
+        self._terminate(h)
+        self._spawn(h)
+        # Keep the fleet streaming while the newcomer boots.
+        deadline = time.monotonic() + ready_timeout_s
+        while not h.alive:
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"replica {idx} rebuild not ready")
+            self.pump()
+            try:
+                self._wait_ready(h, 0.5)
+            except TimeoutError:
+                continue
+        telemetry.inc("tdt_fleet_rebuilds_total")
+
+    def rolling_rebuild(self, ready_timeout_s: float = 240.0) -> int:
+        """Rebuild every live replica one at a time — the no-downtime
+        deploy path for backend or tune-cache changes (set the new config
+        via ``self.env`` first). Returns the number rebuilt."""
+        n = 0
+        for h in list(self._replicas):
+            if not h.alive:
+                continue
+            self.rebuild_replica(h.idx, ready_timeout_s=ready_timeout_s)
+            n += 1
+        return n
+
+    # ------------------------------------------------------------- lifecycle
+    def kill(self, idx: int) -> None:
+        """SIGKILL a replica (chaos/testing): the next :meth:`pump` detects
+        the death and migrates its in-flight work."""
+        h = self._replicas[idx]
+        if h.proc is not None:
+            h.proc.kill()
+            h.proc.wait()
+
+    def _terminate(self, h: ReplicaHandle, timeout_s: float = 30.0) -> None:
+        h.alive = False
+        self._alive_gauge()
+        if h.proc is not None and h.proc.poll() is None:
+            h.proc.terminate()
+            try:
+                h.proc.wait(timeout=timeout_s)
+            except subprocess.TimeoutExpired:
+                h.proc.kill()
+                h.proc.wait()
+        if h._log_f is not None:
+            h._log_f.close()
+            h._log_f = None
+
+    def shutdown(self) -> None:
+        """Stop every replica process. In-flight state stays journaled on
+        disk (each replica drains on SIGTERM before exiting)."""
+        for h in self._replicas:
+            self._terminate(h)
+
+    def _alive_gauge(self) -> None:
+        telemetry.set_gauge(
+            "tdt_fleet_replicas_alive",
+            float(sum(1 for h in self._replicas if h.alive)),
+        )
+
+    def status(self) -> dict:
+        return {
+            "replicas": [
+                {
+                    "idx": h.idx, "alive": h.alive, "draining": h.draining,
+                    "gen": h.gen, "port": h.port,
+                    "inflight": len(h.inflight),
+                    "pid": None if h.proc is None else h.proc.pid,
+                }
+                for h in self._replicas
+            ],
+            "pending": len(self._pending),
+            "requests": len(self._requests),
+            "done": sum(1 for fr in self._requests if fr.done),
+            "placements": self._placements,
+            "prefix_hits": self._prefix_hits,
+            "affinity": self.affinity,
+        }
+
+    # --------------------------------------------------------- context mgmt
+    def __enter__(self) -> "Router":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()

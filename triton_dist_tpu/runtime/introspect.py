@@ -43,8 +43,20 @@ READ; the only write anywhere is the process's own instrumentation.
 Enable with ``TDT_HTTP_PORT=<port>`` (``InferenceServer`` calls
 :func:`maybe_start` at construction; unset/empty means disabled — the
 default, since an open debug port is opt-in). Port 0 binds an ephemeral
-port (tests); the bound port is on the returned handle and in the startup
-log line. One endpoint per process: repeated starts return the first.
+port — the mode every co-hosted process should use: N replicas on one
+host with a fixed ``TDT_HTTP_PORT`` collide, and ``maybe_start`` turns
+the bind failure into "no endpoint at all". The ACTUAL bound port is
+authoritative everywhere the handle surfaces it: ``.port``, ``url()``,
+the startup log line, and — for a parent process that needs to discover
+the port of a child it spawned with ``TDT_HTTP_PORT=0`` — the
+``TDT_HTTP_PORT_FILE`` drop file (the bound port written atomically, the
+fleet router's replica-discovery contract). One endpoint per process:
+repeated starts return the first.
+
+Extension routes: subsystems register JSON handlers with
+:func:`register_json_route` (exact path, GET and/or POST) — the fleet
+replica control plane (``fleet/replica.py``) mounts its ``/fleet/*``
+routes this way instead of running a second HTTP server per process.
 """
 
 from __future__ import annotations
@@ -61,6 +73,11 @@ _LOCK = threading.Lock()
 _SERVER: "IntrospectionServer | None" = None
 _HEALTH_PROVIDER = None
 _REQUESTS_PROVIDER = None
+#: Exact-path JSON extension routes: path -> fn(method, query, body) ->
+#: (status_code, json_safe_obj). Registered by subsystems (fleet replica
+#: control plane); handlers run on endpoint threads, so they must only
+#: touch thread-safe state.
+_JSON_ROUTES: dict = {}
 
 #: Default item cap for the list-valued sections of /snapshot and /traces;
 #: override per request with ``?limit=N`` (``limit=0`` = uncapped).
@@ -83,6 +100,32 @@ def set_requests_provider(fn) -> None:
     None to clear."""
     global _REQUESTS_PROVIDER
     _REQUESTS_PROVIDER = fn
+
+
+def register_json_route(path: str, fn) -> None:
+    """Mount ``fn(method, query, body) -> (code, obj)`` at the exact
+    ``path`` (e.g. ``"/fleet/submit"``); ``body`` is the parsed JSON POST
+    payload (None on GET). Pass ``fn=None`` to unmount. Handlers run on
+    endpoint threads — they must only read thread-safe state or go through
+    locks of their own."""
+    with _LOCK:
+        if fn is None:
+            _JSON_ROUTES.pop(path, None)
+        else:
+            _JSON_ROUTES[path] = fn
+
+
+def clear_json_routes(prefix: str = "") -> None:
+    """Unmount every extension route whose path starts with ``prefix``
+    (default: all of them). Shutdown hygiene for the owning subsystem."""
+    with _LOCK:
+        for path in [p for p in _JSON_ROUTES if p.startswith(prefix)]:
+            del _JSON_ROUTES[path]
+
+
+def _json_route(path: str):
+    with _LOCK:
+        return _JSON_ROUTES.get(path)
 
 
 def _mesh_section() -> dict:
@@ -237,11 +280,34 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                     200, tracing.to_chrome(tid, kernel_traces="kernel=1" in query)
                 )
             else:
+                fn = _json_route(path)
+                if fn is not None:
+                    self._send_json(*fn("GET", query, None))
+                    return
                 self._send_json(404, {
                     "error": f"unknown route {path!r}",
                     "routes": ["/metrics", "/healthz", "/requests",
                                "/snapshot", "/traces", "/traces/<id|last>"],
                 })
+        except Exception as e:  # a debug endpoint must never kill its thread
+            try:
+                self._send_json(500, {"error": f"{type(e).__name__}: {e}"})
+            except Exception:
+                pass
+
+    def do_POST(self):  # noqa: N802 - BaseHTTPRequestHandler contract
+        path, _, query = self.path.partition("?")
+        try:
+            fn = _json_route(path)
+            if fn is None:
+                self._send_json(404, {"error": f"unknown route {path!r}"})
+                return
+            n = int(self.headers.get("Content-Length") or 0)
+            raw = self.rfile.read(n) if n else b""
+            body = json.loads(raw.decode()) if raw else None
+            self._send_json(*fn("POST", query, body))
+        except json.JSONDecodeError as e:
+            self._send_json(400, {"error": f"bad JSON body: {e}"})
         except Exception as e:  # a debug endpoint must never kill its thread
             try:
                 self._send_json(500, {"error": f"{type(e).__name__}: {e}"})
@@ -255,12 +321,35 @@ class IntrospectionServer:
     def __init__(self, port: int):
         self._httpd = http.server.ThreadingHTTPServer(("127.0.0.1", port), _Handler)
         self._httpd.daemon_threads = True
+        #: The ACTUAL bound port — with ``port=0`` the kernel picks an
+        #: ephemeral one, so this is the only trustworthy value (never
+        #: echo the requested port back to anyone).
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, name="tdt-introspect", daemon=True
         )
         self._thread.start()
+        self._write_port_file()
         tdt_log(f"[introspect] serving on http://127.0.0.1:{self.port}")
+
+    def _write_port_file(self) -> None:
+        """Drop the bound port where a parent can find it
+        (``TDT_HTTP_PORT_FILE``): a process spawned with ``TDT_HTTP_PORT=0``
+        has no other way to report which port it actually got. Atomic
+        write-temp + replace so the parent never reads a torn file."""
+        import os
+
+        path = os.environ.get("TDT_HTTP_PORT_FILE", "").strip()
+        if not path:
+            return
+        try:
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w", encoding="utf-8") as f:
+                f.write(str(self.port))
+            os.replace(tmp, path)
+        except OSError as e:  # discovery is best-effort, serving is not
+            tdt_log(f"[introspect] port file {path!r} not written: {e}",
+                    level="warn")
 
     def url(self, path: str = "/") -> str:
         return f"http://127.0.0.1:{self.port}{path}"

@@ -213,6 +213,12 @@ def counter_total(name: str) -> float:
         return sum(v for (n, _), v in _COUNTERS.items() if n == name)
 
 
+def gauge_value(name: str, /, **labels) -> float | None:
+    """Current value of one labeled gauge (None when never set)."""
+    with _LOCK:
+        return _GAUGES.get(_key(name, labels))
+
+
 # ------------------------------------------------------ kernel-trace collector
 
 

@@ -234,6 +234,23 @@ class PrefixIndex:
             node = child
         return chain
 
+    def match_blocks(self, prompt: list[int]) -> int:
+        """Longest indexed full-block prefix of ``prompt``, WITHOUT touching
+        LRU stamps or taking refs — the fleet placement-hint probe. Safe to
+        call from an endpoint thread: the walk only does dict lookups on
+        the trie (concurrent registration may make the answer one block
+        stale, which a *hint* can tolerate)."""
+        bs = self.block_size
+        node = self._root
+        n = 0
+        for i in range(len(prompt) // bs):
+            child = node.children.get(tuple(prompt[i * bs:(i + 1) * bs]))
+            if child is None:
+                break
+            n += 1
+            node = child
+        return n
+
     def register(self, prompt: list[int], blocks: list[int]) -> int:
         """Index a finished prefill's FULL prompt blocks (``len(prompt) //
         block_size`` of them — decode writes only ever land past that
@@ -462,18 +479,24 @@ class Scheduler:
     def submit(self, prompt, max_new: int, arrival_time_s: float = 0.0,
                on_token=None, on_finish=None, now_s: float | None = None,
                priority: int = 1, ttft_deadline_s: float | None = None,
-               deadline_s: float | None = None) -> Request:
+               deadline_s: float | None = None,
+               tokens=None) -> Request:
         """Admission-check and enqueue one request (FCFS). Returns the
         request handle; a rejected request comes back with
         ``state=REJECTED`` and ``reject_reason`` set — it is NOT queued.
         Deadlines default to ``TDT_DEADLINE_TTFT_S`` / ``TDT_DEADLINE_TOTAL_S``
-        when not given (unset/non-positive env = no bound)."""
+        when not given (unset/non-positive env = no bound). ``tokens``
+        seeds an already-generated history (fleet migration): the request
+        enters the queue with it attached, so the join sweep re-prefills
+        from ``prompt + tokens`` — seeded before enqueue, never racing the
+        serving loop."""
         prompt = [int(t) for t in prompt]
         req = Request(
             req_id=self._new_id(), prompt=prompt, max_new=int(max_new),
             arrival_time_s=float(arrival_time_s),
             on_token=on_token, on_finish=on_finish,
             priority=int(priority),
+            tokens=[int(t) for t in tokens] if tokens else [],
             ttft_deadline_s=(
                 _env_deadline("TDT_DEADLINE_TTFT_S")
                 if ttft_deadline_s is None else float(ttft_deadline_s)

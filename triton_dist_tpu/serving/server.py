@@ -231,6 +231,9 @@ class InferenceServer:
         self._shutdown = False
         #: Set by the SIGTERM handler; :meth:`run` converts it into a drain.
         self._shutdown_requested = False
+        #: Drain mode (fleet rolling rebuild): new submits bounce, admitted
+        #: work keeps running, the process stays up. See :meth:`drain_begin`.
+        self._draining = False
         from triton_dist_tpu.runtime import introspect
 
         self._introspect = introspect.maybe_start()
@@ -240,8 +243,9 @@ class InferenceServer:
     def _health_info(self) -> dict:
         shedding = self.scheduler.shedding(self._now())
         return {
-            "ready": not (shedding or self._shutdown),
+            "ready": not (shedding or self._shutdown or self._draining),
             "shedding": shedding,
+            "draining": self._draining,
             "shutting_down": self._shutdown,
             "backend": self.engine.backend,
             "preferred_backend": self._preferred_backend,
@@ -361,6 +365,107 @@ class InferenceServer:
         if ok and self._journal is not None:
             self._journal.append("cancel", req_id=int(req_id))
         return ok
+
+    def resume(self, prompt, max_new: int, tokens, on_token=None,
+               on_finish=None, priority: int = 1,
+               deadline_s: float | None = None) -> Request:
+        """Admit a request MID-STREAM: ``tokens`` is the history another
+        server already streamed for it (journal-replay migration — the
+        fleet router moving an in-flight request off a dead or draining
+        replica). Admission runs normally (fresh local req_id, KV budget,
+        shedding); on admit the history is pre-seeded, so the join sweep
+        re-prefills from ``prompt + tokens`` and decoding continues at
+        position ``len(tokens)`` — seeded tokens are NOT re-streamed to the
+        callbacks (deterministic greedy regeneration of any suffix the
+        donor generated past the seed keeps the stream byte-identical).
+        The seed is journaled as a position-0 chunk so THIS server's
+        journal is self-contained for the next migration or crash."""
+        toks = [int(t) for t in tokens][: int(max_new)]
+        req = self.scheduler.submit(
+            prompt, max_new, on_token=on_token, on_finish=on_finish,
+            now_s=self._now(), priority=priority, deadline_s=deadline_s,
+            tokens=toks,
+        )
+        if req.state is not RequestState.QUEUED:
+            return req
+        telemetry.inc("tdt_serving_resumed_total")
+        if self._journal is not None:
+            self._journal.append(
+                "submit", req_id=req.req_id, prompt=req.prompt,
+                max_new=req.max_new, arrival_time_s=req.arrival_time_s,
+                priority=req.priority, ttft_deadline_s=req.ttft_deadline_s,
+                deadline_s=req.deadline_s,
+            )
+            if toks:
+                self._journal.append(
+                    "chunk", req_id=req.req_id, start=0, tokens=toks
+                )
+        return req
+
+    # ------------------------------------------------------------ fleet hooks
+    def placement_info(self, prompt) -> dict:
+        """Placement hint for a fleet router: how warm is this replica for
+        ``prompt`` (longest indexed full-block prefix) and how loaded is it
+        (EWMA-projected wait + backlog). Read-only and thread-safe — the
+        prefix probe never touches LRU stamps — so the introspect endpoint
+        can serve it off the loop thread."""
+        prompt = [int(t) for t in prompt]
+        warm = 0
+        if self.kv_ledger is not None and self.kv_ledger.prefix_reuse:
+            warm = self.kv_ledger.prefix.match_blocks(prompt)
+        est = self.scheduler.est_wait_s()
+        return {
+            "warm_blocks": warm,
+            "block_size": self.block_size if self.paged else 0,
+            "est_wait_s": None if est is None else round(est, 6),
+            "backlog_tokens": self.scheduler.backlog_tokens(),
+            "queue_depth": self.scheduler.queue_depth(),
+            "occupancy": self.scheduler.occupancy(),
+            "num_slots": self.num_slots,
+            "backend": self.engine.backend,
+            "degraded": self.engine.backend != self._preferred_backend,
+            "draining": self._draining,
+            "shedding": self.scheduler.shedding(self._now()),
+            "ready": not (self._draining or self._shutdown),
+        }
+
+    def drain_begin(self) -> None:
+        """Enter drain mode (rolling rebuild): reject new submits with
+        reason ``shutting_down`` while admitted work keeps running and the
+        process (journal, endpoint) stays up — :meth:`drained` flips once
+        the queue and every slot are empty. Unlike :meth:`shutdown` this is
+        NOT terminal: the replica can still export its journal and serve
+        its in-flight streams while the router migrates them away."""
+        if self._draining:
+            return
+        self._draining = True
+        self.scheduler.shutting_down = True
+        telemetry.inc("tdt_serving_drains_total")
+        telemetry.emit(
+            "serving_drain_begin",
+            in_flight=self.scheduler.occupancy(),
+            queued=self.scheduler.queue_depth(),
+        )
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    @property
+    def drained(self) -> bool:
+        """True once drain mode holds no admitted work (queue + slots empty)."""
+        return (
+            self._draining
+            and self.scheduler.occupancy() == 0
+            and self.scheduler.queue_depth() == 0
+        )
+
+    def journal_records(self) -> list[dict]:
+        """Flush and export the attached journal's records (the migration
+        donor's half of journal-replay migration). Empty without a journal."""
+        if self._journal is None:
+            return []
+        return self._journal.read_records()
 
     # ------------------------------------------------------------------- loop
     def step(self) -> bool:

@@ -1098,11 +1098,21 @@ def bench_serving_fleet(on_tpu):
     (``affinity=False``), then runs a rolling rebuild with a fresh burst
     in flight. Replicas always run the CPU test-dense model (the section
     measures the router — placement probes, positional polling, migration
-    — not model FLOPs; the per-chip sections above cover those). Gated by
-    check_bench_regression.py: ``serving_fleet_tokens_per_s`` (higher
-    better). The hit rates are informational placement-policy counters —
-    affinity must be >= the no-affinity baseline, which the fleet tests
-    assert deterministically."""
+    — not model FLOPs; the per-chip sections above cover those). A third
+    arm re-runs the affinity workload with tracing and the flight
+    recorder off — ``TDT_TRACE_SAMPLE=0`` on both router and replicas
+    plus ``TDT_FLIGHT_RECORDER=""``, metrics/fences left ON so the pair
+    isolates exactly the span + flight-record tax — making the
+    observability overhead a measured number, not a guess. Timed arms
+    take the best of several bursts — the single-burst wall is
+    poll-cadence noise at this scale, and best-of keeps the on/off pair
+    comparable. Gated by
+    check_bench_regression.py: ``serving_fleet_tokens_per_s`` and
+    ``serving_fleet_notrace_tokens_per_s`` (higher better);
+    ``serving_fleet_trace_overhead_pct`` is informational. The hit rates
+    are informational placement-policy counters — affinity must be >= the
+    no-affinity baseline, which the fleet tests assert deterministically."""
+    import os
     import shutil
     import tempfile
     import time
@@ -1124,32 +1134,59 @@ def bench_serving_fleet(on_tpu):
     pa = [(5 * j + 3) % 256 for j in range(block)]
     pb = [(11 * j + 7) % 256 for j in range(block)]
     wave1 = [(pa + [1], 8), (pb + [2], 8)]
-    wave2 = [(p + [i + 3], 8) for i, p in enumerate([pa, pb, pa, pb, pa, pb])]
+    # 14 new tokens per request — the most that fits the replica default
+    # TDT_REPLICA_MAX_LEN=32 after the 17-token prompt: a timed burst then
+    # spans many poll cycles, so one cycle of jitter stops dominating.
+    wave2 = [(p + [i + 3], 14) for i, p in enumerate([pa, pb, pa, pb, pa, pb])]
     out = {
         "serving_fleet_replicas": 2,
         "serving_fleet_requests": len(wave1) + len(wave2),
         "serving_fleet_prefix_len": block,
     }
 
-    for label, affinity in (("affinity", True), ("noaffinity", False)):
+    # (label, affinity, extra replica env): the notrace arm replays the
+    # affinity workload with span tracing + the flight recorder off on
+    # BOTH sides of the wire (TDT_TRACE_SAMPLE=0 in the router process
+    # too — the sampling decision is made at the trace's origin and
+    # travels in the carrier, so an unsampled router means unsampled
+    # replicas). Metrics stay on, so affinity-vs-notrace isolates
+    # exactly the tracing + flight-record tax.
+    notrace = {"TDT_TRACE_SAMPLE": "0", "TDT_FLIGHT_RECORDER": ""}
+    arms = (
+        ("affinity", True, {}),
+        ("noaffinity", False, {}),
+        ("notrace", True, notrace),
+    )
+    for label, affinity, extra_env in arms:
         workdir = tempfile.mkdtemp(prefix=f"tdt_bench_fleet_{label}_")
+        prev_sample = os.environ.get("TDT_TRACE_SAMPLE")
+        if label == "notrace":
+            os.environ["TDT_TRACE_SAMPLE"] = "0"
         try:
-            with Router(2, workdir, env=env, affinity=affinity) as router:
+            arm_env = dict(env, **extra_env)
+            with Router(2, workdir, env=arm_env, affinity=affinity) as router:
                 router.start()
                 for p, g in wave1:
                     router.submit(p, g)
                 router.serve_all(timeout_s=180)
-                t0 = time.perf_counter()
-                frs = [router.submit(p, g) for p, g in wave2]
-                router.serve_all(timeout_s=180)
-                wall = time.perf_counter() - t0
+                # Best-of-3 timed bursts: a single sub-second burst is
+                # dominated by poll-cadence noise (±20% run to run), which
+                # would drown the tracing-vs-notrace comparison this pair
+                # exists for.
+                best = 0.0
+                for _ in range(3):
+                    t0 = time.perf_counter()
+                    frs = [router.submit(p, g) for p, g in wave2]
+                    router.serve_all(timeout_s=180)
+                    wall = time.perf_counter() - t0
+                    toks = sum(len(fr.tokens) for fr in frs)
+                    best = max(best, toks / wall)
                 st = router.status()
                 out[f"serving_fleet_{label}_hit_rate"] = round(
                     st["prefix_hits"] / max(st["placements"], 1), 3
                 )
-                if affinity:
-                    toks = sum(len(fr.tokens) for fr in frs)
-                    out["serving_fleet_tokens_per_s"] = round(toks / wall, 1)
+                if label == "affinity":
+                    out["serving_fleet_tokens_per_s"] = round(best, 1)
                     # Rolling rebuild with a burst in flight: the zero-reject
                     # guarantee (serve_all raises on anything left behind).
                     burst = [router.submit(p, g) for p, g in wave2[:4]]
@@ -1158,8 +1195,21 @@ def bench_serving_fleet(on_tpu):
                     out["serving_fleet_rebuild_requests_done"] = sum(
                         1 for fr in burst if fr.done
                     )
+                elif label == "notrace":
+                    out["serving_fleet_notrace_tokens_per_s"] = round(best, 1)
         finally:
             shutil.rmtree(workdir, ignore_errors=True)
+            if label == "notrace":
+                if prev_sample is None:
+                    os.environ.pop("TDT_TRACE_SAMPLE", None)
+                else:
+                    os.environ["TDT_TRACE_SAMPLE"] = prev_sample
+    traced = out.get("serving_fleet_tokens_per_s")
+    bare = out.get("serving_fleet_notrace_tokens_per_s")
+    if traced and bare:
+        out["serving_fleet_trace_overhead_pct"] = round(
+            100.0 * (bare - traced) / bare, 1
+        )
     return out
 
 

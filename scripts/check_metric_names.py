@@ -54,7 +54,7 @@ EVENT_FNS = {"emit", "events"}
 #: receivers whose name mentions trace/tracing (``tracing.start_trace``,
 #: ``req.trace.span``, ``self._trace.record``).
 TRACING_FNS = {"span", "record", "point", "start_trace", "root_span",
-               "point_current"}
+               "point_current", "start_remote_trace"}
 
 METRIC_NAME = re.compile(r"^tdt_[a-z0-9]+_[a-z0-9_]+$")
 EVENT_KIND = re.compile(r"^[a-z][a-z0-9_]*$")
@@ -120,17 +120,32 @@ REQUIRED_NAMES = {
     "tdt_ep_expert_load",
     "tdt_ep_dropped_tokens_total",
     "tdt_ep_wire_bytes_total",
+    # fleet observability: cross-process trace propagation, federation,
+    # flight recorder (fleet/router.py, runtime/telemetry.py)
+    "tdt_fleet_trace_propagated_total",
+    "tdt_fleet_trace_fetches_total",
+    "tdt_fleet_http_errors_total",
+    "tdt_fleet_postmortems_total",
+    "tdt_flight_records_total",
     # span names
     "tdt_serving_probe",
     "tdt_serving_restore",
     "tdt_serving_recovery",
+    "tdt_fleet_request",
+    "tdt_fleet_placement",
+    "tdt_fleet_migration",
 }
 
 
-def _is_telemetry_call(node: ast.Call) -> str | None:
+def _is_telemetry_call(node: ast.Call, bare_ok: bool = False) -> str | None:
     """Return the called function name when this is ``telemetry.<fn>(...)``
-    (or an alias whose receiver name contains 'telemetry'), else None."""
+    (or an alias whose receiver name contains 'telemetry'), else None.
+    ``bare_ok`` also accepts receiver-less ``inc(...)`` calls — the registry
+    module instruments itself (the flight recorder's own counter)."""
     fn = node.func
+    if bare_ok and isinstance(fn, ast.Name) and \
+            fn.id in (METRIC_FNS | EVENT_FNS):
+        return fn.id
     if not isinstance(fn, ast.Attribute):
         return None
     recv = fn.value
@@ -192,7 +207,7 @@ def check_file(path: pathlib.Path, seen: set[str] | None = None) -> list[str]:
             elif seen is not None:
                 seen.add(first.value)
             continue
-        fname = _is_telemetry_call(node)
+        fname = _is_telemetry_call(node, bare_ok=path.name == "telemetry.py")
         if fname is None or not node.args:
             continue
         first = node.args[0]

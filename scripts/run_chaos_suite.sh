@@ -22,7 +22,11 @@
 #                       and KV block size (portability)
 #   fleet-migration     SIGKILL one of 3 router-fronted replicas mid-burst ->
 #                       journal-replay migration completes every stream on a
-#                       survivor byte-identically (NEW)
+#                       survivor byte-identically
+#   fleet-postmortem    SIGKILL a replica mid-work -> the router recovers
+#                       which request/slot/span it was executing at death
+#                       from the crash-surviving flight record, no exit
+#                       hook involved (NEW)
 #   observability       chaos arcs stay visible in traces + telemetry
 #
 # The env pins below make the arcs quick and reproducible:
@@ -75,6 +79,8 @@ run_scenario kill-and-recover \
   tests/test_journal.py::test_journal_portability_across_server_shapes "$@"
 run_scenario fleet-migration \
   tests/test_fleet.py::test_fleet_kill_one_of_three_mid_burst "$@"
+run_scenario fleet-postmortem \
+  tests/test_fleet.py::test_fleet_postmortem_flight_record_after_kill "$@"
 run_scenario observability tests/test_telemetry.py tests/test_tracing.py "$@"
 
 echo

@@ -17,6 +17,11 @@ Usage::
     python scripts/tdt_metrics.py watch SRC [-n SECS] [-c COUNT]
                                                     # poll + render counter
                                                     # deltas between polls
+    python scripts/tdt_metrics.py fleet URL [-n SECS] [-c COUNT]
+                                                    # top-like fleet view off a
+                                                    # ROUTER endpoint
+                                                    # (/fleet/topology +
+                                                    # /fleet/metrics)
     python scripts/tdt_metrics.py demo [out.json]   # tiny CPU serve -> live
                                                     # snapshot (smoke check)
 
@@ -219,6 +224,74 @@ def cmd_watch(src: str, interval_s: float, count: int) -> int:
     return 0
 
 
+def cmd_fleet(base: str, interval_s: float, count: int) -> int:
+    """Top-like fleet view off a ROUTER introspection endpoint: one row per
+    replica from ``/fleet/topology`` plus the fleet-summed counters from
+    ``/fleet/metrics?format=json`` (count=1 for a one-shot snapshot)."""
+    import urllib.request
+
+    base = base.rstrip("/")
+    if not base.startswith(("http://", "https://")):
+        print(f"fleet needs a router endpoint URL, got {base!r}",
+              file=sys.stderr)
+        return 2
+
+    def fetch(path: str) -> dict:
+        with urllib.request.urlopen(base + path, timeout=10) as r:
+            return json.load(r)
+
+    prev: dict[str, float] = {}
+    for i in range(count):
+        try:
+            topo = fetch("/fleet/topology")
+            metrics = fetch("/fleet/metrics?format=json")
+        except Exception as e:  # router endpoint down / replica mid-rebuild
+            print(f"[fleet] poll failed: {type(e).__name__}: {e}")
+            time.sleep(interval_s)
+            continue
+        stamp = time.strftime("%H:%M:%S")
+        print(f"[{stamp}] fleet: {len(topo['replicas'])} replica(s), "
+              f"pending={topo['pending']} "
+              f"done={topo['done']}/{topo['requests']} "
+              f"affinity={topo['affinity']}")
+        hdr = (f"  {'idx':>3} {'gen':>3} {'state':<8} {'port':>6} "
+               f"{'infl':>4} {'place':>6} {'hit%':>6} {'est_wait':>9} "
+               f"{'backlog':>8} {'queue':>5}")
+        print(hdr)
+        for rep in topo["replicas"]:
+            state = ("drain" if rep["draining"] else
+                     "up" if rep["alive"] else "DOWN")
+            load = rep.get("load") or {}
+            est = load.get("est_wait_s")
+            print(f"  {rep['idx']:>3} {rep['gen']:>3} {state:<8} "
+                  f"{rep['port'] or '-':>6} {rep['inflight']:>4} "
+                  f"{rep['placements']:>6} {rep['hit_rate'] * 100:>5.1f}% "
+                  f"{'-' if est is None else f'{est:.3f}s':>9} "
+                  f"{load.get('backlog_tokens', '-'):>8} "
+                  f"{load.get('queue_depth', '-'):>5}")
+        if topo.get("postmortems"):
+            print(f"  postmortems: replicas {topo['postmortems']} "
+                  f"(see /fleet/postmortem/<idx>)")
+        # Fleet-summed counters (the replica-label-free series) with deltas.
+        sums = {}
+        for name, entries in metrics.get("counters", {}).items():
+            for e in entries:
+                if "replica" not in e["labels"]:
+                    sums[name + _fmt_labels(e["labels"])] = e["value"]
+        shown = sorted(k for k in sums if k.startswith("tdt_serving_")
+                       or k.startswith("tdt_fleet_"))
+        if shown:
+            print("  fleet counters (summed across replicas):")
+            for k in shown:
+                delta = sums[k] - prev.get(k, 0.0)
+                d = f" (+{delta:g})" if prev and delta else ""
+                print(f"    {k} = {sums[k]:g}{d}")
+        prev = sums
+        if i + 1 < count:
+            time.sleep(interval_s)
+    return 0
+
+
 def cmd_demo(out: str | None) -> int:
     """Serve a few tokens from the tiny test model on the 8-device CPU mesh
     and show the live registry — the zero-to-snapshot smoke path."""
@@ -277,6 +350,19 @@ def main(argv: list[str]) -> int:
                 print(f"unknown watch arg {rest[i]!r}", file=sys.stderr)
                 return 2
         return cmd_watch(argv[1], interval, count)
+    if len(argv) >= 2 and argv[0] == "fleet":
+        interval, count = 2.0, 1
+        rest = argv[2:]
+        i = 0
+        while i < len(rest):
+            if rest[i] == "-n" and i + 1 < len(rest):
+                interval = float(rest[i + 1]); i += 2
+            elif rest[i] == "-c" and i + 1 < len(rest):
+                count = int(rest[i + 1]); i += 2
+            else:
+                print(f"unknown fleet arg {rest[i]!r}", file=sys.stderr)
+                return 2
+        return cmd_fleet(argv[1], interval, count)
     if argv and argv[0] == "demo":
         return cmd_demo(argv[1] if len(argv) > 1 else None)
     print(__doc__, file=sys.stderr)

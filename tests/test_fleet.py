@@ -375,6 +375,54 @@ def test_fleet_affinity_parity_and_rolling_rebuild(engine, tmp_path):
         # rejecting) — every submitted request reached done above.
         assert len(router._pending) == 0
 
+        # One more request against the REBUILT generation (the rebuild
+        # recycled the earlier replicas' span rings and counters — this
+        # gives the fresh fleet served work to observe).
+        fr = router.submit(pa + [99], 4)
+        router.serve_all(timeout_s=180)
+        assert fr.done
+
+        # Federation: the merged /fleet/metrics counter sums must equal
+        # what each replica reports when scraped directly.
+        merged = router.federated_metrics()
+        direct = 0.0
+        for h in router.replicas:
+            snap = _get(h.url("/snapshot?limit=1"))
+            for e in snap["counters"].get("tdt_serving_tokens_total", []):
+                direct += e["value"]
+        tok = merged["counters"]["tdt_serving_tokens_total"]
+        assert "replica" not in tok[0]["labels"]
+        assert tok[0]["value"] == direct > 0
+        assert sum(e["value"] for e in tok[1:]) == direct
+        # Router-local family rides along labeled, never summed in.
+        reqs_series = merged["counters"]["tdt_fleet_requests_total"]
+        assert {e["labels"].get("replica") for e in reqs_series} == {"router"}
+
+        # Topology reflects the rebuilt fleet and the placement tallies.
+        topo = router.topology()
+        assert all(r["gen"] == 2 and r["alive"] for r in topo["replicas"])
+        assert sum(r["placements"] for r in topo["replicas"]) \
+            == router._placements
+        live_loads = [r["load"] for r in topo["replicas"]]
+        assert all(ld is not None and "est_wait_s" in ld for ld in live_loads)
+        # Every placement decision left an audit record with candidates.
+        ring = router.placements()
+        assert ring and all(rec["candidates"] for rec in ring)
+        assert telemetry.counter_value("tdt_fleet_trace_propagated_total") > 0
+
+        # One trace per fleet request, spanning processes: the merged
+        # timeline holds the router span AND the replica's serving chain
+        # under one trace id, cross-process parent link intact.
+        doc = router.fleet_trace(fr.trace.trace_id)
+        xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert len({e["pid"] for e in xs}) >= 2
+        placement_ids = {e["args"]["span_id"] for e in xs
+                         if e["name"] == "tdt_fleet_placement"}
+        serving_roots = [e for e in xs if e["name"] == "tdt_serving_request"]
+        assert serving_roots
+        assert all(e["args"]["parent_id"] in placement_ids
+                   for e in serving_roots)
+
 
 @pytest.mark.slow
 @pytest.mark.chaos
@@ -399,6 +447,7 @@ def test_fleet_kill_one_of_three_mid_burst(engine, tmp_path):
                 time.sleep(0.01)
         victim = max(router.replicas, key=lambda h: len(h.inflight))
         assert victim.inflight                # the kill lands on live work
+        pre_kill_rids = set(victim.inflight)  # remote ids executing at death
         router.kill(victim.idx)
 
         router.serve_all(timeout_s=300)
@@ -409,3 +458,360 @@ def test_fleet_kill_one_of_three_mid_burst(engine, tmp_path):
             assert fr.done
             assert fr.tokens == ref, f"fleet_id={fr.fleet_id} diverged"
             assert streams[fr.fleet_id] == ref   # zero drop / zero dup
+
+        # Postmortem: the dead replica's flight record (read off disk, no
+        # atexit hook — the process died by SIGKILL) names the requests it
+        # was executing at death.
+        pm = router.postmortem(victim.idx)
+        assert pm is not None and pm["reason"] == "death"
+        assert pm["n_records"] > 0 and pm["tail"]
+        assert set(pm["active_requests"]) & pre_kill_rids
+        assert telemetry.counter_value(
+            "tdt_fleet_postmortems_total", reason="death") == 1.0
+
+        # One trace id across the kill: a migrated request's merged
+        # timeline continues on the SURVIVOR — router spans plus the
+        # survivor's serving chain under the same trace, with the
+        # migration marker in between.
+        migrated = [fr for fr in frs if fr.migrations >= 1]
+        assert migrated
+        fr = migrated[0]
+        doc = router.fleet_trace(fr.trace.trace_id)
+        xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert len({e["pid"] for e in xs}) >= 2   # router + survivor
+        names = {e["name"] for e in xs}
+        assert {"tdt_fleet_request", "tdt_fleet_migration",
+                "tdt_serving_request"} <= names
+        placement_ids = {e["args"]["span_id"] for e in xs
+                         if e["name"] == "tdt_fleet_placement"}
+        survivor_roots = [e for e in xs if e["name"] == "tdt_serving_request"]
+        assert all(e["args"]["parent_id"] in placement_ids
+                   for e in survivor_roots)
+        assert all(e["pid"] != 1 + victim.idx for e in xs)
+
+
+# ===================================== wire hardening + observability (fast)
+
+
+def _get_raw(url):
+    with urllib.request.urlopen(url, timeout=30) as r:
+        return r.status, r.read().decode()
+
+
+def test_fleet_wire_errors_are_structured(engine, monkeypatch, tmp_path):
+    """Malformed JSON, unknown paths, wrong verbs, and bad fields all get
+    structured JSON errors — never a stack trace, never a hung socket."""
+    monkeypatch.setenv("TDT_HTTP_PORT", "0")
+    srv = InferenceServer(engine, num_slots=2, chunk=2)
+    svc = ReplicaService(srv)
+    base = srv._introspect.url().rstrip("/")
+    try:
+        def post_raw(path, payload: bytes):
+            req = urllib.request.Request(
+                base + path, data=payload,
+                headers={"Content-Type": "application/json"}, method="POST",
+            )
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=30)
+            return ei.value.code, json.loads(ei.value.read().decode())
+
+        # Malformed JSON: 400 with a structured error, even on a path that
+        # does not exist (the body gate runs first).
+        code, err = post_raw("/fleet/submit", b"{not json")
+        assert code == 400 and "error" in err
+        code, err = post_raw("/fleet/no-such-route", b"{not json")
+        assert code == 400 and "error" in err
+        # Unknown route: 404.
+        code, err = post_raw("/fleet/no-such-route", b"{}")
+        assert code == 404 and "error" in err
+        # Wrong verb: 405 names the allowed methods without running the
+        # handler.
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(base + "/fleet/submit")
+        assert ei.value.code == 405
+        err = json.loads(ei.value.read().decode())
+        assert err["allow"] == ["POST"] and "error" in err
+        code, err = post_raw("/fleet/trace/1", b"{}")
+        assert code == 405 and err["allow"] == ["GET"]
+        # Missing / bad fields: 400 with the field named.
+        code, err = post_raw("/fleet/submit", b'{"prompt": [1]}')
+        assert code == 400 and "max_new" in err["error"]
+        code, err = post_raw(
+            "/fleet/submit", b'{"prompt": [1], "max_new": "lots"}')
+        assert code == 400 and "bad field value" in err["error"]
+        code, err = post_raw("/fleet/stream", b'{"reqs": [[1]]}')
+        assert code == 400
+        # Trace route input gate: junk id 400, unknown trace 404.
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(base + "/fleet/trace/zzz")
+        assert ei.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(base + "/fleet/trace/424242")
+        assert ei.value.code == 404
+    finally:
+        svc.close()
+        srv.shutdown(drain=True)
+
+
+def test_replica_continues_router_trace_in_process(engine, monkeypatch,
+                                                  tmp_path):
+    """A submit body carrying a traceparent makes the replica-side serving
+    span chain a CHILD of the router's placement span — one trace id across
+    the admission boundary, fetchable over ``/fleet/trace/<32-hex>``."""
+    from triton_dist_tpu.runtime import tracing
+
+    monkeypatch.setenv("TDT_HTTP_PORT", "0")
+    srv = InferenceServer(engine, num_slots=2, chunk=2)
+    svc = ReplicaService(srv)
+    base = srv._introspect.url().rstrip("/")
+    try:
+        t = tracing.start_remote_trace("tdt_fleet_request", fleet_id=0)
+        with t.span("tdt_fleet_placement") as psp:
+            carrier = tracing.inject(t, span_id=psp["span_id"])
+            resp = _post(base + "/fleet/submit", {
+                "prompt": [3, 17, 42], "max_new": 4, "trace": carrier,
+            })
+        assert resp["state"] == "queued"
+        srv.run()
+        t.finish()
+        doc = _get(base + f"/fleet/trace/{t.trace_id:032x}")
+        assert doc["trace_id_hex"] == f"{t.trace_id:032x}"
+        spans = {s["name"]: s for s in doc["spans"]}
+        assert spans["tdt_serving_request"]["parent_id"] == psp["span_id"]
+        assert spans["tdt_serving_request"]["trace_id"] == t.trace_id
+        # The whole serving chain rode along into the same trace.
+        for name in ("tdt_serving_queue_wait", "tdt_serving_stream"):
+            assert spans[name]["trace_id"] == t.trace_id
+        # Decimal id form fetches the same trace.
+        doc2 = _get(base + f"/fleet/trace/{t.trace_id}")
+        assert len(doc2["spans"]) == len(doc["spans"])
+    finally:
+        svc.close()
+        srv.shutdown(drain=True)
+
+
+def test_stream_polls_are_idempotent_across_resume(engine, monkeypatch,
+                                                   tmp_path):
+    """Positional ``/fleet/stream`` polling: duplicate and overlapping
+    polls never duplicate or drop tokens — including after the request
+    migrates (resume on a second server seeded mid-stream)."""
+    monkeypatch.setenv("TDT_HTTP_PORT", "0")
+    prompt, max_new = [3, 17, 42, 7, 99], 8
+    [ref] = _references(engine, [(prompt, max_new)])
+    ref = [int(t) for t in ref]              # JSON-able resume seeds
+    srv = InferenceServer(
+        engine, num_slots=2, chunk=2,
+        journal=RequestJournal(tmp_path / "j.jsonl", fsync_every=1),
+    )
+    svc = ReplicaService(srv)
+    base = srv._introspect.url().rstrip("/")
+    try:
+        rid = _post(base + "/fleet/submit",
+                    {"prompt": prompt, "max_new": max_new})["req_id"]
+        srv.run()
+        full = _post(base + "/fleet/stream",
+                     {"reqs": [[rid, 0]]})["streams"][str(rid)]
+        assert full["tokens"] == ref and full["done"]
+        # Duplicate poll: byte-identical, nothing consumed.
+        again = _post(base + "/fleet/stream",
+                      {"reqs": [[rid, 0]]})["streams"][str(rid)]
+        assert again["tokens"] == ref
+        # Overlapping offsets slice the same stream consistently.
+        for frm in (0, 2, 5, len(ref), len(ref) + 3):
+            st = _post(base + "/fleet/stream",
+                       {"reqs": [[rid, frm]]})["streams"][str(rid)]
+            assert st["tokens"] == ref[frm:] and st["done"]
+        # Same req polled twice in ONE call: both entries full and equal.
+        st = _post(base + "/fleet/stream",
+                   {"reqs": [[rid, 0], [rid, 3]]})["streams"]
+        assert st[str(rid)]["tokens"] in (ref, ref[3:])
+    finally:
+        svc.close()
+        srv.shutdown(drain=True)
+
+    # "Migration": a second server resumes from the journal seed; polls
+    # against the NEW replica stay positional from the router's delivered
+    # count, so the client stream never duplicates the seed.
+    monkeypatch.setenv("TDT_HTTP_PORT", "0")
+    srv2 = InferenceServer(engine, num_slots=2, chunk=2)
+    svc2 = ReplicaService(srv2)
+    base2 = srv2._introspect.url().rstrip("/")
+    try:
+        delivered = ref[:3]                  # what the router already has
+        rid2 = _post(base2 + "/fleet/resume", {
+            "prompt": prompt, "max_new": max_new, "tokens": ref[:5],
+        })["req_id"]                         # journal ahead of delivery
+        srv2.run()
+        st = _post(base2 + "/fleet/stream",
+                   {"reqs": [[rid2, len(delivered)]]})["streams"][str(rid2)]
+        assert delivered + st["tokens"] == ref  # zero dup, zero drop
+        st2 = _post(base2 + "/fleet/stream",
+                    {"reqs": [[rid2, len(delivered)]]})["streams"][str(rid2)]
+        assert st2["tokens"] == st["tokens"]    # re-poll: same answer
+    finally:
+        svc2.close()
+        srv2.shutdown(drain=True)
+
+
+def test_router_federation_routes(monkeypatch, tmp_path):
+    """The router-process federation endpoint: topology/metrics/placements
+    serve with zero live replicas, postmortem and trace 404/400 correctly,
+    and verbs are enforced. No replica subprocesses involved."""
+    monkeypatch.setenv("TDT_HTTP_PORT", "0")
+    ep = introspect.maybe_start()
+    assert ep is not None
+    base = ep.url().rstrip("/")
+    router = Router(2, tmp_path / "fleet")
+    router.mount_routes()
+    try:
+        router.submit([1, 2, 3], 4)          # parks: no replica is alive
+        topo = _get(base + "/fleet/topology")
+        assert [r["idx"] for r in topo["replicas"]] == [0, 1]
+        assert not any(r["alive"] for r in topo["replicas"])
+        assert topo["pending"] == 1 and topo["requests"] == 1
+
+        status, text = _get_raw(base + "/fleet/metrics")
+        assert status == 200
+        assert 'tdt_fleet_requests_total{replica="router"} 1' in text
+        merged = _get(base + "/fleet/metrics?format=json")
+        assert merged["federated"] and merged["replicas"] == []
+
+        assert _get(base + "/fleet/placements") == {"placements": []}
+        for path, code in [("/fleet/postmortem/0", 404),
+                           ("/fleet/postmortem/xyz", 400),
+                           ("/fleet/trace/zzz", 400),
+                           ("/fleet/trace/424242", 404)]:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(base + path)
+            assert ei.value.code == code, path
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(base + "/fleet/metrics", {})
+        assert ei.value.code == 405
+
+        # The router's own live trace IS fetchable fleet-wide (router pid 0).
+        tid = router._requests[0].trace.trace_id
+        doc = _get(base + f"/fleet/trace/{tid:032x}")
+        names = {e["name"] for e in doc["traceEvents"] if e["ph"] == "X"}
+        assert "tdt_fleet_request" in names
+
+        router.shutdown()                    # unmounts
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(base + "/fleet/topology")
+        assert ei.value.code == 404
+    finally:
+        router.shutdown()
+        ep.stop()
+
+
+def test_merge_scrapes_sums_counters_and_histograms():
+    """Federation merge semantics on synthetic snapshots: counters and
+    histograms sum per label set with per-replica series alongside; gauges
+    stay per-replica (a summed gauge would be a lie)."""
+    def snap(tok, wait_count):
+        return {
+            "counters": {
+                "tdt_serving_tokens_total": [
+                    {"labels": {}, "value": float(tok)}],
+                "tdt_serving_requests_total": [
+                    {"labels": {"priority": "1"}, "value": 2.0}],
+            },
+            "gauges": {"tdt_serving_queue_depth": [
+                {"labels": {}, "value": 3.0}]},
+            "histograms": {"tdt_serving_queue_wait_seconds": [{
+                "labels": {}, "count": wait_count, "sum": 0.5 * wait_count,
+                "buckets": [[0.1, wait_count], ["+Inf", wait_count]],
+            }]},
+        }
+
+    m = Router._merge_scrapes([(0, snap(10, 2)), (2, snap(32, 3))])
+    assert m["replicas"] == [0, 2]
+    tok = m["counters"]["tdt_serving_tokens_total"]
+    assert tok[0] == {"labels": {}, "value": 42.0}          # the sum
+    assert {e["labels"].get("replica"): e["value"] for e in tok[1:]} == \
+        {"0": 10.0, "2": 32.0}
+    # Labeled counter series sum per label set.
+    pri = m["counters"]["tdt_serving_requests_total"]
+    assert pri[0] == {"labels": {"priority": "1"}, "value": 4.0}
+    # Gauges: per-replica only, no summed series.
+    depth = m["gauges"]["tdt_serving_queue_depth"]
+    assert all("replica" in e["labels"] for e in depth) and len(depth) == 2
+    # Histograms: counts, sums, and cumulative buckets sum positionally.
+    hist = m["histograms"]["tdt_serving_queue_wait_seconds"]
+    assert hist[0]["count"] == 5 and hist[0]["sum"] == pytest.approx(2.5)
+    assert hist[0]["buckets"] == [[0.1, 5], ["+Inf", 5]]
+    assert len(hist) == 3
+    # The merged dict renders as Prometheus text directly.
+    text = telemetry.to_prometheus(m)
+    assert "tdt_serving_tokens_total 42" in text
+    assert 'tdt_serving_tokens_total{replica="0"} 10' in text
+
+
+def test_placement_audit_ring_records_why_and_is_bounded(monkeypatch,
+                                                         tmp_path):
+    monkeypatch.setenv("TDT_FLEET_PLACEMENT_RING", "4")
+    r = Router(2, tmp_path)
+    for h in r.replicas:
+        h.alive = True
+    infos = [(r.replicas[0], _hint(warm=2, est=1.0)),
+             (r.replicas[1], _hint(est=0.2))]
+    for i in range(6):
+        fr = FleetRequest(i, list(range(BLOCK)), 4, 1)
+        ranked, reason, hit = r._rank(fr, infos)
+        r._audit_placement(fr, infos, ranked, ranked[0], reason, hit)
+    ring = r.placements()
+    assert len(ring) == 4                    # bounded: oldest evicted
+    assert [rec["fleet_id"] for rec in ring] == [2, 3, 4, 5]
+    rec = ring[-1]
+    assert rec["chosen"] == 0 and rec["reason"] == "affinity"
+    assert rec["prefix_hit"] and rec["ranked"][0] == 0
+    cands = {c["replica"]: c for c in rec["candidates"]}
+    assert cands[0]["warm_blocks"] == 2
+    assert cands[1]["est_wait_s"] == pytest.approx(0.2)
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+@pytest.mark.timeout(600)
+def test_fleet_postmortem_flight_record_after_kill(engine, tmp_path):
+    """Flight-recorder acceptance in isolation: kill -9 a replica mid-work
+    and recover which request/slot/span it was executing at death from the
+    mmap ring next to its journal — no exit hook ran, the file alone tells
+    the story."""
+    reqs = [([5 + i, 3, 2 * i + 1], 10) for i in range(4)]
+    refs = _references(engine, reqs)
+    streams: dict[int, list[int]] = {}
+    with Router(2, tmp_path / "fleet", env=REPLICA_ENV) as router:
+        router.start()
+        frs = [router.submit(p, g, on_token=_collect(streams))
+               for p, g in reqs]
+        deadline = time.monotonic() + 120
+        while sum(len(s) for s in streams.values()) < 2:
+            assert time.monotonic() < deadline, "burst never started"
+            if not router.pump():
+                time.sleep(0.01)
+        victim = max(router.replicas, key=lambda h: len(h.inflight))
+        assert victim.inflight
+        pre_kill_rids = set(victim.inflight)
+        flight_path = victim.flight_path
+        router.kill(victim.idx)
+        router.serve_all(timeout_s=300)
+
+        for fr, ref in zip(frs, refs):
+            assert fr.done and fr.tokens == ref
+
+        # The raw ring on disk is readable and ordered.
+        records = telemetry.FlightRecorder.read(flight_path)
+        assert records
+        seqs = [r["flight_seq"] for r in records]
+        assert seqs == sorted(seqs)
+        assert {"span_start", "span_end"} & {r.get("kind") for r in records}
+
+        # The router's harvested postmortem pins the work at death.
+        pm = router.postmortem(victim.idx)
+        assert pm is not None
+        assert pm["replica"] == victim.idx and pm["reason"] == "death"
+        assert pm["flight_path"] == flight_path
+        assert set(pm["active_requests"]) & pre_kill_rids
+        assert any(n.startswith("tdt_serving_")
+                   for n in pm["active_span_names"])
+        assert pm["last"]["flight_seq"] == seqs[-1]

@@ -8,6 +8,7 @@ does not participate in jit cache keys (the FaultPlan rule).
 """
 
 import json
+import os
 import subprocess
 import sys
 
@@ -465,3 +466,121 @@ def test_counter_total_sums_across_label_sets():
     telemetry.inc("tdt_test_multi_total", 3.0, peer=1)
     assert telemetry.counter_total("tdt_test_multi_total") == 5.0
     assert telemetry.counter_total("tdt_test_absent_total") == 0.0
+
+
+# ------------------------------------------------------------ flight recorder
+
+
+def test_flight_recorder_roundtrip_and_wraparound(tmp_path):
+    path = tmp_path / "flight.bin"
+    fr = telemetry.FlightRecorder(path, capacity=8)
+    for i in range(3):
+        fr.append({"kind": "event", "i": i})
+    recs = telemetry.FlightRecorder.read(path)
+    assert [r["i"] for r in recs] == [0, 1, 2]
+    assert all(r["pid"] == os.getpid() for r in recs)
+    assert recs[0]["flight_seq"] == 1 and recs[0]["t_mono_s"] > 0
+    # Ring wraps: only the newest `capacity` records survive, in order.
+    for i in range(3, 20):
+        fr.append({"kind": "event", "i": i})
+    recs = telemetry.FlightRecorder.read(path)
+    assert [r["i"] for r in recs] == list(range(12, 20))
+    fr.close()
+
+
+def test_flight_recorder_survives_no_close(tmp_path):
+    """The SIGKILL property, minus the SIGKILL: records written with no
+    close()/flush/atexit are readable from the file by another process —
+    the mmap'd pages belong to the kernel once written."""
+    path = tmp_path / "flight.bin"
+    code = (
+        "import sys; sys.path.insert(0, %r);"
+        "from triton_dist_tpu.runtime import telemetry;"
+        "fr = telemetry.FlightRecorder(%r, capacity=16);"
+        "[fr.append({'kind': 'k', 'i': i}) for i in range(5)];"
+        "import os; os.kill(os.getpid(), 9)"  # no close, no atexit
+    ) % (os.getcwd(), str(path))
+    p = subprocess.run([sys.executable, "-c", code])
+    assert p.returncode == -9
+    recs = telemetry.FlightRecorder.read(path)
+    assert [r["i"] for r in recs] == list(range(5))
+
+
+def test_flight_recorder_drops_torn_record(tmp_path):
+    path = tmp_path / "flight.bin"
+    fr = telemetry.FlightRecorder(path, capacity=8)
+    for i in range(4):
+        fr.append({"kind": "event", "i": i})
+    fr.close()
+    # Tear the LAST record mid-payload (what a kill during the final
+    # memcpy leaves behind): reader must drop it, keep the rest.
+    hdr = telemetry.FLIGHT_HEADER_BYTES
+    rec = telemetry.FLIGHT_RECORD_BYTES
+    with open(path, "r+b") as f:
+        f.seek(hdr + 3 * rec + 12)
+        f.write(b"\x00" * 40)
+    recs = telemetry.FlightRecorder.read(path)
+    assert [r["i"] for r in recs] == [0, 1, 2]
+    # A file that is not a flight ring reads as empty, never raises.
+    junk = tmp_path / "junk.bin"
+    junk.write_bytes(b"not a flight ring")
+    assert telemetry.FlightRecorder.read(junk) == []
+    assert telemetry.FlightRecorder.read(tmp_path / "absent.bin") == []
+
+
+def test_flight_recorder_truncates_oversized_payload(tmp_path):
+    path = tmp_path / "flight.bin"
+    fr = telemetry.FlightRecorder(path, capacity=4)
+    fr.append({"kind": "big", "blob": "x" * 4096})
+    fr.append({"kind": "small"})
+    recs = telemetry.FlightRecorder.read(path)
+    assert recs[0]["kind"] == "big" and recs[0]["truncated"] is True
+    assert "blob" not in recs[0]             # stub, not torn JSON
+    assert recs[1]["kind"] == "small"
+    fr.close()
+
+
+def test_emit_feeds_flight_recorder_when_enabled(tmp_path, monkeypatch):
+    monkeypatch.setenv("TDT_FLIGHT_RECORDER", str(tmp_path))
+    monkeypatch.setenv("TDT_FLIGHT_RECORDS", "16")
+    telemetry.reset()
+    assert telemetry.flight_active()
+    telemetry.emit("serving_started", slots=2)
+    telemetry.flight("flight_only", req_id=5)    # flight ring only
+    recs = telemetry.FlightRecorder.read(tmp_path / "flight.bin")
+    assert [r["kind"] for r in recs] == ["serving_started", "flight_only"]
+    assert recs[0]["slots"] == 2 and recs[1]["req_id"] == 5
+    # flight() bypasses the in-memory event ring.
+    assert telemetry.events("flight_only") == []
+    assert telemetry.counter_value("tdt_flight_records_total") == 2.0
+    # reset() re-resolves: recorder off once the env var is gone.
+    monkeypatch.delenv("TDT_FLIGHT_RECORDER")
+    telemetry.reset()
+    assert not telemetry.flight_active()
+
+
+def test_flight_postmortem_folds_open_spans(tmp_path):
+    """The harvest view: span_start/span_end pairs fold away; what remains
+    open at death names the active request/slot/span."""
+    recs = [
+        {"kind": "span_start", "trace_id": 9, "span_id": 1, "parent_id": None,
+         "name": "tdt_serving_request", "req_id": 4},
+        {"kind": "span_start", "trace_id": 9, "span_id": 2, "parent_id": 1,
+         "name": "tdt_serving_prefill", "slot": 1},
+        {"kind": "span_end", "trace_id": 9, "span_id": 2,
+         "name": "tdt_serving_prefill"},
+        {"kind": "span_start", "trace_id": 9, "span_id": 3, "parent_id": 1,
+         "name": "tdt_serving_decode_chunk", "slot": 1},
+        {"kind": "event", "i": 1},
+    ]
+    pm = telemetry.flight_postmortem(recs)
+    assert pm["n_records"] == 5
+    assert pm["last"]["i"] == 1
+    names = pm["active_span_names"]
+    assert "tdt_serving_request" in names
+    assert "tdt_serving_decode_chunk" in names
+    assert "tdt_serving_prefill" not in names  # closed before death
+    assert 4 in pm["active_requests"] or "4" in map(str, pm["active_requests"])
+    assert 1 in pm["active_slots"]
+    assert len(pm["tail"]) == 5
+    assert telemetry.flight_postmortem([])["n_records"] == 0

@@ -436,3 +436,116 @@ def test_maybe_start_disabled_by_default(monkeypatch):
     assert introspect.maybe_start() is None
     monkeypatch.setenv("TDT_HTTP_PORT", "not-a-port")
     assert introspect.maybe_start() is None  # logged, never raises
+
+
+# ============================================= cross-process propagation
+
+
+def test_inject_extract_roundtrip():
+    t = tracing.start_remote_trace("tdt_fleet_request", fleet_id=7)
+    assert t.sampled
+    car = tracing.inject(t)
+    tp = car["traceparent"]
+    # W3C-traceparent shape: version-traceid-spanid-flags, all lowercase hex.
+    assert tp == f"00-{t.trace_id:032x}-{t.root_id:016x}-01"
+    ctx = tracing.extract(car)
+    assert ctx == (t.trace_id, t.root_id, True)
+    # The raw string extracts too (a peer may flatten the carrier).
+    assert tracing.extract(tp) == ctx
+    # inject can pin a non-root parent span.
+    with t.span("tdt_test_child") as sp:
+        car2 = tracing.inject(t, span_id=sp["span_id"])
+    assert tracing.extract(car2).span_id == sp["span_id"]
+
+
+def test_extract_rejects_malformed_carriers():
+    bad = [
+        None, {}, {"traceparent": 42}, "nonsense",
+        "00-" + "0" * 32 + "-" + "1" * 16 + "-01",      # zero trace id
+        "00-" + "1" * 32 + "-" + "0" * 16 + "-01",      # zero span id
+        "ff-" + "1" * 32 + "-" + "1" * 16 + "-01",      # forbidden version
+        "00-" + "1" * 31 + "-" + "1" * 16 + "-01",      # short trace id
+    ]
+    for carrier in bad:
+        assert tracing.extract(carrier) is None, carrier
+
+
+def test_continue_trace_parents_under_remote_span():
+    t = tracing.start_remote_trace("tdt_fleet_request")
+    with t.span("tdt_fleet_placement") as psp:
+        car = tracing.inject(t, span_id=psp["span_id"])
+    # "Remote" side: same process here, but only the carrier crosses.
+    t2 = tracing.continue_trace(tracing.extract(car), "tdt_serving_request",
+                                req_id=3)
+    assert t2.trace_id == t.trace_id and t2.sampled
+    with t2.span("tdt_serving_queue_wait"):
+        pass
+    t2.finish()
+    t.finish()
+    spans = {s["name"]: s for s in tracing.spans(t.trace_id)}
+    assert spans["tdt_serving_request"]["parent_id"] == \
+        spans["tdt_fleet_placement"]["span_id"]
+    assert spans["tdt_serving_queue_wait"]["parent_id"] == \
+        spans["tdt_serving_request"]["span_id"]
+
+
+def test_continue_trace_honors_sender_sampling_and_none():
+    # Unsampled sender: flags 00 -> the receiver no-ops regardless of its
+    # own sampler (one fleet request is one trace everywhere or nowhere).
+    car = tracing.inject(tracing.NOOP_TRACE)
+    assert car["traceparent"].endswith("-00")
+    ctx = tracing.extract(car)
+    assert ctx is None  # zero ids: NOOP injects nothing usable
+    t = tracing.continue_trace(
+        tracing.SpanContext(123, 45, sampled=False), "tdt_serving_request"
+    )
+    assert t is tracing.NOOP_TRACE
+    # No carrier at all: plain local trace, standalone serving unchanged.
+    t2 = tracing.continue_trace(None, "tdt_serving_request")
+    assert t2.sampled and tracing.spans(t2.trace_id, include_open=True)
+
+
+def test_remote_trace_ids_do_not_collide_with_local():
+    """Local ids count 1,2,3... per process; a propagated trace id must be
+    drawn from a range that cannot collide across processes."""
+    local = tracing.start_trace("tdt_test_trace")
+    remote = tracing.start_remote_trace("tdt_fleet_request")
+    assert remote.trace_id != local.trace_id
+    assert remote.trace_id > 2**32  # 63-bit random, never a tiny counter
+    assert tracing.parse_trace_id(f"{remote.trace_id:032x}") == remote.trace_id
+    assert tracing.parse_trace_id(str(local.trace_id)) == local.trace_id
+    assert tracing.parse_trace_id("zz") is None
+
+
+def test_merge_chrome_builds_one_timeline_across_pids():
+    t = tracing.start_remote_trace("tdt_fleet_request")
+    with t.span("tdt_fleet_placement") as psp:
+        car = tracing.inject(t, span_id=psp["span_id"])
+    router_spans = tracing.spans(t.trace_id, include_open=True)
+    # Fake the replica side: shift ids as a second process would have them.
+    ctx = tracing.extract(car)
+    replica_spans = [{
+        "trace_id": ctx.trace_id, "span_id": 1, "parent_id": ctx.span_id,
+        "name": "tdt_serving_request", "start_s": 5.0, "end_s": None,
+        "attrs": {"req_id": 0},
+    }]
+    doc = tracing.merge_chrome([
+        {"label": "router", "pid": 0, "spans": router_spans},
+        {"label": "replica0 pid=999", "pid": 1, "spans": replica_spans},
+        {"label": "empty", "pid": 2, "spans": []},
+    ], trace_id=t.trace_id)
+    metas = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    assert [m["args"]["name"] for m in metas] == ["router", "replica0 pid=999"]
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert {e["pid"] for e in xs} == {0, 1}
+    assert all(e["ts"] >= 0 for e in xs)     # normalized across segments
+    serving = next(e for e in xs if e["name"] == "tdt_serving_request")
+    placement = next(e for e in xs if e["name"] == "tdt_fleet_placement")
+    # The cross-process parent link survives the merge machine-checkably.
+    assert serving["args"]["parent_id"] == placement["args"]["span_id"]
+    assert serving["args"]["open"] is True   # open spans render to t_end
+    # A foreign trace filters out entirely.
+    empty = tracing.merge_chrome(
+        [{"label": "router", "pid": 0, "spans": router_spans}], trace_id=42
+    )
+    assert empty["traceEvents"] == []

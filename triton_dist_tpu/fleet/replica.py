@@ -12,6 +12,22 @@ introspection endpoint (``runtime.introspect.register_json_route``):
 ``POST /fleet/drain``      enter drain mode (rolling rebuild)
 ``GET  /fleet/status``     ready / draining / drained / occupancy
 ``GET  /fleet/journal``    flush + export the write-ahead journal records
+``GET  /fleet/trace/<id>`` this replica's span ring for one trace (hex or
+                           decimal id) — the router fetches these to merge
+                           a fleet request's cross-process timeline
+
+Trace propagation: ``submit``/``resume`` bodies may carry a ``"trace"``
+carrier (``tracing.inject`` W3C-traceparent shape). It is extracted and
+threaded into the server, so the replica's whole serving span chain
+(queue wait → prefill → decode chunks → stream) parents under the
+router's placement span in ONE fleet-wide trace. A missing or malformed
+carrier falls back to a local trace — propagation can never break
+admission.
+
+Wire hardening (the structured-error contract the router's ``_http``
+counts on): a non-object body or missing/garbage fields → 400
+``{"error": ...}``, wrong verb → 405, unknown ``/fleet/`` path → 404 —
+never a replica-side stack trace.
 
 Streams are delivered by ABSOLUTE token position: the service mirrors each
 request's ``tokens`` history into a poll buffer, and ``/fleet/stream``
@@ -34,7 +50,7 @@ import os
 import threading
 import time
 
-from triton_dist_tpu.runtime import introspect
+from triton_dist_tpu.runtime import introspect, tracing
 from triton_dist_tpu.runtime.utils import get_int_env, tdt_log
 
 
@@ -56,17 +72,19 @@ class ReplicaService:
         #: ``tokens`` mirrors the request's full history (seed included for
         #: resumed requests) so stream positions are absolute.
         self._streams: dict[int, dict] = {}
-        for name, fn in (
-            ("submit", self._r_submit),
-            ("resume", self._r_resume),
-            ("stream", self._r_stream),
-            ("placement", self._r_placement),
-            ("cancel", self._r_cancel),
-            ("drain", self._r_drain),
-            ("status", self._r_status),
-            ("journal", self._r_journal),
+        for name, fn, methods in (
+            ("submit", self._r_submit, ("POST",)),
+            ("resume", self._r_resume, ("POST",)),
+            ("stream", self._r_stream, ("POST",)),
+            ("placement", self._r_placement, ("POST",)),
+            ("cancel", self._r_cancel, ("POST",)),
+            ("drain", self._r_drain, ("GET", "POST")),
+            ("status", self._r_status, ("GET", "POST")),
+            ("journal", self._r_journal, ("GET", "POST")),
+            ("trace/", self._r_trace, ("GET",)),
         ):
-            introspect.register_json_route(self.PREFIX + name, fn)
+            introspect.register_json_route(self.PREFIX + name, fn,
+                                           methods=methods)
 
     def close(self) -> None:
         introspect.clear_json_routes(self.PREFIX)
@@ -115,56 +133,110 @@ class ReplicaService:
         return 200, {"req_id": req.req_id, "state": req.state.value}
 
     # --------------------------------------------------------------- routes
-    def _r_submit(self, method, query, body) -> tuple[int, dict]:
+    @staticmethod
+    def _body_error(body, *required: str) -> str | None:
+        """The structured-400 gate every body-taking route runs first."""
         if not isinstance(body, dict):
-            return 400, {"error": "JSON object body required"}
-        req = self.server.submit(
-            body["prompt"], int(body["max_new"]),
-            on_token=self._on_token, on_finish=self._on_finish,
-            priority=int(body.get("priority", 1)),
-            ttft_deadline_s=body.get("ttft_deadline_s"),
-            deadline_s=body.get("deadline_s"),
-        )
+            return "JSON object body required"
+        missing = [k for k in required if k not in body]
+        if missing:
+            return f"missing field(s): {', '.join(missing)}"
+        return None
+
+    def _r_submit(self, method, query, body) -> tuple[int, dict]:
+        err = self._body_error(body, "prompt", "max_new")
+        if err:
+            return 400, {"error": err}
+        try:
+            req = self.server.submit(
+                body["prompt"], int(body["max_new"]),
+                on_token=self._on_token, on_finish=self._on_finish,
+                priority=int(body.get("priority", 1)),
+                ttft_deadline_s=body.get("ttft_deadline_s"),
+                deadline_s=body.get("deadline_s"),
+                trace_ctx=tracing.extract(body.get("trace")),
+            )
+        except (TypeError, ValueError) as e:
+            return 400, {"error": f"bad field value: {e}"}
         return self._admit_response(req)
 
     def _r_resume(self, method, query, body) -> tuple[int, dict]:
-        if not isinstance(body, dict):
-            return 400, {"error": "JSON object body required"}
-        req = self.server.resume(
-            body["prompt"], int(body["max_new"]), body.get("tokens", []),
-            on_token=self._on_token, on_finish=self._on_finish,
-            priority=int(body.get("priority", 1)),
-            deadline_s=body.get("deadline_s"),
-        )
+        err = self._body_error(body, "prompt", "max_new")
+        if err:
+            return 400, {"error": err}
+        try:
+            req = self.server.resume(
+                body["prompt"], int(body["max_new"]), body.get("tokens", []),
+                on_token=self._on_token, on_finish=self._on_finish,
+                priority=int(body.get("priority", 1)),
+                deadline_s=body.get("deadline_s"),
+                trace_ctx=tracing.extract(body.get("trace")),
+            )
+        except (TypeError, ValueError) as e:
+            return 400, {"error": f"bad field value: {e}"}
         return self._admit_response(req)
 
     def _r_stream(self, method, query, body) -> tuple[int, dict]:
-        if not isinstance(body, dict):
-            return 400, {"error": "JSON object body required"}
+        err = self._body_error(body)
+        if err:
+            return 400, {"error": err}
+        reqs = body.get("reqs", [])
+        if not isinstance(reqs, list) or any(
+            not isinstance(it, (list, tuple)) or len(it) != 2 for it in reqs
+        ):
+            return 400, {"error": "reqs must be a list of [req_id, from]"}
         out = {}
-        with self._lock:
-            for rid, frm in body.get("reqs", []):
-                st = self._streams.get(int(rid))
-                if st is None:
-                    out[str(rid)] = {"tokens": [], "done": False,
-                                     "reason": None, "unknown": True}
-                    continue
-                out[str(rid)] = {
-                    "tokens": st["tokens"][max(int(frm), 0):],
-                    "done": st["done"],
-                    "reason": st["reason"],
-                }
+        try:
+            with self._lock:
+                for rid, frm in reqs:
+                    st = self._streams.get(int(rid))
+                    if st is None:
+                        out[str(rid)] = {"tokens": [], "done": False,
+                                         "reason": None, "unknown": True}
+                        continue
+                    out[str(rid)] = {
+                        "tokens": st["tokens"][max(int(frm), 0):],
+                        "done": st["done"],
+                        "reason": st["reason"],
+                    }
+        except (TypeError, ValueError) as e:
+            return 400, {"error": f"bad field value: {e}"}
         return 200, {"streams": out}
 
     def _r_placement(self, method, query, body) -> tuple[int, dict]:
-        if not isinstance(body, dict):
-            return 400, {"error": "JSON object body required"}
-        return 200, self.server.placement_info(body.get("prompt", []))
+        err = self._body_error(body)
+        if err:
+            return 400, {"error": err}
+        try:
+            return 200, self.server.placement_info(body.get("prompt", []))
+        except (TypeError, ValueError) as e:
+            return 400, {"error": f"bad field value: {e}"}
 
     def _r_cancel(self, method, query, body) -> tuple[int, dict]:
-        if not isinstance(body, dict):
-            return 400, {"error": "JSON object body required"}
-        return 200, {"cancelled": self.server.cancel(int(body["req_id"]))}
+        err = self._body_error(body, "req_id")
+        if err:
+            return 400, {"error": err}
+        try:
+            return 200, {"cancelled": self.server.cancel(int(body["req_id"]))}
+        except (TypeError, ValueError) as e:
+            return 400, {"error": f"bad field value: {e}"}
+
+    def _r_trace(self, method, query, body, rest="") -> tuple[int, dict]:
+        """``GET /fleet/trace/<id>``: this process's span ring for one
+        trace — what the router merges into the fleet-wide timeline. The id
+        is the 32-hex traceparent form (canonical) or decimal."""
+        tid = _parse_trace_id(rest)
+        if tid is None:
+            return 400, {"error": f"bad trace id {rest!r} "
+                                  "(want 32-hex or decimal)"}
+        sps = tracing.spans(tid, include_open=True)
+        if not sps:
+            return 404, {"error": f"unknown trace {rest!r}"}
+        return 200, {
+            "trace_id_hex": f"{tid:032x}",
+            "pid": os.getpid(),
+            "spans": sps,
+        }
 
     def _r_drain(self, method, query, body) -> tuple[int, dict]:
         self.server.drain_begin()
@@ -193,6 +265,10 @@ class ReplicaService:
             "backend": s.engine.backend,
             "pid": os.getpid(),
         }
+
+
+#: Shared with the router's ``/fleet/trace/<id>`` federation route.
+_parse_trace_id = tracing.parse_trace_id
 
 
 # ------------------------------------------------------- subprocess entry

@@ -35,32 +35,82 @@ meanwhile place on the other replicas, or park in the router's own
 pending queue until a replica is eligible — the client never sees a
 reject.
 
+**Observability control plane** (this file + ``runtime/tracing.py`` +
+``runtime/telemetry.py``):
+
+* *One trace per fleet request.* :meth:`Router.submit` opens a
+  ``tdt_fleet_request`` trace with a globally-unique trace id
+  (``tracing.start_remote_trace``); every ``/fleet/submit|resume|
+  placement|cancel`` body carries the injected context parented under the
+  placement span, so each replica's serving span chain continues the SAME
+  trace — migration renders as one trace_id moving to the survivor.
+  :meth:`Router.fleet_trace` fetches every live replica's span ring over
+  ``GET /fleet/trace/<id>`` and merges router + replicas into one
+  chrome://tracing timeline, one pid per process.
+* *Federation routes* (mounted on the ROUTER process's introspection
+  endpoint by :meth:`start`, served while ``TDT_HTTP_PORT`` enables one):
+  ``/fleet/metrics`` (every live replica scraped; counters/histograms
+  summed across replicas plus per-replica-labeled series plus the
+  router-local ``tdt_fleet_*`` family — Prometheus text, ``?format=json``
+  for the structured merge), ``/fleet/topology`` (generation, port,
+  health, EWMA load, per-replica placement-hit rates),
+  ``/fleet/placements`` (the bounded placement audit ring — every
+  decision with its ranked candidates and why the head won),
+  ``/fleet/postmortem/<replica>`` (harvested flight recording of a dead
+  replica), ``/fleet/trace/<id>`` (the merged timeline).
+* *Flight-recorder harvest.* Replicas spawn with
+  ``TDT_FLIGHT_RECORDER=<gen dir>`` (next to the journal), so a kill -9'd
+  replica leaves a crash-surviving event ring behind;
+  :meth:`Router._on_replica_failure` reads it and folds it into a
+  postmortem (``telemetry.flight_postmortem``) — which request/slot/span
+  the replica was executing at death, with no atexit hook involved.
+
 Control plane is stdlib-only: ``subprocess`` + ``urllib`` + JSON over
 each replica's loopback introspection endpoint. The router itself is
 single-threaded — drive it with :meth:`pump` (one poll sweep) or
-:meth:`serve_all` (pump until every stream completes).
+:meth:`serve_all` (pump until every stream completes). (The federation
+route handlers run on endpoint threads and only READ router state that is
+stable between pumps — scrapes go over HTTP to the replicas, never into
+the router's placement loop.)
 
 Telemetry (router-process ``tdt_fleet_*`` family):
 ``tdt_fleet_requests_total``, ``tdt_fleet_tokens_total``,
 ``tdt_fleet_placements_total{reason}``, ``tdt_fleet_prefix_hits_total``,
 ``tdt_fleet_prefix_hit_rate`` (gauge), ``tdt_fleet_migrations_total{reason}``,
 ``tdt_fleet_replica_failures_total{reason}``, ``tdt_fleet_replicas_alive``
-(gauge), ``tdt_fleet_pending_requests`` (gauge), ``tdt_fleet_rebuilds_total``.
+(gauge), ``tdt_fleet_pending_requests`` (gauge), ``tdt_fleet_rebuilds_total``,
+``tdt_fleet_trace_propagated_total``, ``tdt_fleet_trace_fetches_total{outcome}``,
+``tdt_fleet_http_errors_total{path,code}``, ``tdt_fleet_postmortems_total{reason}``.
 """
 
 from __future__ import annotations
 
+import collections
 import hashlib
 import json
 import os
 import subprocess
 import sys
 import time
+import urllib.error
 import urllib.request
 
-from triton_dist_tpu.runtime import telemetry
+from triton_dist_tpu.runtime import introspect, telemetry, tracing
 from triton_dist_tpu.runtime.utils import get_int_env, tdt_log
 from triton_dist_tpu.serving.journal import RequestJournal
+
+
+class FleetWireError(RuntimeError):
+    """A ``/fleet/*`` call answered with a structured 4xx — the replica is
+    alive and talking, the CALL was wrong (or the resource unknown).
+    Deliberately not an OSError so the router's replica-death handling
+    (``except OSError`` → migrate everything) never fires for it."""
+
+    def __init__(self, path: str, code: int, detail: str):
+        super().__init__(f"{path}: HTTP {code}: {detail or 'error'}")
+        self.path = path
+        self.code = code
+        self.detail = detail
 
 
 class FleetRequest:
@@ -74,7 +124,7 @@ class FleetRequest:
     __slots__ = (
         "fleet_id", "prompt", "max_new", "priority", "on_token", "on_finish",
         "tokens", "done", "finish_reason", "replica", "remote_id",
-        "migrations", "placed_reason", "_seed",
+        "migrations", "placed_reason", "trace", "_seed",
     )
 
     def __init__(self, fleet_id: int, prompt, max_new: int, priority: int,
@@ -94,6 +144,9 @@ class FleetRequest:
         self.remote_id: int | None = None
         self.migrations = 0
         self.placed_reason: str | None = None
+        #: The fleet-wide trace (globally-unique trace id) this request's
+        #: spans — router AND replica side — all live under.
+        self.trace = tracing.NOOP_TRACE
         #: Resume history to seed at the next placement (migration only):
         #: max(journal tokens, delivered tokens) from the previous replica.
         self._seed: list[int] = []
@@ -119,9 +172,23 @@ class ReplicaHandle:
         self.alive = False
         self.draining = False
         self.inflight: dict[int, FleetRequest] = {}
+        #: Placement tallies for /fleet/topology (cumulative across gens —
+        #: a replica slot's identity survives rebuilds).
+        self.placements = 0
+        self.prefix_hits = 0
 
     def url(self, path: str) -> str:
         return f"http://127.0.0.1:{self.port}{path}"
+
+    @property
+    def flight_path(self) -> str:
+        """The current generation's flight-recorder file (next to the
+        journal — where the router harvests after a kill -9)."""
+        if not self.journal_path:
+            return ""
+        return os.path.join(
+            os.path.dirname(self.journal_path), telemetry.FLIGHT_FILE
+        )
 
 
 class Router:
@@ -151,6 +218,15 @@ class Router:
         self._placements = 0
         self._prefix_hits = 0
         self._rr = 0  # round-robin cursor for the load tiebreak
+        #: Bounded audit ring of placement decisions (/fleet/placements):
+        #: every decision with its ranked candidates and why the head won.
+        self._placement_ring: collections.deque = collections.deque(
+            maxlen=max(get_int_env("TDT_FLEET_PLACEMENT_RING", 256), 1)
+        )
+        #: Harvested flight recordings of dead replicas, by idx
+        #: (/fleet/postmortem/<idx>); newest failure wins per replica.
+        self._postmortems: dict[int, dict] = {}
+        self._routes_mounted = False
 
     # ---------------------------------------------------------------- spawn
     @property
@@ -158,7 +234,11 @@ class Router:
         return self._replicas
 
     def start(self, ready_timeout_s: float = 240.0) -> None:
-        """Spawn every replica, then wait for all of them to serve."""
+        """Spawn every replica, then wait for all of them to serve. Also
+        mounts the federation routes on this process's introspection route
+        registry (served whenever the router process runs an endpoint —
+        ``TDT_HTTP_PORT`` / ``introspect.start``)."""
+        self.mount_routes()
         for h in self._replicas:
             self._spawn(h)
         for h in self._replicas:
@@ -182,6 +262,11 @@ class Router:
             "TDT_HTTP_PORT_FILE": h.port_file,
             "TDT_JOURNAL_DIR": gdir,
         })
+        # Flight recorder next to the journal by default: the postmortem
+        # harvest path. An explicit setting in self.env wins (""  disables —
+        # the bench's tracing-off arm).
+        if "TDT_FLIGHT_RECORDER" not in self.env:
+            env["TDT_FLIGHT_RECORDER"] = gdir
         h._log_f = open(h.log_path, "ab")
         h.proc = subprocess.Popen(
             [sys.executable, "-m", "triton_dist_tpu.fleet.replica"],
@@ -222,31 +307,69 @@ class Router:
     # ----------------------------------------------------------------- http
     def _http(self, h: ReplicaHandle, path: str, body=None,
               timeout_s: float | None = None):
+        """One wire call. Failures are counted by path: a structured 4xx
+        becomes :class:`FleetWireError` (replica alive, call wrong — must
+        NOT trigger death handling); 5xx and connection-level OSErrors
+        re-raise as before (the callers' replica-failure paths)."""
         data = None if body is None else json.dumps(body).encode()
         req = urllib.request.Request(
             h.url(path), data=data,
             headers={"Content-Type": "application/json"},
             method="GET" if data is None else "POST",
         )
-        with urllib.request.urlopen(
-            req, timeout=self.request_timeout_s if timeout_s is None else timeout_s
-        ) as r:
-            return json.loads(r.read().decode())
+        route = path.partition("?")[0]
+        if route.startswith("/fleet/trace/"):
+            route = "/fleet/trace/*"  # keep the failure label low-cardinality
+        try:
+            with urllib.request.urlopen(
+                req,
+                timeout=self.request_timeout_s if timeout_s is None else timeout_s,
+            ) as r:
+                return json.loads(r.read().decode())
+        except urllib.error.HTTPError as e:
+            telemetry.inc("tdt_fleet_http_errors_total",
+                          path=route, code=str(e.code))
+            if 400 <= e.code < 500:
+                try:
+                    detail = json.loads(e.read().decode()).get("error", "")
+                except Exception:
+                    detail = ""
+                raise FleetWireError(route, e.code, detail) from None
+            raise
+        except OSError:
+            telemetry.inc("tdt_fleet_http_errors_total",
+                          path=route, code="conn")
+            raise
 
     # ------------------------------------------------------------ placement
     def submit(self, prompt, max_new: int, priority: int = 1,
                on_token=None, on_finish=None) -> FleetRequest:
         """Place one request on the fleet. Never rejects: with no eligible
         or accepting replica it parks in the router queue and places at a
-        later :meth:`pump`."""
+        later :meth:`pump`. Opens the request's fleet-wide trace — every
+        process that touches the request parents its spans under it."""
         fr = FleetRequest(self._next_id, prompt, max_new, priority,
                           on_token=on_token, on_finish=on_finish)
         self._next_id += 1
         self._requests.append(fr)
         telemetry.inc("tdt_fleet_requests_total")
+        fr.trace = tracing.start_remote_trace(
+            "tdt_fleet_request", fleet_id=fr.fleet_id,
+            prompt_len=len(fr.prompt), max_new=fr.max_new,
+        )
         if not self._try_place(fr):
             self._park(fr)
         return fr
+
+    def _stamp(self, fr: FleetRequest, pspan, body: dict) -> dict:
+        """Inject ``fr``'s trace context into a wire body (parented under
+        the current placement span when one is live). Unsampled traces
+        stamp nothing — the replica then runs a plain local trace."""
+        if fr.trace.sampled:
+            sid = None if pspan is None else pspan["span_id"]
+            body["trace"] = tracing.inject(fr.trace, span_id=sid)
+            telemetry.inc("tdt_fleet_trace_propagated_total")
+        return body
 
     def _park(self, fr: FleetRequest) -> None:
         self._pending.append(fr)
@@ -267,27 +390,73 @@ class Router:
 
     def _try_place(self, fr: FleetRequest) -> bool:
         """Probe, rank, and send to the best accepting replica. False when
-        nothing is eligible or everything rejected (shed / KV pressure)."""
-        infos = []
-        for h in self._eligible():
-            try:
-                infos.append((h, self._http(
-                    h, "/fleet/placement", {"prompt": fr.prompt}
-                )))
-            except OSError:
-                self._on_replica_failure(h, "death")
-        if not infos:
+        nothing is eligible or everything rejected (shed / KV pressure).
+        The whole attempt runs under one ``tdt_fleet_placement`` span —
+        the parent of everything the chosen replica does for ``fr``."""
+        with fr.trace.span(
+            "tdt_fleet_placement", fleet_id=fr.fleet_id,
+            migration=fr.migrations,
+        ) as psp:
+            def note(**kv):
+                if psp is not None:  # None = unsampled no-op span
+                    psp["attrs"].update(kv)
+
+            infos = []
+            for h in self._eligible():
+                try:
+                    infos.append((h, self._http(
+                        h, "/fleet/placement",
+                        self._stamp(fr, psp, {"prompt": fr.prompt}),
+                    )))
+                except OSError:
+                    self._on_replica_failure(h, "death")
+            if not infos:
+                note(outcome="no_replica")
+                return False
+            ranked, reason, hit = self._rank(fr, infos)
+            for i, h in enumerate(ranked):
+                try:
+                    if self._send(fr, h, psp):
+                        fr.placed_reason = reason if i == 0 else "spill"
+                        self._note_placement(
+                            h, fr.placed_reason, hit and i == 0
+                        )
+                        self._audit_placement(fr, infos, ranked, h,
+                                              fr.placed_reason, hit and i == 0)
+                        note(outcome="placed", replica=h.idx,
+                             reason=fr.placed_reason)
+                        return True
+                except OSError:
+                    self._on_replica_failure(h, "death")
+            note(outcome="rejected")
             return False
-        ranked, reason, hit = self._rank(fr, infos)
-        for i, h in enumerate(ranked):
-            try:
-                if self._send(fr, h):
-                    fr.placed_reason = reason if i == 0 else "spill"
-                    self._note_placement(fr.placed_reason, hit and i == 0)
-                    return True
-            except OSError:
-                self._on_replica_failure(h, "death")
-        return False
+
+    def _audit_placement(self, fr: FleetRequest, infos, ranked,
+                         chosen: ReplicaHandle, reason: str,
+                         hit: bool) -> None:
+        """Append one decision record to the bounded audit ring — every
+        candidate's load picture, the ranked order, and why the winner won
+        (``/fleet/placements``)."""
+        by_idx = {h.idx: info for h, info in infos}
+        self._placement_ring.append({
+            "fleet_id": fr.fleet_id,
+            "migration": fr.migrations,
+            "chosen": chosen.idx,
+            "reason": reason,
+            "prefix_hit": hit,
+            "ranked": [h.idx for h in ranked],
+            "candidates": [
+                {
+                    "replica": h.idx,
+                    "warm_blocks": info.get("warm_blocks", 0),
+                    "est_wait_s": info.get("est_wait_s"),
+                    "backlog_tokens": info.get("backlog_tokens", 0),
+                    "queue_depth": info.get("queue_depth", 0),
+                }
+                for h, info in infos
+            ],
+            "n_candidates": len(by_idx),
+        })
 
     def _rank(self, fr: FleetRequest, infos) -> tuple[list, str, bool]:
         """Order candidate replicas best-first and name the policy that
@@ -327,10 +496,13 @@ class Router:
         warm = {h.idx: info.get("warm_blocks", 0) for h, info in infos}
         return ranked, reason, warm.get(chosen.idx, 0) > 0
 
-    def _note_placement(self, reason: str, hit: bool) -> None:
+    def _note_placement(self, h: ReplicaHandle, reason: str,
+                        hit: bool) -> None:
         self._placements += 1
+        h.placements += 1
         if hit:
             self._prefix_hits += 1
+            h.prefix_hits += 1
             telemetry.inc("tdt_fleet_prefix_hits_total")
         telemetry.inc("tdt_fleet_placements_total", reason=reason)
         telemetry.set_gauge(
@@ -338,14 +510,14 @@ class Router:
             self._prefix_hits / self._placements,
         )
 
-    def _send(self, fr: FleetRequest, h: ReplicaHandle) -> bool:
+    def _send(self, fr: FleetRequest, h: ReplicaHandle, pspan=None) -> bool:
         """Admit ``fr`` on ``h`` (resume when it carries history). True on
         queued; False on a replica-side reject. OSError propagates."""
         seed = fr._seed if len(fr._seed) > len(fr.tokens) else fr.tokens
-        body = {
+        body = self._stamp(fr, pspan, {
             "prompt": fr.prompt, "max_new": fr.max_new,
             "priority": fr.priority,
-        }
+        })
         if seed:
             body["tokens"] = list(seed)
             resp = self._http(h, "/fleet/resume", body)
@@ -370,6 +542,10 @@ class Router:
         fr.finish_reason = reason or "ok"
         fr.replica = None
         fr.remote_id = None
+        fr.trace.finish(
+            reason=fr.finish_reason, tokens=len(fr.tokens),
+            migrations=fr.migrations,
+        )
         if fr.on_finish is not None:
             fr.on_finish(fr)
 
@@ -446,8 +622,30 @@ class Router:
         self._alive_gauge()
         tdt_log(f"[fleet] replica {h.idx} lost ({reason}); migrating "
                 f"{len(h.inflight)} in-flight request(s)", level="warn")
+        self._harvest_flight(h, reason)
         records = RequestJournal.read(h.journal_path)
         self._migrate_inflight(h, records, reason=reason, cancel_donor=False)
+
+    def _harvest_flight(self, h: ReplicaHandle, reason: str) -> None:
+        """Read the dead replica's crash-surviving flight ring off disk and
+        fold it into a postmortem: which request/slot/span it was executing
+        when it died (``/fleet/postmortem/<idx>``). A replica spawned with
+        the recorder disabled just records an empty postmortem."""
+        records = telemetry.FlightRecorder.read(h.flight_path) \
+            if h.flight_path else []
+        pm = telemetry.flight_postmortem(records)
+        pm.update(
+            replica=h.idx, gen=h.gen, reason=reason,
+            flight_path=h.flight_path,
+            pid=None if h.proc is None else h.proc.pid,
+        )
+        self._postmortems[h.idx] = pm
+        telemetry.inc("tdt_fleet_postmortems_total", reason=reason)
+        telemetry.emit(
+            "fleet_postmortem", replica=h.idx, reason=reason,
+            n_records=pm["n_records"],
+            active_requests=pm["active_requests"],
+        )
 
     def _migrate_inflight(self, h: ReplicaHandle, records: list[dict],
                           reason: str, cancel_donor: bool) -> None:
@@ -479,10 +677,15 @@ class Router:
             fr.remote_id = None
             fr.migrations += 1
             telemetry.inc("tdt_fleet_migrations_total", reason=reason)
+            fr.trace.point(
+                "tdt_fleet_migration", reason=reason, from_replica=h.idx,
+                seeded=len(fr._seed), delivered=len(fr.tokens),
+            )
             if cancel_donor:
                 try:
-                    self._http(h, "/fleet/cancel", {"req_id": rid})
-                except OSError:
+                    self._http(h, "/fleet/cancel",
+                               self._stamp(fr, None, {"req_id": rid}))
+                except (OSError, FleetWireError):
                     pass
             if not self._try_place(fr):
                 self._park(fr)
@@ -584,6 +787,7 @@ class Router:
     def shutdown(self) -> None:
         """Stop every replica process. In-flight state stays journaled on
         disk (each replica drains on SIGTERM before exiting)."""
+        self.unmount_routes()
         for h in self._replicas:
             self._terminate(h)
 
@@ -610,7 +814,257 @@ class Router:
             "placements": self._placements,
             "prefix_hits": self._prefix_hits,
             "affinity": self.affinity,
+            "postmortems": sorted(self._postmortems),
+            "placement_ring": len(self._placement_ring),
         }
+
+    # ------------------------------------------------------------- federation
+    #: Paths :meth:`mount_routes` registers on THIS process's introspection
+    #: route registry (trailing "/" = prefix route).
+    FEDERATION_ROUTES = (
+        "/fleet/metrics", "/fleet/topology", "/fleet/placements",
+        "/fleet/postmortem/", "/fleet/trace/",
+    )
+
+    def mount_routes(self) -> None:
+        """Mount the federation routes. Idempotent; served whenever the
+        router process runs an introspection endpoint. Unmounts path-by-path
+        in :meth:`shutdown` (never ``clear_json_routes("/fleet/")`` — an
+        in-process :class:`ReplicaService` shares the registry in tests)."""
+        if self._routes_mounted:
+            return
+        introspect.register_json_route(
+            "/fleet/metrics", self._r_metrics, methods=("GET",))
+        introspect.register_json_route(
+            "/fleet/topology", self._r_topology, methods=("GET",))
+        introspect.register_json_route(
+            "/fleet/placements", self._r_placements, methods=("GET",))
+        introspect.register_json_route(
+            "/fleet/postmortem/", self._r_postmortem, methods=("GET",))
+        introspect.register_json_route(
+            "/fleet/trace/", self._r_trace, methods=("GET",))
+        self._routes_mounted = True
+
+    def unmount_routes(self) -> None:
+        if not self._routes_mounted:
+            return
+        for path in self.FEDERATION_ROUTES:
+            introspect.register_json_route(path, None)
+        self._routes_mounted = False
+
+    def federated_metrics(self) -> dict:
+        """Scrape every live replica's ``/snapshot`` and merge into one
+        snapshot-shaped dict: counters/histograms summed across replicas
+        per label set PLUS per-replica-labeled series, gauges per-replica
+        only, and the router-local ``tdt_fleet_*``/``tdt_flight_*`` family
+        labeled ``replica="router"`` (never mixed into the sums).
+        ``telemetry.to_prometheus(result)`` renders it as exposition text."""
+        scrapes = []
+        for h in self._replicas:
+            if not h.alive:
+                continue
+            try:
+                scrapes.append((h.idx, self._http(h, "/snapshot?limit=1")))
+            except (OSError, FleetWireError):
+                continue
+        merged = self._merge_scrapes(scrapes)
+        local = telemetry.snapshot()
+        for sec in ("counters", "gauges"):
+            for name, entries in local.get(sec, {}).items():
+                if not name.startswith(("tdt_fleet_", "tdt_flight_")):
+                    continue
+                merged[sec].setdefault(name, []).extend(
+                    {"labels": {**e["labels"], "replica": "router"},
+                     "value": e["value"]}
+                    for e in entries
+                )
+        for name, entries in local.get("histograms", {}).items():
+            if not name.startswith(("tdt_fleet_", "tdt_flight_")):
+                continue
+            merged["histograms"].setdefault(name, []).extend(
+                {**e, "labels": {**e["labels"], "replica": "router"}}
+                for e in entries
+            )
+        return merged
+
+    @staticmethod
+    def _merge_scrapes(scrapes: list[tuple[int, dict]]) -> dict:
+        """Pure merge of ``(replica_idx, snapshot)`` pairs (separated from
+        the scraping so tests can feed it synthetic snapshots). Counters
+        and histograms get one SUMMED series per label set (no ``replica``
+        label) followed by the per-replica series (``replica="<idx>"``);
+        gauges are per-replica only — a summed queue depth or hit-rate
+        gauge would be a lie. Histogram buckets share telemetry's fixed
+        ladder, so cumulative counts sum positionally."""
+        out: dict = {
+            "federated": True,
+            "replicas": [idx for idx, _ in scrapes],
+            "counters": {}, "gauges": {}, "histograms": {},
+        }
+        csum: dict[str, dict[tuple, float]] = {}
+        cper: dict[str, list[dict]] = {}
+        for idx, snap in scrapes:
+            for name, entries in snap.get("counters", {}).items():
+                for e in entries:
+                    key = tuple(sorted(e["labels"].items()))
+                    csum.setdefault(name, {})
+                    csum[name][key] = csum[name].get(key, 0.0) + e["value"]
+                    cper.setdefault(name, []).append({
+                        "labels": {**e["labels"], "replica": str(idx)},
+                        "value": e["value"],
+                    })
+        for name in sorted(csum):
+            out["counters"][name] = [
+                {"labels": dict(key), "value": v}
+                for key, v in sorted(csum[name].items())
+            ] + cper[name]
+        for idx, snap in scrapes:
+            for name, entries in snap.get("gauges", {}).items():
+                out["gauges"].setdefault(name, []).extend(
+                    {"labels": {**e["labels"], "replica": str(idx)},
+                     "value": e["value"]}
+                    for e in entries
+                )
+        hsum: dict[str, dict[tuple, dict]] = {}
+        hper: dict[str, list[dict]] = {}
+        for idx, snap in scrapes:
+            for name, entries in snap.get("histograms", {}).items():
+                for e in entries:
+                    key = tuple(sorted(e["labels"].items()))
+                    acc = hsum.setdefault(name, {}).get(key)
+                    if acc is None:
+                        hsum[name][key] = {
+                            "labels": dict(e["labels"]),
+                            "count": e["count"], "sum": e["sum"],
+                            "buckets": [list(b) for b in e["buckets"]],
+                        }
+                    else:
+                        acc["count"] += e["count"]
+                        acc["sum"] += e["sum"]
+                        for b, eb in zip(acc["buckets"], e["buckets"]):
+                            b[1] += eb[1]
+                    hper.setdefault(name, []).append({
+                        **e, "labels": {**e["labels"], "replica": str(idx)},
+                    })
+        for name in sorted(hsum):
+            out["histograms"][name] = [
+                hsum[name][key] for key in sorted(hsum[name])
+            ] + hper[name]
+        return out
+
+    def topology(self) -> dict:
+        """Fleet shape for dashboards: per-replica generation, port,
+        health, placement tallies, and (for live replicas) a fresh load
+        probe — the same numbers the placement policy ranks on."""
+        reps = []
+        for h in self._replicas:
+            entry = {
+                "idx": h.idx, "gen": h.gen, "port": h.port,
+                "alive": h.alive, "draining": h.draining,
+                "pid": None if h.proc is None else h.proc.pid,
+                "inflight": len(h.inflight),
+                "placements": h.placements,
+                "prefix_hits": h.prefix_hits,
+                "hit_rate": h.prefix_hits / h.placements
+                if h.placements else 0.0,
+                "load": None,
+            }
+            if h.alive:
+                try:
+                    probe = self._http(h, "/fleet/placement", {"prompt": []})
+                    entry["load"] = {
+                        k: probe.get(k)
+                        for k in ("est_wait_s", "backlog_tokens",
+                                  "queue_depth", "occupancy", "backend")
+                    }
+                except (OSError, FleetWireError):
+                    pass
+            reps.append(entry)
+        return {
+            "replicas": reps,
+            "pending": len(self._pending),
+            "requests": len(self._requests),
+            "done": sum(1 for fr in self._requests if fr.done),
+            "placements": self._placements,
+            "prefix_hits": self._prefix_hits,
+            "affinity": self.affinity,
+            "postmortems": sorted(self._postmortems),
+        }
+
+    def placements(self) -> list[dict]:
+        """The placement audit ring, oldest first (bounded by
+        ``TDT_FLEET_PLACEMENT_RING``)."""
+        return list(self._placement_ring)
+
+    def postmortem(self, idx: int) -> dict | None:
+        """The harvested postmortem for replica ``idx`` (None when it never
+        failed — or failed with the flight recorder disabled AND left no
+        ring file)."""
+        return self._postmortems.get(idx)
+
+    def fleet_trace(self, trace_id: int) -> dict:
+        """One chrome://tracing timeline for ``trace_id`` across the whole
+        fleet: the router's own spans (pid 0) merged with every live
+        replica's ``GET /fleet/trace/<id>`` ring (pid 1+idx). A replica
+        with no spans for the trace answers 404 — counted as a ``miss``,
+        not an error; migration shows up as the same trace continuing
+        under the survivor's pid."""
+        segments = [{
+            "label": "router", "pid": 0,
+            "spans": tracing.spans(trace_id, include_open=True),
+        }]
+        for h in self._replicas:
+            if not h.alive:
+                continue
+            outcome = "ok"
+            try:
+                resp = self._http(h, f"/fleet/trace/{trace_id:032x}")
+                segments.append({
+                    "label": f"replica{h.idx} pid={resp.get('pid')}",
+                    "pid": 1 + h.idx,
+                    "spans": resp.get("spans", []),
+                })
+            except FleetWireError:
+                outcome = "miss"
+            except OSError:
+                outcome = "error"
+            telemetry.inc("tdt_fleet_trace_fetches_total", outcome=outcome)
+        return tracing.merge_chrome(segments, trace_id=trace_id)
+
+    # federation route handlers — run on introspection endpoint threads;
+    # they only read router state that is stable between pumps and go over
+    # HTTP for everything replica-side.
+    def _r_metrics(self, method, query, body) -> tuple[int, object]:
+        merged = self.federated_metrics()
+        if "format=json" in (query or ""):
+            return 200, merged
+        return 200, telemetry.to_prometheus(merged)
+
+    def _r_topology(self, method, query, body) -> tuple[int, dict]:
+        return 200, self.topology()
+
+    def _r_placements(self, method, query, body) -> tuple[int, dict]:
+        return 200, {"placements": self.placements()}
+
+    def _r_postmortem(self, method, query, body, rest="") -> tuple[int, dict]:
+        try:
+            idx = int(rest)
+        except ValueError:
+            return 400, {"error": f"bad replica index {rest!r}"}
+        pm = self.postmortem(idx)
+        if pm is None:
+            return 404, {"error": f"no postmortem for replica {idx}"}
+        return 200, pm
+
+    def _r_trace(self, method, query, body, rest="") -> tuple[int, dict]:
+        tid = tracing.parse_trace_id(rest)
+        if tid is None:
+            return 400, {"error": f"bad trace id {rest!r} "
+                                  "(32-hex or decimal expected)"}
+        merged = self.fleet_trace(tid)
+        if not merged["traceEvents"]:
+            return 404, {"error": f"no spans for trace {rest}"}
+        return 200, merged
 
     # --------------------------------------------------------- context mgmt
     def __enter__(self) -> "Router":

@@ -54,9 +54,17 @@ fleet router's replica-discovery contract). One endpoint per process:
 repeated starts return the first.
 
 Extension routes: subsystems register JSON handlers with
-:func:`register_json_route` (exact path, GET and/or POST) — the fleet
-replica control plane (``fleet/replica.py``) mounts its ``/fleet/*``
-routes this way instead of running a second HTTP server per process.
+:func:`register_json_route` — the fleet replica control plane
+(``fleet/replica.py``) and the fleet router's federation routes
+(``fleet/router.py``) mount their ``/fleet/*`` routes this way instead of
+running a second HTTP server per process. Paths ending in ``/`` are
+PREFIX routes (``/fleet/trace/`` serves ``/fleet/trace/<id>``; the
+handler receives the suffix), ``methods=`` restricts verbs (wrong verb →
+structured 405), and a handler returning a ``str`` body is sent as
+``text/plain`` (the federation Prometheus view). Wire-error contract for
+every extension route: malformed JSON → 400, unknown path → 404, wrong
+method → 405, handler crash → 500 — always ``{"error": ...}`` JSON,
+never a stack trace on the wire.
 """
 
 from __future__ import annotations
@@ -73,10 +81,11 @@ _LOCK = threading.Lock()
 _SERVER: "IntrospectionServer | None" = None
 _HEALTH_PROVIDER = None
 _REQUESTS_PROVIDER = None
-#: Exact-path JSON extension routes: path -> fn(method, query, body) ->
-#: (status_code, json_safe_obj). Registered by subsystems (fleet replica
-#: control plane); handlers run on endpoint threads, so they must only
-#: touch thread-safe state.
+#: JSON extension routes: path -> (fn, allowed_methods | None). Exact
+#: paths; a path ending in "/" prefix-matches and its handler receives the
+#: path suffix as a 4th argument. Registered by subsystems (fleet replica
+#: control plane, fleet router federation); handlers run on endpoint
+#: threads, so they must only touch thread-safe state.
 _JSON_ROUTES: dict = {}
 
 #: Default item cap for the list-valued sections of /snapshot and /traces;
@@ -102,17 +111,25 @@ def set_requests_provider(fn) -> None:
     _REQUESTS_PROVIDER = fn
 
 
-def register_json_route(path: str, fn) -> None:
-    """Mount ``fn(method, query, body) -> (code, obj)`` at the exact
-    ``path`` (e.g. ``"/fleet/submit"``); ``body`` is the parsed JSON POST
-    payload (None on GET). Pass ``fn=None`` to unmount. Handlers run on
-    endpoint threads — they must only read thread-safe state or go through
-    locks of their own."""
+def register_json_route(path: str, fn, methods=None) -> None:
+    """Mount ``fn(method, query, body) -> (code, obj)`` at ``path`` (e.g.
+    ``"/fleet/submit"``); ``body`` is the parsed JSON POST payload (None on
+    GET). A ``path`` ending in ``/`` is a PREFIX route: it matches any
+    longer path and ``fn`` is called with the suffix as a 4th positional
+    argument (``fn(method, query, body, rest)`` — how ``/fleet/trace/<id>``
+    mounts). ``methods`` restricts verbs (e.g. ``("POST",)``); any other
+    verb gets a structured 405 without entering the handler; None allows
+    GET and POST both. A handler returning ``(code, str)`` is served as
+    ``text/plain`` instead of JSON. Pass ``fn=None`` to unmount. Handlers
+    run on endpoint threads — they must only read thread-safe state or go
+    through locks of their own."""
     with _LOCK:
         if fn is None:
             _JSON_ROUTES.pop(path, None)
         else:
-            _JSON_ROUTES[path] = fn
+            _JSON_ROUTES[path] = (
+                fn, None if methods is None else frozenset(methods)
+            )
 
 
 def clear_json_routes(prefix: str = "") -> None:
@@ -123,9 +140,40 @@ def clear_json_routes(prefix: str = "") -> None:
             del _JSON_ROUTES[path]
 
 
-def _json_route(path: str):
+def _resolve_route(path: str):
+    """(entry, suffix) for ``path``: exact match first, else the LONGEST
+    registered prefix route (trailing-``/`` paths); (None, None) when
+    nothing matches."""
     with _LOCK:
-        return _JSON_ROUTES.get(path)
+        entry = _JSON_ROUTES.get(path)
+        if entry is not None:
+            return entry, None
+        best = None
+        for p, e in _JSON_ROUTES.items():
+            if p.endswith("/") and path.startswith(p):
+                if best is None or len(p) > len(best[0]):
+                    best = (p, e)
+    if best is not None:
+        return best[1], path[len(best[0]):]
+    return None, None
+
+
+def _dispatch_json(method: str, path: str, query: str, body):
+    """Run the extension route for ``path`` (None when unregistered).
+    Returns ``(code, obj)`` — including the structured 405 when the route
+    exists but not for this verb."""
+    entry, rest = _resolve_route(path)
+    if entry is None:
+        return None
+    fn, methods = entry
+    if methods is not None and method not in methods:
+        return 405, {
+            "error": f"method {method} not allowed for {path!r}",
+            "allow": sorted(methods),
+        }
+    if rest is None:
+        return fn(method, query, body)
+    return fn(method, query, body, rest)
 
 
 def _mesh_section() -> dict:
@@ -235,6 +283,14 @@ class _Handler(http.server.BaseHTTPRequestHandler):
     def _send_json(self, code: int, obj) -> None:
         self._send(code, json.dumps(obj, indent=1), "application/json")
 
+    def _send_route_result(self, code: int, obj) -> None:
+        """Extension-route responses: JSON by default, text/plain when the
+        handler returned a string body (the Prometheus federation view)."""
+        if isinstance(obj, str):
+            self._send(code, obj, "text/plain; version=0.0.4")
+        else:
+            self._send_json(code, obj)
+
     def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler contract
         path, _, query = self.path.partition("?")
         try:
@@ -280,9 +336,9 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                     200, tracing.to_chrome(tid, kernel_traces="kernel=1" in query)
                 )
             else:
-                fn = _json_route(path)
-                if fn is not None:
-                    self._send_json(*fn("GET", query, None))
+                r = _dispatch_json("GET", path, query, None)
+                if r is not None:
+                    self._send_route_result(*r)
                     return
                 self._send_json(404, {
                     "error": f"unknown route {path!r}",
@@ -298,14 +354,14 @@ class _Handler(http.server.BaseHTTPRequestHandler):
     def do_POST(self):  # noqa: N802 - BaseHTTPRequestHandler contract
         path, _, query = self.path.partition("?")
         try:
-            fn = _json_route(path)
-            if fn is None:
-                self._send_json(404, {"error": f"unknown route {path!r}"})
-                return
             n = int(self.headers.get("Content-Length") or 0)
             raw = self.rfile.read(n) if n else b""
             body = json.loads(raw.decode()) if raw else None
-            self._send_json(*fn("POST", query, body))
+            r = _dispatch_json("POST", path, query, body)
+            if r is None:
+                self._send_json(404, {"error": f"unknown route {path!r}"})
+                return
+            self._send_route_result(*r)
         except json.JSONDecodeError as e:
             self._send_json(400, {"error": f"bad JSON body: {e}"})
         except Exception as e:  # a debug endpoint must never kill its thread

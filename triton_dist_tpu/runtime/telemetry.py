@@ -48,19 +48,37 @@ the signal routing bugs need ("AUTO flipped methods between traces").
 Host-side sites (``Engine.serve``, watchdog, abort callbacks) count real
 runtime occurrences. See ``docs/observability.md``.
 
+**Flight recorder** (``TDT_FLIGHT_RECORDER=<dir>``): the event ring and the
+``TDT_TELEMETRY_DUMP`` atexit hook both die with the process — a SIGKILL
+takes the whole story with it. The :class:`FlightRecorder` is the
+crash-surviving sibling: a bounded ring of fixed-size records in an
+mmap-backed file that :func:`emit` (and the span tracer, via
+:func:`flight`) appends to with no fsync on the hot path. Once the bytes
+are memcpy'd into the mapping the KERNEL owns the dirty pages, so a
+SIGKILL'd process loses at most the one record being written at death
+(dropped by :meth:`FlightRecorder.read`'s torn-record check) — only power
+loss can lose more. :func:`flight_postmortem` folds a recovered ring into
+"what was this process doing when it died".
+
 Env flags::
 
     TDT_TELEMETRY        0 disables all collection (default 1)
     TDT_TELEMETRY_DUMP   path: dump a JSON snapshot at process exit
     TDT_EVENT_RING       event-ring capacity (default 1024)
     TDT_KERNEL_TRACE     1 wires KernelTrace into adopted kernels (default 0)
+    TDT_FLIGHT_RECORDER  dir: crash-surviving mmap event ring (default off)
+    TDT_FLIGHT_RECORDS   flight-ring record capacity (default 1024)
 """
 
 from __future__ import annotations
 
 import collections
 import json
+import mmap
+import os
+import struct
 import threading
+import time
 from typing import Any, Iterable, Mapping
 
 from triton_dist_tpu.runtime.utils import get_bool_env, get_int_env
@@ -121,7 +139,7 @@ def reset(enabled_override: bool | None = None) -> None:
     """Clear every metric, event, and kernel trace; re-resolve the enable
     gate from the env (or force it). Tests and operator resets only — a
     serving process keeps its registry for the life of the process."""
-    global _ENABLED, _EVENT_SEQ, _EVENTS
+    global _ENABLED, _EVENT_SEQ, _EVENTS, _FLIGHT, _FLIGHT_RESOLVED
     with _LOCK:
         _COUNTERS.clear()
         _GAUGES.clear()
@@ -133,6 +151,11 @@ def reset(enabled_override: bool | None = None) -> None:
         # between "None" and the override would re-resolve from the env and
         # clobber a forced-off test gate.
         _ENABLED = None if enabled_override is None else bool(enabled_override)
+        fr = _FLIGHT
+        _FLIGHT = None
+        _FLIGHT_RESOLVED = False  # re-resolve TDT_FLIGHT_RECORDER next use
+    if fr is not None:
+        fr.close()
 
 
 # ---------------------------------------------------------------- instruments
@@ -177,7 +200,8 @@ def observe(name: str, value: float, /, **labels) -> None:
 
 
 def emit(kind: str, /, **fields) -> None:
-    """Append one structured event to the bounded ring."""
+    """Append one structured event to the bounded ring (and mirror it into
+    the flight recorder when one is active — the crash-surviving copy)."""
     if not enabled():
         return
     global _EVENT_SEQ
@@ -190,6 +214,9 @@ def emit(kind: str, /, **fields) -> None:
         ev["seq"] = _EVENT_SEQ
         ev["kind"] = kind
         _ring().append(ev)
+    fr = flight_recorder()
+    if fr is not None:
+        fr.append(ev)
 
 
 def events(kind: str | None = None) -> list[dict]:
@@ -217,6 +244,218 @@ def gauge_value(name: str, /, **labels) -> float | None:
     """Current value of one labeled gauge (None when never set)."""
     with _LOCK:
         return _GAUGES.get(_key(name, labels))
+
+
+# ------------------------------------------------------------ flight recorder
+
+#: On-disk format identity: bump on any layout change (self-describing —
+#: the reader trusts the header, not this module's constants).
+FLIGHT_MAGIC = b"TDTFLT1\n"
+FLIGHT_HEADER_BYTES = 64
+FLIGHT_RECORD_BYTES = 256
+#: File name inside a ``TDT_FLIGHT_RECORDER`` directory — fixed so a parent
+#: that knows a child's working dir (the fleet router, which already knows
+#: the journal path) can harvest the ring after a kill -9.
+FLIGHT_FILE = "flight.bin"
+_FLIGHT_REC_HDR = struct.Struct("<QdH")  # seq, monotonic seconds, payload len
+
+
+class FlightRecorder:
+    """Crash-surviving bounded event ring: fixed-size records in an
+    mmap-backed file.
+
+    Layout (little-endian)::
+
+        header (64 B): magic(8) | record_bytes u32 | capacity u32 | pid u32
+                       | pad(4) | seq u64 at offset 24 (last written)
+        records:       capacity × record_bytes, each
+                       seq u64 | t_mono f64 | len u16 | JSON payload
+
+    Record ``seq`` is 1-based and monotonically increasing; a record lands
+    in slot ``(seq - 1) % capacity``, so the file is a ring that always
+    holds the newest ``capacity`` events. Appends memcpy into the mapping
+    and return — no fsync, no msync: the kernel owns the dirty pages from
+    that point, so a SIGKILL (the whole reason this exists — the
+    ``TDT_TELEMETRY_DUMP`` atexit hook never runs under SIGKILL) loses at
+    most the single record being written at death. :meth:`read` drops such
+    a torn record via the seq/JSON checks. Oversized payloads are replaced
+    with a ``{"truncated": true}`` stub rather than torn JSON."""
+
+    def __init__(self, path: str | os.PathLike,
+                 capacity: int | None = None,
+                 record_bytes: int = FLIGHT_RECORD_BYTES):
+        self.path = os.fspath(path)
+        self.capacity = max(
+            get_int_env("TDT_FLIGHT_RECORDS", 1024)
+            if capacity is None else int(capacity), 1
+        )
+        self.record_bytes = max(int(record_bytes), _FLIGHT_REC_HDR.size + 32)
+        parent = os.path.dirname(self.path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        size = FLIGHT_HEADER_BYTES + self.capacity * self.record_bytes
+        fd = os.open(self.path, os.O_RDWR | os.O_CREAT, 0o644)
+        try:
+            os.ftruncate(fd, size)
+            self._mm = mmap.mmap(fd, size)
+        finally:
+            os.close(fd)
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._closed = False
+        struct.pack_into(
+            "<8sIII", self._mm, 0,
+            FLIGHT_MAGIC, self.record_bytes, self.capacity, os.getpid(),
+        )
+        struct.pack_into("<Q", self._mm, 24, 0)
+
+    def append(self, fields: Mapping[str, Any]) -> None:
+        """Write one record (a JSON-safe dict; ``kind`` conventionally
+        present). Hot path: one json.dumps + two pack_into, no syscalls."""
+        payload = json.dumps(
+            dict(fields), separators=(",", ":"), default=str
+        ).encode()
+        cap = self.record_bytes - _FLIGHT_REC_HDR.size
+        if len(payload) > cap:
+            payload = json.dumps(
+                {"kind": fields.get("kind", "?"), "truncated": True},
+                separators=(",", ":"),
+            ).encode()[:cap]
+        with self._lock:
+            if self._closed:
+                return
+            self._seq += 1
+            off = (FLIGHT_HEADER_BYTES
+                   + ((self._seq - 1) % self.capacity) * self.record_bytes)
+            _FLIGHT_REC_HDR.pack_into(
+                self._mm, off, self._seq, time.monotonic(), len(payload)
+            )
+            self._mm[off + _FLIGHT_REC_HDR.size:
+                     off + _FLIGHT_REC_HDR.size + len(payload)] = payload
+            struct.pack_into("<Q", self._mm, 24, self._seq)
+        inc("tdt_flight_records_total")
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            self._mm.flush()
+            self._mm.close()
+
+    @staticmethod
+    def read(path: str | os.PathLike) -> list[dict]:
+        """Decode a flight file (typically another — possibly dead —
+        process's), oldest record first. Self-describing: geometry comes
+        from the file header. Torn or corrupt records (the one being
+        written at death, or slots never yet written) are silently
+        dropped — a postmortem reader must never crash on the crash."""
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except OSError:
+            return []
+        if len(data) < FLIGHT_HEADER_BYTES or data[:8] != FLIGHT_MAGIC:
+            return []
+        record_bytes, capacity, pid = struct.unpack_from("<III", data, 8)
+        if record_bytes <= _FLIGHT_REC_HDR.size or capacity < 1:
+            return []
+        out: list[dict] = []
+        for slot in range(capacity):
+            off = FLIGHT_HEADER_BYTES + slot * record_bytes
+            if off + record_bytes > len(data):
+                break
+            seq, t_mono, ln = _FLIGHT_REC_HDR.unpack_from(data, off)
+            if seq == 0 or ln == 0 or ln > record_bytes - _FLIGHT_REC_HDR.size:
+                continue
+            start = off + _FLIGHT_REC_HDR.size
+            try:
+                obj = json.loads(data[start:start + ln].decode())
+            except (UnicodeDecodeError, json.JSONDecodeError):
+                continue
+            if not isinstance(obj, dict):
+                continue
+            obj["flight_seq"] = seq
+            obj["t_mono_s"] = t_mono
+            obj["pid"] = pid
+            out.append(obj)
+        out.sort(key=lambda r: r["flight_seq"])
+        return out
+
+
+_FLIGHT: FlightRecorder | None = None
+_FLIGHT_RESOLVED = False
+
+
+def flight_recorder() -> FlightRecorder | None:
+    """This process's flight recorder, opened lazily from
+    ``TDT_FLIGHT_RECORDER=<dir>`` (file ``<dir>/flight.bin``). None when
+    the knob is unset or the open failed — recording is strictly optional
+    and must never take down the instrumented process."""
+    global _FLIGHT, _FLIGHT_RESOLVED
+    if not _FLIGHT_RESOLVED:
+        with _LOCK:
+            if not _FLIGHT_RESOLVED:  # double-checked: one ring per process
+                d = os.environ.get("TDT_FLIGHT_RECORDER", "").strip()
+                if d:
+                    try:
+                        _FLIGHT = FlightRecorder(os.path.join(d, FLIGHT_FILE))
+                    except OSError:
+                        _FLIGHT = None
+                _FLIGHT_RESOLVED = True
+    return _FLIGHT
+
+
+def flight_active() -> bool:
+    """One cheap check for high-frequency callers (the span tracer)."""
+    return enabled() and flight_recorder() is not None
+
+
+def flight(kind: str, /, **fields) -> None:
+    """Append one record to the flight recorder ONLY — no event-ring entry.
+    For breadcrumbs too chatty for the in-memory ring (span open/close)
+    whose whole value is surviving a crash."""
+    if not enabled():
+        return
+    fr = flight_recorder()
+    if fr is None:
+        return
+    ev = {
+        k: (v if isinstance(v, (str, int, float, bool, type(None))) else str(v))
+        for k, v in fields.items()
+    }
+    ev["kind"] = kind
+    fr.append(ev)
+
+
+def flight_postmortem(records: list[dict]) -> dict:
+    """Fold recovered flight records into a death report: what was this
+    process doing when it died. ``open_spans`` are spans started but never
+    ended within the ring — at-death activity, with their ``req_id`` /
+    ``slot`` attrs surfaced. Approximate by construction: a span whose
+    start wrapped out of the ring cannot be matched, and the final record
+    may have been torn — the report is evidence, not a transcript."""
+    open_spans: dict[int, dict] = {}
+    for r in records:
+        kind = r.get("kind")
+        if kind == "span_start" and "span_id" in r:
+            open_spans[r["span_id"]] = r
+        elif kind == "span_end":
+            open_spans.pop(r.get("span_id"), None)
+    active = sorted(open_spans.values(), key=lambda r: r.get("flight_seq", 0))
+    return {
+        "n_records": len(records),
+        "last": records[-1] if records else None,
+        "tail": records[-8:],
+        "open_spans": active,
+        "active_requests": sorted(
+            {r["req_id"] for r in active if "req_id" in r}
+        ),
+        "active_slots": sorted({r["slot"] for r in active if "slot" in r}),
+        "active_span_names": sorted(
+            {r["name"] for r in active if "name" in r}
+        ),
+    }
 
 
 # ------------------------------------------------------ kernel-trace collector

@@ -26,6 +26,17 @@ Orca's iteration timeline). This module is that answer:
   row (pid) per trace, span attrs in ``args``, and — via the correlation
   id — the in-kernel ``KernelTrace`` phase marks merged onto the same
   timeline so a request span can zoom into ring-protocol phases.
+* **Cross-process propagation** — :func:`inject` serializes a trace's
+  ``(trace_id, span_id, sampled)`` as a W3C-``traceparent``-style carrier
+  a caller stamps into a wire body; :func:`extract` parses it back and
+  :func:`continue_trace` opens a trace in the RECEIVING process under the
+  sender's trace_id, parented on the sender's span. Traces meant to cross
+  processes start with :func:`start_remote_trace` (globally-unique random
+  trace id — two processes' local counters would collide); the sender's
+  sampling decision travels in the flags byte, so one fleet request is one
+  trace everywhere or nowhere. :func:`merge_chrome` renders span lists
+  collected from SEVERAL processes as one timeline, one pid per process —
+  the fleet router's ``/fleet/trace/<id>`` merge (``docs/fleet.md``).
 
 Clocks: spans stamp raw ``time.monotonic()`` seconds. Callers whose
 bookkeeping lives in another monotonic-derived clock (the serving loop's
@@ -57,9 +68,11 @@ import contextlib
 import contextvars
 import itertools
 import json
+import os
+import re
 import threading
 import time
-from typing import Any, Mapping
+from typing import Any, Mapping, NamedTuple
 
 from triton_dist_tpu.runtime import telemetry
 from triton_dist_tpu.runtime.utils import get_float_env, get_int_env
@@ -217,24 +230,124 @@ class _NoopTrace(Trace):
 NOOP_TRACE = _NoopTrace()
 
 
-def start_trace(name: str, /, **attrs) -> Trace:
-    """Open a new trace (root span starts now). Returns the shared no-op
-    handle when tracing is disabled or the sampler skips this trace — all
-    Trace methods stay safe to call unconditionally."""
+def _sampler_admits() -> bool:
+    """Advance the deterministic error-feedback sampler one trace."""
     global _SAMPLE_ACC
-    if not telemetry.enabled():
-        return NOOP_TRACE
     rate = sample_rate()
     with _LOCK:
         _SAMPLE_ACC += rate
         take = _SAMPLE_ACC >= 1.0
         if take:
             _SAMPLE_ACC -= 1.0
-    if not take:
+    return take
+
+
+def start_trace(name: str, /, **attrs) -> Trace:
+    """Open a new trace (root span starts now). Returns the shared no-op
+    handle when tracing is disabled or the sampler skips this trace — all
+    Trace methods stay safe to call unconditionally."""
+    if not telemetry.enabled() or not _sampler_admits():
         return NOOP_TRACE
     trace_id = next(_IDS)
     sp = _start_span(trace_id, name, None, attrs)
     return Trace(trace_id, sp["span_id"], name, True)
+
+
+# ---------------------------------------------------- cross-process propagation
+
+
+class SpanContext(NamedTuple):
+    """The propagated identity of a span in another process: enough for a
+    receiver to parent its own spans under it. What :func:`inject` carries
+    and :func:`extract` returns."""
+
+    trace_id: int
+    span_id: int
+    sampled: bool
+
+
+#: ``version-traceid(32 hex)-spanid(16 hex)-flags`` (W3C traceparent shape).
+_TRACEPARENT = re.compile(
+    r"^([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$"
+)
+
+
+def new_trace_id() -> int:
+    """A globally-unique (random 63-bit) trace id for traces that will cross
+    process boundaries. Local trace ids come from a per-process counter, so
+    two processes both mint 1, 2, 3… — a propagated trace needs an id no
+    receiving process could collide with."""
+    return (int.from_bytes(os.urandom(8), "big") >> 1) or 1
+
+
+def start_remote_trace(name: str, /, **attrs) -> Trace:
+    """:func:`start_trace`, but with a :func:`new_trace_id` — the entry
+    point for a trace that will be :func:`inject`-ed to other processes
+    (the fleet router's one-trace-per-request)."""
+    if not telemetry.enabled() or not _sampler_admits():
+        return NOOP_TRACE
+    trace_id = new_trace_id()
+    sp = _start_span(trace_id, name, None, attrs)
+    return Trace(trace_id, sp["span_id"], name, True)
+
+
+def inject(trace: Trace, span_id: int | None = None) -> dict:
+    """Serialize ``(trace_id, span_id, sampled)`` as a W3C-traceparent-style
+    carrier dict to stamp into a wire body. ``span_id`` picks the span the
+    receiver should parent under (default: the root span). Unsampled traces
+    inject flags ``00`` so the receiver no-ops too — the sampling decision
+    is made once, at the trace's origin."""
+    sid = trace.root_id if span_id is None else int(span_id)
+    flags = "01" if trace.sampled else "00"
+    return {"traceparent": f"00-{trace.trace_id:032x}-{sid:016x}-{flags}"}
+
+
+def extract(carrier) -> SpanContext | None:
+    """Parse a carrier produced by :func:`inject` (the dict, or the raw
+    ``traceparent`` string). Returns None on anything missing or malformed —
+    the caller falls back to a local root trace, never errors: a bad peer
+    must not be able to break admission."""
+    if carrier is None:
+        return None
+    tp = carrier.get("traceparent") if isinstance(carrier, Mapping) else carrier
+    if not isinstance(tp, str):
+        return None
+    m = _TRACEPARENT.match(tp.strip().lower())
+    if m is None or m.group(1) == "ff":
+        return None
+    trace_id = int(m.group(2), 16)
+    span_id = int(m.group(3), 16)
+    if trace_id == 0 or span_id == 0:
+        return None
+    return SpanContext(trace_id, span_id, bool(int(m.group(4), 16) & 1))
+
+
+def parse_trace_id(s: str) -> int | None:
+    """Parse a trace id off a URL path: 32-hex (the traceparent form
+    :func:`inject` emits) as hex, all-digits as decimal (local counter
+    ids); None on anything else — the ``/fleet/trace/<id>`` routes' shared
+    input gate."""
+    s = s.strip().lower()
+    if re.fullmatch(r"[0-9a-f]{32}", s):
+        return int(s, 16)
+    if s.isdigit():
+        return int(s)
+    return None
+
+
+def continue_trace(ctx: SpanContext | None, name: str, /, **attrs) -> Trace:
+    """Open a trace that CONTINUES a remote one: same trace_id, root span
+    parented under the remote span. Sampling follows the SENDER's decision
+    (the flags byte), not the local sampler — one fleet request is one
+    trace in every process or in none. ``ctx=None`` (no carrier on the
+    wire) falls back to a plain local :func:`start_trace`, so standalone
+    operation is unchanged."""
+    if ctx is None:
+        return start_trace(name, **attrs)
+    if not telemetry.enabled() or not ctx.sampled:
+        return NOOP_TRACE
+    sp = _start_span(ctx.trace_id, name, ctx.span_id, attrs)
+    return Trace(ctx.trace_id, sp["span_id"], name, True)
 
 
 @contextlib.contextmanager
@@ -266,6 +379,7 @@ def _start_span(trace_id: int, name: str, parent_id: int | None,
     }
     with _LOCK:
         _OPEN[sp["span_id"]] = sp
+    _flight_span("span_start", sp)
     return sp
 
 
@@ -274,6 +388,21 @@ def _finish_span(sp: dict, end_s: float | None = None) -> None:
     with _LOCK:
         _OPEN.pop(sp["span_id"], None)
         _ring().append(sp)
+    _flight_span("span_end", sp)
+
+
+def _flight_span(event: str, sp: dict) -> None:
+    """Mirror one span edge into the crash-surviving flight recorder (when
+    one is active): the span-start breadcrumbs are how a postmortem knows
+    which request/slot/span a SIGKILL'd process was executing — attrs ride
+    along so ``req_id``/``slot`` survive with the span."""
+    if not telemetry.flight_active():
+        return
+    telemetry.flight(event, **{
+        **sp["attrs"],
+        "name": sp["name"], "trace_id": sp["trace_id"],
+        "span_id": sp["span_id"], "parent_id": sp["parent_id"],
+    })
 
 
 # ------------------------------------------------------------- ambient access
@@ -423,6 +552,60 @@ def to_chrome(trace_id: int | list[int] | None = None,
                     "args": {"step": e["step"], "aux": e["aux"],
                              "corr_span": corr[1]},
                 })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def merge_chrome(segments: list[dict], trace_id: int | None = None) -> dict:
+    """Merge span lists collected from SEVERAL processes into one
+    chrome://tracing JSON — the cross-process counterpart of
+    :func:`to_chrome`.
+
+    Each segment is ``{"label": str, "pid": int, "spans": [span dicts]}``
+    (spans in the wire shape ``spans()`` / the ``/fleet/trace/<id>`` route
+    return). One process row per segment, ``trace_id`` optionally filters
+    every segment to one trace, and timestamps normalize to the earliest
+    span across ALL segments. Same-host processes share the monotonic
+    clock's boot epoch (Linux ``CLOCK_MONOTONIC``), so a router and its
+    replica subprocesses align on one real timeline; spans still open in a
+    segment (a snapshot of a live process) render to the latest end seen.
+    ``span_id``/``parent_id`` stay in ``args`` — ids are per-process, so
+    chains are machine-checkable WITHIN a segment and across the injected
+    parent link (a receiver's root span carries the sender's span id)."""
+    segs = []
+    all_spans: list[dict] = []
+    for i, seg in enumerate(segments):
+        sps = [s for s in seg.get("spans", ())
+               if trace_id is None or s.get("trace_id") == trace_id]
+        if not sps:
+            continue
+        segs.append((seg.get("label", f"proc{i}"), seg.get("pid", i), sps))
+        all_spans.extend(sps)
+    if not all_spans:
+        return {"traceEvents": [], "displayTimeUnit": "ms"}
+    t0 = min(s["start_s"] for s in all_spans)
+    t_end = max(
+        (s["end_s"] if s["end_s"] is not None else s["start_s"])
+        for s in all_spans
+    )
+    events: list[dict] = []
+    for label, pid, sps in segs:
+        events.append({
+            "name": "process_name", "ph": "M", "pid": pid,
+            "args": {"name": label},
+        })
+        for s in sorted(sps, key=lambda x: x["start_s"]):
+            end = s["end_s"] if s["end_s"] is not None else t_end
+            events.append({
+                "name": s["name"], "ph": "X",
+                "ts": (s["start_s"] - t0) * 1e6,
+                "dur": max((end - s["start_s"]) * 1e6, 0.0),
+                "pid": pid, "tid": 0,
+                "args": {
+                    **s["attrs"], "span_id": s["span_id"],
+                    "parent_id": s["parent_id"], "proc": label,
+                    **({} if s["end_s"] is not None else {"open": True}),
+                },
+            })
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 

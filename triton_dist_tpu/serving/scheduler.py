@@ -480,7 +480,8 @@ class Scheduler:
                on_token=None, on_finish=None, now_s: float | None = None,
                priority: int = 1, ttft_deadline_s: float | None = None,
                deadline_s: float | None = None,
-               tokens=None) -> Request:
+               tokens=None,
+               trace_ctx: "tracing.SpanContext | None" = None) -> Request:
         """Admission-check and enqueue one request (FCFS). Returns the
         request handle; a rejected request comes back with
         ``state=REJECTED`` and ``reject_reason`` set — it is NOT queued.
@@ -489,7 +490,11 @@ class Scheduler:
         seeds an already-generated history (fleet migration): the request
         enters the queue with it attached, so the join sweep re-prefills
         from ``prompt + tokens`` — seeded before enqueue, never racing the
-        serving loop."""
+        serving loop. ``trace_ctx`` is an extracted remote trace context
+        (``tracing.extract``): when given, the request trace CONTINUES the
+        sender's trace — same trace_id, root span parented under the
+        sender's span (the fleet router's placement span), sender's
+        sampling decision — instead of opening a fresh local one."""
         prompt = [int(t) for t in prompt]
         req = Request(
             req_id=self._new_id(), prompt=prompt, max_new=int(max_new),
@@ -508,8 +513,8 @@ class Scheduler:
         )
         now = time.monotonic() if now_s is None else now_s
         req.submitted_at = now
-        req.trace = tracing.start_trace(
-            "tdt_serving_request", req_id=req.req_id,
+        req.trace = tracing.continue_trace(
+            trace_ctx, "tdt_serving_request", req_id=req.req_id,
             prompt_len=len(prompt), max_new=req.max_new,
         )
         telemetry.inc("tdt_serving_requests_total")

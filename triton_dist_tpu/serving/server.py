@@ -338,15 +338,19 @@ class InferenceServer:
     def submit(self, prompt, max_new: int, arrival_time_s: float = 0.0,
                on_token=None, on_finish=None, priority: int = 1,
                ttft_deadline_s: float | None = None,
-               deadline_s: float | None = None) -> Request:
+               deadline_s: float | None = None,
+               trace_ctx=None) -> Request:
         """Admission-check and enqueue one request; returns its handle
         (``state=REJECTED`` + ``reject_reason`` when not admitted). Admitted
-        requests are journaled (write-ahead) when a journal is attached."""
+        requests are journaled (write-ahead) when a journal is attached.
+        ``trace_ctx`` (an extracted ``tracing.SpanContext``) makes the
+        request trace continue a remote caller's trace — the fleet replica
+        passes the router's propagated context through here."""
         req = self.scheduler.submit(
             prompt, max_new, arrival_time_s=arrival_time_s,
             on_token=on_token, on_finish=on_finish, now_s=self._now(),
             priority=priority, ttft_deadline_s=ttft_deadline_s,
-            deadline_s=deadline_s,
+            deadline_s=deadline_s, trace_ctx=trace_ctx,
         )
         if self._journal is not None and req.state is RequestState.QUEUED:
             # Rejections are never journaled: there is nothing to resume.
@@ -368,7 +372,8 @@ class InferenceServer:
 
     def resume(self, prompt, max_new: int, tokens, on_token=None,
                on_finish=None, priority: int = 1,
-               deadline_s: float | None = None) -> Request:
+               deadline_s: float | None = None,
+               trace_ctx=None) -> Request:
         """Admit a request MID-STREAM: ``tokens`` is the history another
         server already streamed for it (journal-replay migration — the
         fleet router moving an in-flight request off a dead or draining
@@ -384,7 +389,7 @@ class InferenceServer:
         req = self.scheduler.submit(
             prompt, max_new, on_token=on_token, on_finish=on_finish,
             now_s=self._now(), priority=priority, deadline_s=deadline_s,
-            tokens=toks,
+            tokens=toks, trace_ctx=trace_ctx,
         )
         if req.state is not RequestState.QUEUED:
             return req

@@ -1213,6 +1213,148 @@ def bench_serving_fleet(on_tpu):
     return out
 
 
+def bench_serving_fleet_gray(on_tpu):
+    """Gray-failure fleet benchmark (the health machine in fleet/router.py):
+    prices what a *gray* replica costs a 2-replica fleet. Three arms, same
+    warm-up wave + timed burst each: **healthy** (the baseline,
+    ``serving_fleet_gray_healthy_tokens_per_s``); **straggler** — a scripted
+    ``TDT_FLEET_CHAOS`` program delays every stream poll to replica 1 while
+    ``TDT_FLEET_SLOW_MS`` lets the probe-latency EWMA mark it SUSPECT, so
+    placement steers the burst onto the healthy peer
+    (``serving_fleet_gray_tokens_per_s``, ``serving_fleet_gray_ttft_p99_ms``
+    — the gap vs healthy is the measured cost of serving around a gray
+    replica; its sign is host-dependent — on a single core, consolidating
+    the burst onto the survivor can beat two contending replica processes,
+    and each series is gated against its own trajectory, not the other);
+    **migration** — SIGKILL replica 0 mid-burst and report the
+    mean of the ``tdt_fleet_migration_seconds`` histogram as
+    ``serving_fleet_gray_migration_ms`` (detection -> resumed-on-survivor).
+    Both tokens/s series and the two ``_ms`` series are gated by
+    check_bench_regression.py; the chaos suite's ``fleet-hang`` /
+    ``fleet-flaky-wire`` / ``fleet-crash-loop`` rows assert the correctness
+    side of the same arcs."""
+    import math
+    import os
+    import shutil
+    import tempfile
+    import time
+
+    from triton_dist_tpu.fleet import Router
+    from triton_dist_tpu.runtime import telemetry
+    from triton_dist_tpu.runtime.utils import get_int_env
+
+    env = {
+        "JAX_PLATFORMS": "cpu",
+        "TDT_INTERPRET_FALLBACK": "1",
+        "TDT_SERVE_SLOTS": "2",
+        "TDT_SERVE_CHUNK": "2",
+    }
+    block = get_int_env("TDT_KV_BLOCK_SIZE", 16)
+    pa = [(5 * j + 3) % 256 for j in range(block)]
+    pb = [(11 * j + 7) % 256 for j in range(block)]
+    warm = [(pa + [1], 8), (pb + [2], 8)]
+    burst = [(p + [i + 3], 14) for i, p in enumerate([pa, pb, pa, pb, pa, pb])]
+    out = {
+        "serving_fleet_gray_replicas": 2,
+        "serving_fleet_gray_requests": len(burst),
+    }
+
+    def run_burst(router):
+        """Timed burst -> (tokens_per_s, per-request TTFT seconds)."""
+        states = []
+        t0 = time.perf_counter()
+        frs = []
+        for p, g in burst:
+            state = {"sub": time.perf_counter()}
+            states.append(state)
+
+            def cb(fr, tok, i, _s=state):
+                if "ttft" not in _s:
+                    _s["ttft"] = time.perf_counter() - _s["sub"]
+
+            frs.append(router.submit(p, g, on_token=cb))
+        router.serve_all(timeout_s=180)
+        wall = time.perf_counter() - t0
+        toks = sum(len(fr.tokens) for fr in frs)
+        ttfts = [s["ttft"] for s in states if "ttft" in s]
+        return toks / wall, ttfts
+
+    def mig_hist():
+        h = telemetry.snapshot()["histograms"].get(
+            "tdt_fleet_migration_seconds", [])
+        return sum(e["count"] for e in h), sum(e["sum"] for e in h)
+
+    # Straggler wire: the program must outlast the warm-up wave's polls so
+    # the timed burst still sees delays; 400 events is far past both.
+    straggle = ",".join(["delay@/fleet/stream#1:20ms"] * 400) + ",heal"
+    arms = (("healthy", "", None), ("straggler", straggle, "10"))
+    for label, chaos, slow_ms in arms:
+        workdir = tempfile.mkdtemp(prefix=f"tdt_bench_gray_{label}_")
+        prev_slow = os.environ.get("TDT_FLEET_SLOW_MS")
+        if slow_ms is not None:
+            os.environ["TDT_FLEET_SLOW_MS"] = slow_ms
+        try:
+            with Router(2, workdir, env=env, wire_chaos=chaos) as router:
+                router.start()
+                for p, g in warm:
+                    router.submit(p, g)
+                router.serve_all(timeout_s=180)
+                # Best-of-3 like serving_fleet: one sub-second burst is
+                # poll-cadence noise, which would swamp the healthy-vs-gray
+                # gap this pair exists to measure. TTFTs pool across bursts.
+                best, ttfts = 0.0, []
+                for _ in range(3):
+                    tps, t = run_burst(router)
+                    best = max(best, tps)
+                    ttfts.extend(t)
+                if label == "healthy":
+                    out["serving_fleet_gray_healthy_tokens_per_s"] = round(
+                        best, 1)
+                else:
+                    out["serving_fleet_gray_tokens_per_s"] = round(best, 1)
+                    if ttfts:
+                        rank = max(0, math.ceil(0.99 * len(ttfts)) - 1)
+                        out["serving_fleet_gray_ttft_p99_ms"] = round(
+                            sorted(ttfts)[rank] * 1000.0, 1)
+        finally:
+            shutil.rmtree(workdir, ignore_errors=True)
+            if slow_ms is not None:
+                if prev_slow is None:
+                    os.environ.pop("TDT_FLEET_SLOW_MS", None)
+                else:
+                    os.environ["TDT_FLEET_SLOW_MS"] = prev_slow
+
+    # Migration arm: SIGKILL replica 0 once tokens are flowing; the delta of
+    # the migration histogram across the arm isolates THESE migrations from
+    # any earlier section's (rolling rebuild also migrates).
+    workdir = tempfile.mkdtemp(prefix="tdt_bench_gray_kill_")
+    n0, s0 = mig_hist()
+    try:
+        with Router(2, workdir, env=env, wire_chaos="") as router:
+            router.start()
+            for p, g in warm:
+                router.submit(p, g)
+            router.serve_all(timeout_s=180)
+            frs = [router.submit(p, g) for p, g in burst]
+            deadline = time.monotonic() + 60.0
+            while (not any(fr.tokens for fr in frs)
+                   and time.monotonic() < deadline):
+                router.pump()
+                time.sleep(0.01)
+            router.kill(0)
+            router.serve_all(timeout_s=180)
+            out["serving_fleet_gray_kill_requests_done"] = sum(
+                1 for fr in frs if fr.done)
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+    n1, s1 = mig_hist()
+    if n1 > n0:
+        out["serving_fleet_gray_migrations"] = n1 - n0
+        out["serving_fleet_gray_migration_ms"] = round(
+            (s1 - s0) / (n1 - n0) * 1000.0, 1)
+    return out
+
+
 def bench_moe_decode(on_tpu):
     """MoE decode benchmark (the EP subsystem, models/moe.py): serves the
     ``test-moe`` EP model through the full continuous-batching loop on the
@@ -1959,6 +2101,17 @@ def main():
         emit()
     else:
         extra["serving_fleet_skipped"] = "budget"
+    if remaining() > 240:
+        # Three more 2-replica fleets boot inside this section (healthy,
+        # straggler-wire, kill-mid-burst), so it gets the same big slice.
+        phase("serving_fleet_gray")
+        try:
+            absorb(bench_serving_fleet_gray(on_tpu))
+        except Exception as e:  # noqa: BLE001
+            extra["serving_fleet_gray_error"] = f"{type(e).__name__}"
+        emit()
+    else:
+        extra["serving_fleet_gray_skipped"] = "budget"
     if remaining() > 45:
         phase("moe_decode")
         try:

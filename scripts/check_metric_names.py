@@ -127,6 +127,13 @@ REQUIRED_NAMES = {
     "tdt_fleet_http_errors_total",
     "tdt_fleet_postmortems_total",
     "tdt_flight_records_total",
+    # gray-failure tolerance: health state machine, wire retries, progress
+    # watchdog, supervised respawn (fleet/router.py)
+    "tdt_fleet_health_state",
+    "tdt_fleet_wire_retries_total",
+    "tdt_fleet_stall_migrations_total",
+    "tdt_fleet_respawns_total",
+    "tdt_fleet_migration_seconds",
     # span names
     "tdt_serving_probe",
     "tdt_serving_restore",

@@ -26,7 +26,18 @@
 #   fleet-postmortem    SIGKILL a replica mid-work -> the router recovers
 #                       which request/slot/span it was executing at death
 #                       from the crash-surviving flight record, no exit
-#                       hook involved (NEW)
+#                       hook involved
+#   fleet-hang          gray failure: a wire hang (sticky chaos) and a wedged
+#                       serving loop (process alive, zero token progress) ->
+#                       the progress watchdog quarantines, kills, and
+#                       migrates byte-identically (NEW)
+#   fleet-flaky-wire    scripted connection resets on /fleet/stream -> the
+#                       bounded retry layer absorbs them; zero migrations,
+#                       zero replica failures, streams byte-exact (NEW)
+#   fleet-crash-loop    a replica that dies at every respawn -> capped
+#                       exponential respawn backoff, then the crash-loop
+#                       breaker quarantines it; the survivor keeps serving;
+#                       plus the healthy supervised-respawn arc (NEW)
 #   observability       chaos arcs stay visible in traces + telemetry
 #
 # The env pins below make the arcs quick and reproducible:
@@ -81,6 +92,17 @@ run_scenario fleet-migration \
   tests/test_fleet.py::test_fleet_kill_one_of_three_mid_burst "$@"
 run_scenario fleet-postmortem \
   tests/test_fleet.py::test_fleet_postmortem_flight_record_after_kill "$@"
+run_scenario fleet-hang \
+  tests/test_fleet.py::test_fleet_hang_watchdog_quarantines_and_migrates \
+  tests/test_fleet.py::test_fleet_serving_loop_stall_watchdog_migrates \
+  tests/test_fleet.py::test_fleet_deadline_expires_against_original_budget_mid_migration \
+  "$@"
+run_scenario fleet-flaky-wire \
+  tests/test_fleet.py::test_fleet_flaky_wire_reset_absorbed_without_migration \
+  "$@"
+run_scenario fleet-crash-loop \
+  tests/test_fleet.py::test_fleet_crash_loop_breaker_contains_respawn_storm \
+  tests/test_fleet.py::test_fleet_supervised_respawn_brings_replica_back "$@"
 run_scenario observability tests/test_telemetry.py tests/test_tracing.py "$@"
 
 echo

@@ -145,6 +145,22 @@ def test_chaos_check_abort_marks_and_raises():
     assert ev["site"] == "prefill" and ev["action"] == "abort"
 
 
+def test_chaos_check_stall_wedges_caller_then_runs_clean(monkeypatch):
+    """``stall`` wedges the CALLING thread for ``TDT_CHAOS_STALL_S`` while
+    the process stays alive — the gray-failure shape the fleet progress
+    watchdog detects. Nothing is marked degraded and no error is raised:
+    from the inside, a wedged loop looks perfectly healthy."""
+    monkeypatch.setenv("TDT_CHAOS_STALL_S", "0.05")
+    with resilience.chaos_schedule("stall@decode,heal"):
+        t0 = time.monotonic()
+        resilience.chaos_check("decode")
+        assert time.monotonic() - t0 >= 0.05
+        resilience.chaos_check("decode")     # program exhausted: clean
+    assert not resilience.is_degraded("collectives")
+    assert telemetry.counter_value(
+        "tdt_resilience_chaos_injected_total", site="decode") == 1.0
+
+
 # ======================================== probe arc: degrade → restore
 
 

@@ -669,6 +669,13 @@ def test_router_federation_routes(monkeypatch, tmp_path):
         assert [r["idx"] for r in topo["replicas"]] == [0, 1]
         assert not any(r["alive"] for r in topo["replicas"])
         assert topo["pending"] == 1 and topo["requests"] == 1
+        for r in topo["replicas"]:               # health fields ride along
+            assert r["health"] == "live"
+            assert r["consecutive_failures"] == 0
+            assert r["probe_ewma_ms"] == 0.0
+            assert r["stall_age_s"] is None      # not alive: no stall clock
+            assert r["respawn_failures"] == 0
+            assert not r["breaker_tripped"]
 
         status, text = _get_raw(base + "/fleet/metrics")
         assert status == 200
@@ -769,6 +776,296 @@ def test_placement_audit_ring_records_why_and_is_bounded(monkeypatch,
     assert cands[1]["est_wait_s"] == pytest.approx(0.2)
 
 
+# ========================================== gray-failure tolerance (fast)
+# Pure units: the health state machine, the wire-chaos grammar, error
+# classification, retry accounting, deadline stamping — deterministic
+# clocks, monkeypatched wires, no subprocesses.
+
+
+def test_replica_health_thresholds_and_heal():
+    from triton_dist_tpu.fleet.router import ReplicaHealth
+
+    hp = ReplicaHealth(suspect_after=2, dead_after=4, now=0.0)
+    hp.note_failure(1.0)
+    assert hp.state == "live" and hp.failures == 1   # one blip is free
+    hp.note_failure(2.0)
+    assert hp.state == "suspect"                     # leaves placement
+    hp.note_ok(3.0, 0.01)
+    assert hp.state == "live" and hp.failures == 0   # one success heals
+    for t in range(4):
+        hp.note_failure(4.0 + t)
+    assert hp.state == "dead"                        # migration verdict
+    hp.note_ok(9.0, 0.01)
+    assert hp.state == "dead"                        # only reset() revives
+    hp.reset(10.0)
+    assert hp.state == "live" and hp.failures == 0
+
+    # Heartbeat staleness and the progress-watchdog predicate.
+    hp2 = ReplicaHealth(heartbeat_s=1.0, now=0.0)
+    assert not hp2.stale(2.9) and hp2.stale(3.0)     # 3 missed intervals
+    hp2.note_progress(5.0)
+    assert hp2.stall_age_s(6.5) == pytest.approx(1.5)
+    assert not hp2.stalled(6.0, 2.0) and hp2.stalled(7.0, 2.0)
+    assert not hp2.stalled(1e9, 0.0)                 # 0 disables the watchdog
+
+
+def test_replica_health_straggler_ewma_marks_suspect():
+    from triton_dist_tpu.fleet.router import ReplicaHealth
+
+    hp = ReplicaHealth(slow_ms=50.0, now=0.0)
+    hp.note_ok(1.0, 0.001)
+    assert hp.state == "live"
+    for i in range(20):                              # 200ms calls: straggler
+        hp.note_ok(2.0 + i, 0.2)
+    assert hp.ewma_ms > 50.0 and hp.state == "suspect"
+    for i in range(60):                              # fast again: heals
+        hp.note_ok(30.0 + i, 0.001)
+    assert hp.ewma_ms < 50.0 and hp.state == "live"
+
+
+def test_replica_health_respawn_backoff_doubles_and_breaker_trips():
+    from triton_dist_tpu.fleet.router import ReplicaHealth
+
+    hp = ReplicaHealth(respawn_s=0.5, respawn_cap_s=2.0, crash_loop_n=3,
+                       now=0.0)
+    assert hp.schedule_respawn(10.0) == 0.5
+    assert not hp.respawn_due(10.4) and hp.respawn_due(10.5)
+    assert hp.respawn_result(False, 11.0) == 1.0     # 0.5 × 2^1
+    assert hp.next_respawn_at == pytest.approx(12.0)
+    assert hp.respawn_result(False, 13.0) == 2.0     # 0.5 × 2^2, capped
+    assert hp.respawn_result(False, 16.0) is None    # 3rd death: breaker
+    assert hp.breaker_tripped and hp.state == "quarantined"
+    assert not hp.respawn_due(1e9)                   # pinned down for good
+
+    hp2 = ReplicaHealth(respawn_s=0.5, crash_loop_n=3, now=0.0)
+    hp2.respawn_result(False, 1.0)
+    assert hp2.respawn_result(True, 2.0) == 0.0      # success resets
+    assert hp2.respawn_failures == 0 and hp2.state == "live"
+
+    hp3 = ReplicaHealth(now=0.0)                     # supervision off
+    assert hp3.respawn_delay() == 0.0 and not hp3.respawn_due(1e9)
+
+
+def test_classify_oserror_codes():
+    from triton_dist_tpu.fleet.router import _classify_oserror
+
+    assert _classify_oserror(ConnectionRefusedError()) == "refused"
+    assert _classify_oserror(ConnectionResetError()) == "reset"
+    assert _classify_oserror(ConnectionAbortedError()) == "reset"
+    assert _classify_oserror(BrokenPipeError()) == "reset"
+    assert _classify_oserror(TimeoutError()) == "timeout"
+    assert _classify_oserror(OSError("misc")) == "conn"
+    # urllib wraps the socket error in URLError: unwrap what it carries.
+    assert _classify_oserror(
+        urllib.error.URLError(ConnectionRefusedError())) == "refused"
+    assert _classify_oserror(urllib.error.URLError("just a string")) == "conn"
+
+
+def test_wire_chaos_schedule_parse_take_and_sticky_hang():
+    s = resilience.WireChaosSchedule(
+        "delay@/fleet/stream:50ms, reset@/fleet/stream#1:1,"
+        "hang@/fleet/status,heal"
+    )
+    ev = s.take("/fleet/stream", 0)
+    assert ev.action == "delay" and ev.delay_s == pytest.approx(0.05)
+    # The head now targets replica 1: replica 0 neither fires it nor
+    # consumes its skip; replica 1's first matching call burns the skip,
+    # its second fires.
+    assert s.take("/fleet/stream", 0) is None
+    assert s.take("/fleet/stream", 1) is None        # skip=1 consumed
+    ev = s.take("/fleet/stream", 1)
+    assert ev is not None and ev.action == "reset"
+    # hang is STICKY: fires on its first match and every one after.
+    assert s.take("/fleet/stream", 1) is None        # path mismatch
+    assert s.take("/fleet/status", 2).action == "hang"
+    assert s.take("/fleet/status", 0).action == "hang"
+    assert not s.exhausted                           # sticky keeps it armed
+    # Duration forms: 0.5s and bare seconds.
+    s2 = resilience.WireChaosSchedule("delay@/x:0.5s,delay@/x:2")
+    assert s2.take("/x").delay_s == 0.5
+    assert s2.take("/x").delay_s == 2.0
+    assert s2.exhausted
+
+
+@pytest.mark.parametrize("spec", [
+    "heal,reset@/fleet/stream",      # heal must be last
+    "explode@/fleet/stream",         # unknown action
+    "reset@stream",                  # path must start with /
+    "delay@/fleet/stream",           # delay needs a duration arg
+    "delay@/fleet/stream:fast",      # bad duration
+    "reset@/fleet/stream#x",         # bad replica index
+    "reset@/fleet/stream:1.5",       # bad skip
+    "reset",                         # missing @
+])
+def test_wire_chaos_schedule_rejects_bad_specs(spec):
+    with pytest.raises(ValueError):
+        resilience.WireChaosSchedule(spec)
+
+
+def test_router_http_retry_absorbs_reset_then_accounts_health(
+        monkeypatch, tmp_path):
+    """One reset costs one retry (replica stays LIVE); exhausting the
+    retry budget costs ONE health failure (SUSPECT, not migration); the
+    next clean call heals back to LIVE — with every step visible in
+    ``tdt_fleet_wire_retries_total`` / ``tdt_fleet_health_state``."""
+    monkeypatch.setenv("TDT_HTTP_PORT", "0")
+    monkeypatch.setenv("TDT_FLEET_RETRY_BACKOFF_S", "0")
+    ep = introspect.maybe_start()
+    assert ep is not None
+    introspect.register_json_route(
+        "/fleet/status", lambda m, q, b: (200, {"ready": True}),
+        methods=("GET",))
+    try:
+        router = Router(1, tmp_path, wire_chaos="reset@/fleet/status,heal")
+        h = router.replicas[0]
+        h.port, h.alive = ep.port, True
+
+        assert router._http(h, "/fleet/status")["ready"]  # retry absorbed it
+        assert telemetry.counter_value(
+            "tdt_fleet_wire_retries_total",
+            path="/fleet/status", code="reset") == 1.0
+        assert h.health.state == "live" and h.health.failures == 0
+        assert telemetry.gauge_value(
+            "tdt_fleet_health_state", replica="0") == 0.0
+
+        # Three resets exhaust retries=2: one OSError, one health failure.
+        router._wire_chaos = resilience.WireChaosSchedule(
+            "reset@/fleet/status,reset@/fleet/status,reset@/fleet/status,heal"
+        )
+        with pytest.raises(OSError):
+            router._http(h, "/fleet/status")
+        assert h.health.state == "suspect" and h.health.failures == 1
+        assert telemetry.gauge_value(
+            "tdt_fleet_health_state", replica="0") == 1.0
+
+        assert router._http(h, "/fleet/status")["ready"]  # clean call heals
+        assert h.health.state == "live"
+        assert telemetry.gauge_value(
+            "tdt_fleet_health_state", replica="0") == 0.0
+
+        # Non-idempotent route + reset: NO retry (a duplicate admit could
+        # double-serve) — the error surfaces on the first attempt.
+        router._wire_chaos = resilience.WireChaosSchedule(
+            "reset@/fleet/submit,heal")
+        with pytest.raises(ConnectionResetError):
+            router._http(h, "/fleet/submit", {"prompt": [1]})
+        assert telemetry.counter_value(
+            "tdt_fleet_wire_retries_total",
+            path="/fleet/submit", code="reset") == 0.0
+    finally:
+        ep.stop()
+
+
+def test_router_http_refused_retries_even_submit(monkeypatch, tmp_path):
+    """``refused`` means the connection never reached a server, so even
+    ``/fleet/submit`` retries safely — and the exhausted run is one
+    health failure."""
+    import socket
+
+    monkeypatch.setenv("TDT_FLEET_RETRY_BACKOFF_S", "0")
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        dead_port = s.getsockname()[1]       # bound then closed: refuses
+    router = Router(1, tmp_path, wire_chaos="")
+    h = router.replicas[0]
+    h.port, h.alive = dead_port, True
+    with pytest.raises(OSError):
+        router._http(h, "/fleet/submit", {"prompt": [1], "max_new": 2})
+    assert telemetry.counter_value(
+        "tdt_fleet_wire_retries_total",
+        path="/fleet/submit", code="refused") == 2.0
+    assert telemetry.counter_value(
+        "tdt_fleet_http_errors_total",
+        path="/fleet/submit", code="refused") == 3.0
+    assert h.health.state == "suspect" and h.health.failures == 1
+
+
+def test_deadline_stamps_remaining_budget_and_migration_shrinks(
+        monkeypatch, tmp_path):
+    router = Router(1, tmp_path)
+    h = router.replicas[0]
+    h.alive = True
+    calls = []
+
+    def fake_http(handle, path, body=None, **kw):
+        calls.append((path, body))
+        if path == "/fleet/placement":
+            return _hint()
+        return {"state": "queued", "req_id": 7}
+
+    monkeypatch.setattr(router, "_http", fake_http)
+    fr = router.submit([1, 2, 3], 8, ttft_deadline_s=5.0, deadline_s=10.0)
+    sub = next(b for p, b in calls if p == "/fleet/submit")
+    assert 9.5 < sub["deadline_s"] <= 10.0           # remaining, not total
+    assert 4.5 < sub["ttft_deadline_s"] <= 5.0
+
+    # Migration re-stamp 3s later: the residual SHRANK, and a seeded
+    # resume carries no TTFT budget (first token already happened).
+    fr.arrived_at -= 3.0
+    fr._seed = [101, 102]
+    h.inflight.clear()
+    assert router._send(fr, h)
+    res = next(b for p, b in calls if p == "/fleet/resume")
+    assert 6.5 < res["deadline_s"] <= 7.0
+    assert "ttft_deadline_s" not in res
+    assert res["tokens"] == [101, 102]
+
+    # No deadlines: nothing stamped on the wire.
+    fr2 = router.submit([4, 5], 4)
+    sub2 = [b for p, b in calls if p == "/fleet/submit"][-1]
+    assert fr2.done is False
+    assert "deadline_s" not in sub2 and "ttft_deadline_s" not in sub2
+
+
+def test_parked_deadline_expires_router_side(tmp_path):
+    router = Router(1, tmp_path)                     # no replica alive
+    fr = router.submit([1, 2], 4, deadline_s=5.0)
+    assert not fr.done and router._pending
+    fr.arrived_at -= 10.0                            # budget long gone
+    assert router.pump()
+    assert fr.done and fr.finish_reason == "deadline"
+    assert not router._pending
+    assert telemetry.gauge_value("tdt_fleet_pending_requests") == 0.0
+
+
+def test_serve_all_idle_backoff_doubles_to_cap(monkeypatch, tmp_path):
+    router = Router(1, tmp_path)
+    fr = router.submit([1, 2, 3], 4)                 # parks: nothing alive
+    sleeps = []
+
+    def fake_sleep(s):
+        sleeps.append(s)
+        if len(sleeps) >= 6:
+            fr.done = True                           # let serve_all exit
+
+    monkeypatch.setattr(time, "sleep", fake_sleep)
+    router.serve_all(timeout_s=30, poll_s=0.01, idle_cap_s=0.1)
+    assert sleeps == [0.01, 0.02, 0.04, 0.08, 0.1, 0.1]
+
+
+def test_wait_ready_failure_includes_log_tail(tmp_path):
+    import types
+
+    router = Router(1, tmp_path)
+    h = router.replicas[0]
+    h.log_path = str(tmp_path / "replica.log")
+    with open(h.log_path, "w", encoding="utf-8") as f:
+        f.write("\n".join(f"boot line {i}" for i in range(30)))
+    h.port_file = str(tmp_path / "never-written-port")
+
+    h.proc = types.SimpleNamespace(poll=lambda: None, returncode=None)
+    with pytest.raises(TimeoutError) as ei:
+        router._wait_ready(h, 0.01)
+    msg = str(ei.value)
+    assert "last 20 log lines" in msg
+    assert "boot line 29" in msg and "boot line 5" not in msg
+
+    h.proc = types.SimpleNamespace(poll=lambda: 3, returncode=3)
+    with pytest.raises(RuntimeError) as ei:
+        router._wait_ready(h, 0.01)
+    assert "rc=3" in str(ei.value) and "boot line 29" in str(ei.value)
+
+
 @pytest.mark.slow
 @pytest.mark.chaos
 @pytest.mark.timeout(600)
@@ -815,3 +1112,271 @@ def test_fleet_postmortem_flight_record_after_kill(engine, tmp_path):
         assert any(n.startswith("tdt_serving_")
                    for n in pm["active_span_names"])
         assert pm["last"]["flight_seq"] == seqs[-1]
+
+
+# =============================== gray-failure acceptance (multi-process)
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+@pytest.mark.timeout(600)
+def test_fleet_flaky_wire_reset_absorbed_without_migration(
+        engine, monkeypatch, tmp_path):
+    """Acceptance: a flaky wire costs retries, never migrations. A burst
+    of stream-poll resets — including one run long enough to exhaust the
+    retry budget and flip the victim SUSPECT — ends with every stream
+    byte-identical, ZERO migrations, and every replica back to LIVE."""
+    monkeypatch.setenv("TDT_FLEET_RETRY_BACKOFF_S", "0.005")
+    reqs = [([7 + i, 3, 2 * i + 1], 8) for i in range(6)]
+    refs = _references(engine, reqs)
+    streams: dict[int, list[int]] = {}
+    # First poll anywhere eats 3 resets (attempt + 2 retries → one health
+    # failure → SUSPECT); the 4th reset is absorbed by a later poll's
+    # retry; then the wire runs clean.
+    chaos = ",".join(["reset@/fleet/stream"] * 4) + ",heal"
+    with Router(2, tmp_path / "fleet", env=REPLICA_ENV,
+                wire_chaos=chaos) as router:
+        router.start()
+        frs = [router.submit(p, g, on_token=_collect(streams))
+               for p, g in reqs]
+        router.serve_all(timeout_s=300)
+
+        for fr, ref in zip(frs, refs):
+            assert fr.done and fr.finish_reason == "ok"
+            assert fr.tokens == ref
+            assert streams[fr.fleet_id] == ref
+            assert fr.migrations == 0            # absorbed, not migrated
+        assert telemetry.counter_total("tdt_fleet_migrations_total") == 0.0
+        assert telemetry.counter_total("tdt_fleet_replica_failures_total") \
+            == 0.0
+        assert telemetry.counter_value(
+            "tdt_fleet_wire_retries_total",
+            path="/fleet/stream", code="reset") >= 3.0
+        # SUSPECT → LIVE: whoever ate the exhausted run healed on the next
+        # clean poll; nobody is dead, nobody quarantined.
+        assert all(h.alive and h.health.state == "live"
+                   for h in router.replicas)
+        assert telemetry.gauge_value("tdt_fleet_replicas_alive") == 2.0
+        assert router._wire_chaos.exhausted
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+@pytest.mark.timeout(600)
+def test_fleet_hang_watchdog_quarantines_and_migrates(
+        engine, monkeypatch, tmp_path):
+    """Acceptance: wedge one replica's wire (sticky ``hang@/fleet/stream``
+    — the process stays alive and boots fine, its stream polls never
+    answer). The progress watchdog quarantines it within
+    ``TDT_FLEET_STALL_S``, kills it, and journal-replay-migrates its
+    streams to survivors byte-identically. The threshold must sit ABOVE
+    the healthy replicas' worst first-chunk latency (cold compile on a
+    contended CPU) or the watchdog would reap legitimately busy peers."""
+    monkeypatch.setenv("TDT_FLEET_STALL_S", "30.0")
+    monkeypatch.setenv("TDT_FLEET_DEAD_AFTER", "100000")  # watchdog, not wire
+    monkeypatch.setenv("TDT_FLEET_RETRIES", "0")
+    reqs = [([3 + i, 17, (42 & (i + 1)) + 1, 7, 9 * i + 1], 10)
+            for i in range(9)]
+    refs = _references(engine, reqs)
+    streams: dict[int, list[int]] = {}
+    with Router(3, tmp_path / "fleet", env=REPLICA_ENV,
+                wire_chaos="hang@/fleet/stream#0") as router:
+        router.start()
+        frs = [router.submit(p, g, on_token=_collect(streams))
+               for p, g in reqs]
+        victim = router.replicas[0]
+        assert victim.inflight                   # the wedge lands on work
+        t0 = time.monotonic()
+        router.serve_all(timeout_s=300)
+
+        for fr, ref in zip(frs, refs):
+            assert fr.done and fr.tokens == ref
+            assert streams[fr.fleet_id] == ref   # zero drop / zero dup
+        # The stall arc fired (quarantine → drain → kill → migrate), off
+        # the watchdog — not the wire-death path. Depending on how far the
+        # wedged-WIRE replica's (healthy) serving loop got before the
+        # SIGKILL, each of its streams either resumed on a survivor or
+        # completed straight from the final journal — both byte-exact,
+        # both stall-triggered.
+        assert telemetry.counter_value(
+            "tdt_fleet_replica_failures_total", reason="stall") == 1.0
+        resumed = telemetry.counter_value(
+            "tdt_fleet_migrations_total", reason="stall")
+        completed = telemetry.counter_value(
+            "tdt_fleet_migrations_total", reason="stall_journal_complete")
+        assert resumed + completed >= 1.0
+        assert telemetry.counter_value(
+            "tdt_fleet_stall_migrations_total") == resumed
+        assert not victim.alive and victim.health.state == "dead"
+        assert telemetry.gauge_value(
+            "tdt_fleet_health_state", replica="0") == 3.0
+        assert telemetry.gauge_value("tdt_fleet_replicas_alive") == 2.0
+        assert router.topology()["replicas"][0]["health"] == "dead"
+        # Detection + full drain of the burst happened promptly — the
+        # watchdog did not wait out some larger timeout.
+        assert time.monotonic() - t0 < 120
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+@pytest.mark.timeout(600)
+def test_fleet_serving_loop_stall_watchdog_migrates(
+        engine, monkeypatch, tmp_path):
+    """Acceptance, gray-failure shape #2: the replica's SERVING LOOP wedges
+    (``stall@decode`` chaos inside the subprocess) while its HTTP endpoint
+    keeps answering status and stream polls — so wire health stays green
+    and only the token-progress watchdog can see the problem. (30s
+    threshold: comfortably above cold-compile first-chunk latency on a
+    contended CPU, far below the suite timeout.)"""
+    monkeypatch.setenv("TDT_FLEET_STALL_S", "30.0")
+    reqs = [([5 + i, 3, 2 * i + 1], 8) for i in range(6)]
+    refs = _references(engine, reqs)
+    streams: dict[int, list[int]] = {}
+    with Router(2, tmp_path / "fleet", env=REPLICA_ENV,
+                per_replica_env={1: {
+                    "TDT_CHAOS_SCHEDULE": "stall@decode:2",
+                    "TDT_CHAOS_STALL_S": "600",
+                }}) as router:
+        router.start()
+        frs = [router.submit(p, g, on_token=_collect(streams))
+               for p, g in reqs]
+        assert router.replicas[1].inflight       # the wedge lands on work
+        router.serve_all(timeout_s=300)
+
+        for fr, ref in zip(frs, refs):
+            assert fr.done and fr.tokens == ref
+            assert streams[fr.fleet_id] == ref
+        assert telemetry.counter_value(
+            "tdt_fleet_replica_failures_total", reason="stall") == 1.0
+        assert telemetry.counter_value(
+            "tdt_fleet_stall_migrations_total") >= 1.0
+        victim = router.replicas[1]
+        assert not victim.alive and victim.health.state == "dead"
+        assert telemetry.gauge_value("tdt_fleet_replicas_alive") == 1.0
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+@pytest.mark.timeout(600)
+def test_fleet_deadline_expires_against_original_budget_mid_migration(
+        engine, monkeypatch, tmp_path):
+    """Acceptance: a deadline request whose sole replica dies mid-stream
+    parks for migration with nowhere to go — and finishes router-side with
+    ``finish_reason="deadline"`` against the ORIGINAL submit-time budget,
+    not a clock reset by the migration."""
+    [ref] = _references(engine, [([3, 17, 42, 7, 99], 24)])
+    with Router(1, tmp_path / "fleet", env=REPLICA_ENV) as router:
+        router.start()
+        t0 = time.monotonic()
+        fr = router.submit([3, 17, 42, 7, 99], 24, deadline_s=3.0)
+        deadline = time.monotonic() + 120
+        while not fr.tokens:                     # stream genuinely started
+            assert time.monotonic() < deadline, "stream never started"
+            if not router.pump():
+                time.sleep(0.01)
+        router.kill(0)                           # sole replica: no survivor
+        router.serve_all(timeout_s=120)
+        elapsed = time.monotonic() - t0
+
+        assert fr.done and fr.finish_reason == "deadline"
+        assert fr.migrations == 1                # it DID migrate (to park)
+        assert fr.tokens == ref[:len(fr.tokens)]  # partial stream is exact
+        # Expired against the original 3s budget: not early, and not
+        # stretched by the migration (generous ceiling for slow CI).
+        assert 3.0 <= elapsed < 30.0
+        assert telemetry.gauge_value("tdt_fleet_replicas_alive") == 0.0
+        assert not router._pending
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+@pytest.mark.timeout(600)
+def test_fleet_crash_loop_breaker_contains_respawn_storm(
+        engine, monkeypatch, tmp_path):
+    """Acceptance: supervised respawn brings a killed replica back through
+    capped-doubling backoff — but when every respawn dies at boot (bad
+    preset injected post-start), the crash-loop breaker trips after
+    ``TDT_FLEET_CRASH_LOOP_N`` startup deaths and the slot stays
+    QUARANTINED while the surviving peer serves the whole burst."""
+    monkeypatch.setenv("TDT_FLEET_RESPAWN_S", "0.1")
+    monkeypatch.setenv("TDT_FLEET_RESPAWN_CAP_S", "5.0")
+    monkeypatch.setenv("TDT_FLEET_CRASH_LOOP_N", "3")
+    reqs = [([9 + i, 4, i + 1], 8) for i in range(4)]
+    refs = _references(engine, reqs)
+    streams: dict[int, list[int]] = {}
+    with Router(2, tmp_path / "fleet", env=REPLICA_ENV) as router:
+        router.start()                           # first boot: healthy env
+        victim = router.replicas[1]
+        # Every respawn from here boots with a nonexistent preset → the
+        # subprocess dies during startup, every time.
+        router.per_replica_env[1] = {"TDT_REPLICA_PRESET": "no-such-preset"}
+        frs = [router.submit(p, g, on_token=_collect(streams))
+               for p, g in reqs]
+        router.kill(1)
+        router.serve_all(timeout_s=300)          # peer serves everything
+        for fr, ref in zip(frs, refs):
+            assert fr.done and fr.finish_reason == "ok"
+            assert fr.tokens == ref
+            assert streams[fr.fleet_id] == ref
+
+        # Keep pumping until the breaker trips (3 boot deaths with 0.1 →
+        # 0.2 → 0.4s backoffs between attempts).
+        deadline = time.monotonic() + 240
+        while not victim.health.breaker_tripped:
+            assert time.monotonic() < deadline, "breaker never tripped"
+            router.pump()
+            time.sleep(0.05)
+
+        assert victim.health.state == "quarantined"
+        assert not victim.respawning and not victim.alive
+        assert victim.health.respawn_failures == 3
+        assert telemetry.counter_value(
+            "tdt_fleet_respawns_total", outcome="crash") == 3.0
+        assert telemetry.counter_value(
+            "tdt_fleet_respawns_total", outcome="ok") == 0.0
+        assert telemetry.gauge_value(
+            "tdt_fleet_health_state", replica="1") == 2.0
+        topo = router.topology()["replicas"][1]
+        assert topo["breaker_tripped"] and topo["respawn_failures"] == 3
+        # The peer kept serving throughout; one more request still lands.
+        fr = router.submit([44, 45], 4)
+        router.serve_all(timeout_s=120)
+        assert fr.done and fr.finish_reason == "ok"
+        assert router.replicas[0].alive
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+@pytest.mark.timeout(600)
+def test_fleet_supervised_respawn_brings_replica_back(
+        engine, monkeypatch, tmp_path):
+    """The happy respawn path: with supervision on and a healthy env, a
+    SIGKILLed replica migrates its work away and then REJOINS the fleet
+    (fresh generation, health reset, respawns_total{outcome=ok})."""
+    monkeypatch.setenv("TDT_FLEET_RESPAWN_S", "0.1")
+    reqs = [([6 + i, 2, i + 1], 8) for i in range(4)]
+    refs = _references(engine, reqs)
+    with Router(2, tmp_path / "fleet", env=REPLICA_ENV) as router:
+        router.start()
+        victim = router.replicas[0]
+        gen0 = victim.gen
+        frs = [router.submit(p, g) for p, g in reqs]
+        router.kill(0)
+        router.serve_all(timeout_s=300)
+        for fr, ref in zip(frs, refs):
+            assert fr.done and fr.tokens == ref
+
+        deadline = time.monotonic() + 240
+        while not victim.alive:                  # pump the boot to ready
+            assert time.monotonic() < deadline, "respawn never completed"
+            router.pump()
+            time.sleep(0.05)
+        assert victim.gen == gen0 + 1            # fresh journal generation
+        assert victim.health.state == "live"
+        assert telemetry.counter_value(
+            "tdt_fleet_respawns_total", outcome="ok") == 1.0
+        assert telemetry.gauge_value("tdt_fleet_replicas_alive") == 2.0
+        # And the reborn replica takes work again.
+        fr = router.submit([77, 78], 4)
+        router.serve_all(timeout_s=120)
+        assert fr.done and fr.finish_reason == "ok"

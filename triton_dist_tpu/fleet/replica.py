@@ -169,6 +169,7 @@ class ReplicaService:
                 body["prompt"], int(body["max_new"]), body.get("tokens", []),
                 on_token=self._on_token, on_finish=self._on_finish,
                 priority=int(body.get("priority", 1)),
+                ttft_deadline_s=body.get("ttft_deadline_s"),
                 deadline_s=body.get("deadline_s"),
                 trace_ctx=tracing.extract(body.get("trace")),
             )

@@ -65,13 +65,35 @@ reject.
   postmortem (``telemetry.flight_postmortem``) — which request/slot/span
   the replica was executing at death, with no atexit hook involved.
 
+**Gray-failure tolerance** (:class:`ReplicaHealth` + the watchdog arcs in
+:meth:`pump`). Real fleets fail *gray* — hung processes, stragglers,
+flaky wires — not just binary-dead. Every ``/fleet/*`` call runs through
+bounded jittered-backoff retries and feeds a per-replica health state
+machine (LIVE → SUSPECT → QUARANTINED → DEAD) driven by consecutive
+wire-failure counts, probe-latency EWMA, and heartbeat staleness: a
+transient reset costs one retry, a SUSPECT replica leaves placement but
+keeps its streams, and only the DEAD verdict (or ``proc.poll()``)
+triggers migration. A progress watchdog catches the wedged case —
+process alive, HTTP alive, zero token progress for ``TDT_FLEET_STALL_S``
+— and runs quarantine → graceful-drain attempt → SIGKILL →
+journal-replay migrate, byte-identical. Supervised respawn
+(``TDT_FLEET_RESPAWN_S``) brings dead slots back with capped exponential
+backoff behind a crash-loop breaker; deadline budgets
+(``Router.submit(ttft_deadline_s=, deadline_s=)``) ride the wire as
+*remaining* wall-clock so migration never resets the clock; and
+``TDT_FLEET_CHAOS`` (a :class:`~triton_dist_tpu.runtime.resilience.
+WireChaosSchedule` program: ``delay@/fleet/stream:50ms``, ``reset@…``,
+``hang@…``, ``drop@…``) injects deterministic wire faults inside
+:meth:`Router._http` so every arc above replays on one CPU host.
+
 Control plane is stdlib-only: ``subprocess`` + ``urllib`` + JSON over
 each replica's loopback introspection endpoint. The router itself is
 single-threaded — drive it with :meth:`pump` (one poll sweep) or
 :meth:`serve_all` (pump until every stream completes). (The federation
 route handlers run on endpoint threads and only READ router state that is
 stable between pumps — scrapes go over HTTP to the replicas, never into
-the router's placement loop.)
+the router's placement loop; ``_http`` likewise only ACCOUNTS health from
+those threads, enactment is :meth:`pump`'s alone.)
 
 Telemetry (router-process ``tdt_fleet_*`` family):
 ``tdt_fleet_requests_total``, ``tdt_fleet_tokens_total``,
@@ -80,7 +102,11 @@ Telemetry (router-process ``tdt_fleet_*`` family):
 ``tdt_fleet_replica_failures_total{reason}``, ``tdt_fleet_replicas_alive``
 (gauge), ``tdt_fleet_pending_requests`` (gauge), ``tdt_fleet_rebuilds_total``,
 ``tdt_fleet_trace_propagated_total``, ``tdt_fleet_trace_fetches_total{outcome}``,
-``tdt_fleet_http_errors_total{path,code}``, ``tdt_fleet_postmortems_total{reason}``.
+``tdt_fleet_http_errors_total{path,code}``, ``tdt_fleet_postmortems_total{reason}``,
+``tdt_fleet_health_state{replica}`` (gauge),
+``tdt_fleet_wire_retries_total{path,code}``,
+``tdt_fleet_stall_migrations_total``, ``tdt_fleet_respawns_total{outcome}``,
+``tdt_fleet_migration_seconds`` (histogram).
 """
 
 from __future__ import annotations
@@ -89,6 +115,7 @@ import collections
 import hashlib
 import json
 import os
+import random
 import subprocess
 import sys
 import time
@@ -96,7 +123,8 @@ import urllib.error
 import urllib.request
 
 from triton_dist_tpu.runtime import introspect, telemetry, tracing
-from triton_dist_tpu.runtime.utils import get_int_env, tdt_log
+from triton_dist_tpu.runtime.resilience import WireChaosSchedule
+from triton_dist_tpu.runtime.utils import get_float_env, get_int_env, tdt_log
 from triton_dist_tpu.serving.journal import RequestJournal
 
 
@@ -113,6 +141,173 @@ class FleetWireError(RuntimeError):
         self.detail = detail
 
 
+def _classify_oserror(err: BaseException) -> str:
+    """Map a connection-level failure to a low-cardinality code for
+    ``tdt_fleet_http_errors_total{code}``: a slow replica (``timeout``)
+    reads very differently from a dead one (``refused``) or a flaky wire
+    (``reset``). ``URLError`` unwraps to whatever it carries."""
+    if isinstance(err, urllib.error.URLError) \
+            and not isinstance(err, urllib.error.HTTPError) \
+            and isinstance(err.reason, Exception):
+        return _classify_oserror(err.reason)
+    if isinstance(err, ConnectionRefusedError):
+        return "refused"
+    if isinstance(err, (ConnectionResetError, ConnectionAbortedError,
+                        BrokenPipeError)):
+        return "reset"
+    if isinstance(err, TimeoutError):
+        return "timeout"
+    return "conn"
+
+
+#: Routes ``_http`` may retry after ANY connection-level failure — reads
+#: and naturally-idempotent writes. ``/fleet/submit`` and ``/fleet/resume``
+#: are retried only on ``refused`` (the connection never reached a server,
+#: so a duplicate admit is impossible).
+_IDEMPOTENT_ROUTES = frozenset({
+    "/fleet/stream", "/fleet/placement", "/fleet/status", "/fleet/journal",
+    "/fleet/drain", "/fleet/cancel", "/fleet/trace/*", "/snapshot",
+})
+
+
+# ------------------------------------------------------------------ health
+HEALTH_LIVE = "live"
+HEALTH_SUSPECT = "suspect"
+HEALTH_QUARANTINED = "quarantined"
+HEALTH_DEAD = "dead"
+
+#: ``tdt_fleet_health_state{replica}`` gauge encoding (dashboard ordinal).
+_HEALTH_CODE = {
+    HEALTH_LIVE: 0.0, HEALTH_SUSPECT: 1.0,
+    HEALTH_QUARANTINED: 2.0, HEALTH_DEAD: 3.0,
+}
+
+
+class ReplicaHealth:
+    """Per-replica health state machine: LIVE → SUSPECT → QUARANTINED →
+    DEAD, driven by consecutive wire-failure counts, probe-latency EWMA,
+    heartbeat staleness, and token progress.
+
+    Pure policy: every method takes an explicit ``now`` so unit tests
+    drive it with a fake clock — no sockets, no subprocesses. The router
+    owns *enactment* (who gets placed, when to migrate, when to respawn);
+    this class only answers "what state is replica i in, and when is its
+    next respawn due".
+
+    * ``note_ok``/``note_failure`` — wire-call accounting. ``suspect_after``
+      consecutive failures flip LIVE→SUSPECT (replica leaves placement but
+      keeps its streams); ``dead_after`` flips to DEAD (router migrates).
+      One success heals SUSPECT→LIVE and zeroes the failure run. A nonzero
+      ``slow_ms`` also flips a LIVE replica whose latency EWMA exceeds it
+      to SUSPECT — the straggler signal.
+    * ``stalled`` — no token progress for ``stall_s`` despite in-flight
+      work: the progress-watchdog trigger (wedged process, live HTTP).
+    * ``respawn_*`` — supervised-restart bookkeeping: capped exponential
+      backoff (``respawn_s × 2^n``, capped at ``respawn_cap_s``) between
+      attempts, and a crash-loop breaker that pins the replica QUARANTINED
+      after ``crash_loop_n`` consecutive startup deaths.
+    """
+
+    def __init__(self, suspect_after: int = 1, dead_after: int = 5,
+                 heartbeat_s: float = 5.0, slow_ms: float = 0.0,
+                 respawn_s: float = 0.0, respawn_cap_s: float = 30.0,
+                 crash_loop_n: int = 3, now: float = 0.0):
+        self.suspect_after = max(int(suspect_after), 1)
+        self.dead_after = max(int(dead_after), self.suspect_after)
+        self.heartbeat_s = float(heartbeat_s)
+        self.slow_ms = float(slow_ms)
+        self.respawn_s = float(respawn_s)
+        self.respawn_cap_s = float(respawn_cap_s)
+        self.crash_loop_n = max(int(crash_loop_n), 1)
+        self.state = HEALTH_LIVE
+        self.failures = 0          # consecutive wire failures
+        self.ewma_ms = 0.0         # wire-call latency EWMA
+        self.last_ok = float(now)
+        self.last_progress = float(now)
+        self.last_beat = 0.0       # last heartbeat probe sent
+        self.respawn_failures = 0  # consecutive startup deaths
+        self.next_respawn_at = 0.0
+        self.breaker_tripped = False
+
+    def reset(self, now: float) -> None:
+        """Fresh (re)spawned replica: clean slate, clocks restarted."""
+        self.state = HEALTH_LIVE
+        self.failures = 0
+        self.ewma_ms = 0.0
+        self.last_ok = now
+        self.last_progress = now
+
+    def note_ok(self, now: float, latency_s: float = 0.0) -> None:
+        self.failures = 0
+        self.last_ok = now
+        ms = latency_s * 1000.0
+        self.ewma_ms = ms if self.ewma_ms == 0.0 \
+            else 0.8 * self.ewma_ms + 0.2 * ms
+        if self.state == HEALTH_SUSPECT:
+            self.state = HEALTH_LIVE
+        if self.slow_ms > 0 and self.state == HEALTH_LIVE \
+                and self.ewma_ms > self.slow_ms:
+            self.state = HEALTH_SUSPECT
+
+    def note_failure(self, now: float) -> None:
+        self.failures += 1
+        if self.state in (HEALTH_LIVE, HEALTH_SUSPECT):
+            if self.failures >= self.dead_after:
+                self.state = HEALTH_DEAD
+            elif self.failures >= self.suspect_after:
+                self.state = HEALTH_SUSPECT
+
+    def note_progress(self, now: float) -> None:
+        self.last_progress = now
+
+    def stall_age_s(self, now: float) -> float:
+        return now - self.last_progress
+
+    def stalled(self, now: float, stall_s: float) -> bool:
+        return stall_s > 0 and self.stall_age_s(now) >= stall_s
+
+    def stale(self, now: float) -> bool:
+        """No successful wire call for 3 heartbeat intervals."""
+        return self.heartbeat_s > 0 \
+            and now - self.last_ok >= 3.0 * self.heartbeat_s
+
+    def mark(self, state: str) -> None:
+        self.state = state
+
+    def respawn_delay(self) -> float:
+        """Backoff before the NEXT respawn attempt: base × 2^deaths,
+        capped."""
+        if self.respawn_s <= 0:
+            return 0.0
+        return min(self.respawn_s * (2.0 ** self.respawn_failures),
+                   self.respawn_cap_s)
+
+    def schedule_respawn(self, now: float) -> float:
+        delay = self.respawn_delay()
+        self.next_respawn_at = now + delay
+        return delay
+
+    def respawn_due(self, now: float) -> bool:
+        return (self.respawn_s > 0 and not self.breaker_tripped
+                and now >= self.next_respawn_at)
+
+    def respawn_result(self, ok: bool, now: float) -> float | None:
+        """Record a respawn outcome. Success resets everything; a startup
+        death doubles the backoff and — at ``crash_loop_n`` consecutive
+        deaths — trips the breaker (returns None, state QUARANTINED):
+        the replica stays down instead of restart-storming."""
+        if ok:
+            self.respawn_failures = 0
+            self.reset(now)
+            return 0.0
+        self.respawn_failures += 1
+        if self.respawn_failures >= self.crash_loop_n:
+            self.breaker_tripped = True
+            self.state = HEALTH_QUARANTINED
+            return None
+        return self.schedule_respawn(now)
+
+
 class FleetRequest:
     """Router-side handle for one fleet-level generation request.
 
@@ -125,16 +320,27 @@ class FleetRequest:
         "fleet_id", "prompt", "max_new", "priority", "on_token", "on_finish",
         "tokens", "done", "finish_reason", "replica", "remote_id",
         "migrations", "placed_reason", "trace", "_seed",
+        "ttft_deadline_s", "deadline_s", "arrived_at",
     )
 
     def __init__(self, fleet_id: int, prompt, max_new: int, priority: int,
-                 on_token=None, on_finish=None):
+                 on_token=None, on_finish=None,
+                 ttft_deadline_s: float | None = None,
+                 deadline_s: float | None = None):
         self.fleet_id = fleet_id
         self.prompt = [int(t) for t in prompt]
         self.max_new = int(max_new)
         self.priority = int(priority)
         self.on_token = on_token
         self.on_finish = on_finish
+        #: Wall-clock budgets measured from ``arrived_at`` (router admit).
+        #: Every placement — including each migration — stamps the
+        #: REMAINING budget into the wire body, so the clock never resets
+        #: across a splice.
+        self.ttft_deadline_s = None if ttft_deadline_s is None \
+            else float(ttft_deadline_s)
+        self.deadline_s = None if deadline_s is None else float(deadline_s)
+        self.arrived_at = time.monotonic()
         self.tokens: list[int] = []
         self.done = False
         self.finish_reason: str | None = None
@@ -172,6 +378,16 @@ class ReplicaHandle:
         self.alive = False
         self.draining = False
         self.inflight: dict[int, FleetRequest] = {}
+        #: Health state machine (the router overwrites this with its
+        #: env-configured policy; the default keeps bare handles usable
+        #: in unit tests).
+        self.health = ReplicaHealth()
+        #: Supervised-respawn bookkeeping: ``respawning`` marks a slot the
+        #: pump should bring back; ``booting`` marks a spawn in progress
+        #: (polled non-blockingly so the fleet keeps streaming).
+        self.respawning = False
+        self.booting = False
+        self.boot_deadline = 0.0
         #: Placement tallies for /fleet/topology (cumulative across gens —
         #: a replica slot's identity survives rebuilds).
         self.placements = 0
@@ -195,19 +411,62 @@ class Router:
     """Front door for ``num_replicas`` data-parallel serving replicas."""
 
     def __init__(self, num_replicas: int, workdir: str, env: dict | None = None,
-                 affinity: bool = True, request_timeout_s: float = 30.0):
+                 affinity: bool = True, request_timeout_s: float = 30.0,
+                 per_replica_env: dict | None = None,
+                 wire_chaos: str | None = None):
         assert num_replicas >= 1
         self.workdir = os.fspath(workdir)
         #: Extra env for replica subprocesses (TDT_REPLICA_*, TDT_SERVE_*…)
         #: on top of the router's own environment.
         self.env = dict(env or {})
+        #: Per-replica env overlay (idx -> dict), applied AFTER ``env`` —
+        #: how chaos tests wedge exactly one replica's serving loop.
+        self.per_replica_env = {
+            int(k): dict(v) for k, v in (per_replica_env or {}).items()
+        }
         self.affinity = bool(affinity)
         self.request_timeout_s = float(request_timeout_s)
         self.block_size = get_int_env("TDT_KV_BLOCK_SIZE", 16)
+        #: Wire retry policy: bounded attempts with jittered exponential
+        #: backoff; 0 retries restores fail-on-first-error.
+        self._retries = max(get_int_env("TDT_FLEET_RETRIES", 2), 0)
+        self._retry_backoff_s = max(
+            get_float_env("TDT_FLEET_RETRY_BACKOFF_S", 0.05), 0.0
+        )
+        #: Progress watchdog: migrate off a replica with in-flight work
+        #: that advanced no stream for this long (0 disables).
+        self._stall_s = get_float_env("TDT_FLEET_STALL_S", 60.0)
+        self._heartbeat_s = get_float_env("TDT_FLEET_HEARTBEAT_S", 5.0)
+        #: Supervised respawn: 0 (default) preserves "a killed replica
+        #: stays dead" semantics; >0 is the backoff base.
+        self._respawn_s = get_float_env("TDT_FLEET_RESPAWN_S", 0.0)
+        self._health_kw = dict(
+            suspect_after=get_int_env("TDT_FLEET_SUSPECT_AFTER", 1),
+            dead_after=get_int_env("TDT_FLEET_DEAD_AFTER", 5),
+            heartbeat_s=self._heartbeat_s,
+            slow_ms=get_float_env("TDT_FLEET_SLOW_MS", 0.0),
+            respawn_s=self._respawn_s,
+            respawn_cap_s=get_float_env("TDT_FLEET_RESPAWN_CAP_S", 30.0),
+            crash_loop_n=get_int_env("TDT_FLEET_CRASH_LOOP_N", 3),
+        )
+        #: Deterministic wire fault injector (TDT_FLEET_CHAOS / ctor arg):
+        #: a WireChaosSchedule program enforced inside _http.
+        self._wire_chaos: WireChaosSchedule | None = None
+        spec = wire_chaos if wire_chaos is not None \
+            else os.environ.get("TDT_FLEET_CHAOS", "").strip()
+        if spec:
+            try:
+                self._wire_chaos = WireChaosSchedule(spec)
+            except ValueError as e:
+                tdt_log(f"[fleet] ignoring bad TDT_FLEET_CHAOS: {e}",
+                        level="warn")
         self._replicas = [
             ReplicaHandle(i, os.path.join(self.workdir, f"r{i}"))
             for i in range(num_replicas)
         ]
+        now = time.monotonic()
+        for h in self._replicas:
+            h.health = ReplicaHealth(now=now, **self._health_kw)
         self._requests: list[FleetRequest] = []
         #: Requests with no eligible/accepting replica right now; retried
         #: every pump — the zero-reject guarantee during rebuild windows.
@@ -257,6 +516,7 @@ class Router:
         h.inflight = {}
         env = dict(os.environ)
         env.update(self.env)
+        env.update(self.per_replica_env.get(h.idx, {}))
         env.update({
             "TDT_HTTP_PORT": "0",           # ephemeral: N replicas, one host
             "TDT_HTTP_PORT_FILE": h.port_file,
@@ -267,6 +527,8 @@ class Router:
         # the bench's tracing-off arm).
         if "TDT_FLIGHT_RECORDER" not in self.env:
             env["TDT_FLIGHT_RECORDER"] = gdir
+        if h._log_f is not None:
+            h._log_f.close()
         h._log_f = open(h.log_path, "ab")
         h.proc = subprocess.Popen(
             [sys.executable, "-m", "triton_dist_tpu.fleet.replica"],
@@ -281,75 +543,179 @@ class Router:
                 raise RuntimeError(
                     f"replica {h.idx} exited rc={h.proc.returncode} during "
                     f"boot; see {h.log_path}"
+                    f"{self._log_tail(h)}"
                 )
-            if h.port is None:
-                try:
-                    with open(h.port_file, "r", encoding="utf-8") as f:
-                        h.port = int(f.read().strip())
-                except (OSError, ValueError):
-                    time.sleep(0.1)
-                    continue
-            try:
-                st = self._http(h, "/fleet/status")
-            except OSError:
-                time.sleep(0.1)
-                continue
-            if st.get("ready"):
-                h.alive = True
-                self._alive_gauge()
-                tdt_log(f"[fleet] replica {h.idx} ready on port {h.port}")
+            if self._check_ready(h):
                 return
             time.sleep(0.1)
         raise TimeoutError(
             f"replica {h.idx} not ready after {timeout_s}s; see {h.log_path}"
+            f"{self._log_tail(h)}"
         )
 
+    def _check_ready(self, h: ReplicaHandle, timeout_s: float = 2.0) -> bool:
+        """One non-blocking-ish readiness probe (port file, then
+        ``/fleet/status``, no retries). On ready: mark alive, reset health,
+        clear the respawn flags."""
+        if h.port is None:
+            try:
+                with open(h.port_file, "r", encoding="utf-8") as f:
+                    h.port = int(f.read().strip())
+            except (OSError, ValueError):
+                return False
+        try:
+            st = self._http(h, "/fleet/status", timeout_s=timeout_s,
+                            retries=0)
+        except (OSError, FleetWireError):
+            return False
+        if not st.get("ready"):
+            return False
+        h.alive = True
+        h.booting = False
+        h.respawning = False
+        h.health.reset(time.monotonic())
+        self._alive_gauge()
+        self._health_gauge(h)
+        tdt_log(f"[fleet] replica {h.idx} ready on port {h.port}")
+        return True
+
+    def _log_tail(self, h: ReplicaHandle, lines: int = 20) -> str:
+        """The last ~``lines`` lines of the replica's log, formatted for
+        appending to a boot-failure exception — the diagnosis without a
+        trip to the filesystem."""
+        try:
+            with open(h.log_path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                f.seek(max(0, f.tell() - 8192))
+                tail = f.read().decode("utf-8", "replace").splitlines()
+        except OSError:
+            return ""
+        if not tail:
+            return ""
+        tail = tail[-lines:]
+        return (f"\n--- last {len(tail)} log lines ({h.log_path}) ---\n"
+                + "\n".join(tail))
+
     # ----------------------------------------------------------------- http
+    def _chaos_wire(self, h: ReplicaHandle, route: str) -> None:
+        """Wire fault injection point (``TDT_FLEET_CHAOS``): runs inside
+        ``_http``'s try so injected faults are classified, retried, and
+        health-accounted exactly like real ones. ``hang`` compresses
+        wall-clock — a short sleep then ``TimeoutError`` stands in for a
+        peer that accepts and never answers."""
+        sched = self._wire_chaos
+        if sched is None:
+            return
+        ev = sched.take(route, h.idx)
+        if ev is None:
+            return
+        telemetry.inc("tdt_resilience_chaos_injected_total", site=route)
+        telemetry.emit("fleet_wire_chaos", action=ev.action, path=route,
+                       replica=h.idx)
+        if ev.action == "delay":
+            time.sleep(ev.delay_s)
+            return
+        if ev.action == "reset":
+            raise ConnectionResetError(f"chaos reset@{route}")
+        if ev.action == "hang":
+            time.sleep(min(self.request_timeout_s, 0.05))
+            raise TimeoutError(f"chaos hang@{route}")
+        raise TimeoutError(f"chaos drop@{route}")
+
+    def _health_gauge(self, h: ReplicaHandle) -> None:
+        telemetry.set_gauge("tdt_fleet_health_state",
+                            _HEALTH_CODE[h.health.state],
+                            replica=str(h.idx))
+
     def _http(self, h: ReplicaHandle, path: str, body=None,
-              timeout_s: float | None = None):
-        """One wire call. Failures are counted by path: a structured 4xx
-        becomes :class:`FleetWireError` (replica alive, call wrong — must
-        NOT trigger death handling); 5xx and connection-level OSErrors
-        re-raise as before (the callers' replica-failure paths)."""
+              timeout_s: float | None = None, retries: int | None = None):
+        """One wire call with bounded jittered-backoff retries and health
+        accounting. A structured 4xx becomes :class:`FleetWireError`
+        (replica alive, call wrong — never triggers death handling).
+        Connection-level OSErrors are classified (timeout/refused/reset/
+        conn), retried when safe (idempotent routes always; submit/resume
+        only on ``refused``), and — once retries are exhausted — recorded
+        against the replica's health state machine before re-raising. This
+        method only ACCOUNTS health (it also runs on introspection endpoint
+        threads); enactment — migration off a DEAD replica — happens in
+        :meth:`pump` alone."""
         data = None if body is None else json.dumps(body).encode()
-        req = urllib.request.Request(
-            h.url(path), data=data,
-            headers={"Content-Type": "application/json"},
-            method="GET" if data is None else "POST",
-        )
         route = path.partition("?")[0]
         if route.startswith("/fleet/trace/"):
             route = "/fleet/trace/*"  # keep the failure label low-cardinality
-        try:
-            with urllib.request.urlopen(
-                req,
-                timeout=self.request_timeout_s if timeout_s is None else timeout_s,
-            ) as r:
-                return json.loads(r.read().decode())
-        except urllib.error.HTTPError as e:
-            telemetry.inc("tdt_fleet_http_errors_total",
-                          path=route, code=str(e.code))
-            if 400 <= e.code < 500:
-                try:
-                    detail = json.loads(e.read().decode()).get("error", "")
-                except Exception:
-                    detail = ""
-                raise FleetWireError(route, e.code, detail) from None
-            raise
-        except OSError:
-            telemetry.inc("tdt_fleet_http_errors_total",
-                          path=route, code="conn")
-            raise
+        if retries is None:
+            retries = self._retries
+        attempt = 0
+        while True:
+            t0 = time.monotonic()
+            try:
+                self._chaos_wire(h, route)
+                req = urllib.request.Request(
+                    h.url(path), data=data,
+                    headers={"Content-Type": "application/json"},
+                    method="GET" if data is None else "POST",
+                )
+                with urllib.request.urlopen(
+                    req,
+                    timeout=self.request_timeout_s if timeout_s is None
+                    else timeout_s,
+                ) as r:
+                    out = json.loads(r.read().decode())
+            except urllib.error.HTTPError as e:
+                telemetry.inc("tdt_fleet_http_errors_total",
+                              path=route, code=str(e.code))
+                if 400 <= e.code < 500:
+                    try:
+                        detail = json.loads(e.read().decode()).get("error", "")
+                    except Exception:
+                        detail = ""
+                    raise FleetWireError(route, e.code, detail) from None
+                raise
+            except OSError as e:
+                code = _classify_oserror(e)
+                telemetry.inc("tdt_fleet_http_errors_total",
+                              path=route, code=code)
+                if attempt < retries and (
+                    route in _IDEMPOTENT_ROUTES or code == "refused"
+                ):
+                    attempt += 1
+                    telemetry.inc("tdt_fleet_wire_retries_total",
+                                  path=route, code=code)
+                    delay = min(
+                        self._retry_backoff_s * (2.0 ** (attempt - 1)), 1.0
+                    )
+                    if delay > 0:
+                        time.sleep(delay * (0.5 + 0.5 * random.random()))
+                    continue
+                if h.alive:
+                    h.health.note_failure(time.monotonic())
+                    self._health_gauge(h)
+                raise
+            if h.alive:
+                h.health.note_ok(time.monotonic(), time.monotonic() - t0)
+                self._health_gauge(h)
+            return out
 
     # ------------------------------------------------------------ placement
     def submit(self, prompt, max_new: int, priority: int = 1,
-               on_token=None, on_finish=None) -> FleetRequest:
+               on_token=None, on_finish=None,
+               ttft_deadline_s: float | None = None,
+               deadline_s: float | None = None) -> FleetRequest:
         """Place one request on the fleet. Never rejects: with no eligible
         or accepting replica it parks in the router queue and places at a
         later :meth:`pump`. Opens the request's fleet-wide trace — every
-        process that touches the request parents its spans under it."""
+        process that touches the request parents its spans under it.
+
+        ``ttft_deadline_s``/``deadline_s`` are wall-clock budgets measured
+        from THIS call: each placement stamps the *remaining* budget into
+        the wire body (the replica scheduler enforces it), migrations
+        re-stamp the shrunken residual, and a request whose total budget
+        runs out while parked or mid-migration finishes router-side with
+        ``finish_reason="deadline"``."""
         fr = FleetRequest(self._next_id, prompt, max_new, priority,
-                          on_token=on_token, on_finish=on_finish)
+                          on_token=on_token, on_finish=on_finish,
+                          ttft_deadline_s=ttft_deadline_s,
+                          deadline_s=deadline_s)
         self._next_id += 1
         self._requests.append(fr)
         telemetry.inc("tdt_fleet_requests_total")
@@ -378,7 +744,29 @@ class Router:
         )
 
     def _eligible(self) -> list[ReplicaHandle]:
-        return [h for h in self._replicas if h.alive and not h.draining]
+        """Replicas placement may use: alive, not draining, health LIVE —
+        SUSPECT/QUARANTINED replicas keep their streams but take no new
+        work until they prove themselves again."""
+        return [h for h in self._replicas
+                if h.alive and not h.draining
+                and h.health.state == HEALTH_LIVE]
+
+    def _expire_if_due(self, fr: FleetRequest) -> bool:
+        """Finish ``fr`` router-side with ``finish_reason="deadline"`` when
+        its total wall-clock budget (measured from submit) has run out —
+        the parked / mid-migration expiry path the replica scheduler never
+        sees. True when the request is done (now or already)."""
+        if fr.done:
+            return True
+        if fr.deadline_s is None:
+            return False
+        if time.monotonic() - fr.arrived_at >= fr.deadline_s:
+            tdt_log(f"[fleet] request {fr.fleet_id} total deadline "
+                    f"({fr.deadline_s}s) expired before placement",
+                    level="warn")
+            self._finish(fr, "deadline")
+            return True
+        return False
 
     def _first_block_key(self, prompt: list[int]) -> str:
         head = prompt[: self.block_size] if len(prompt) >= self.block_size \
@@ -392,7 +780,15 @@ class Router:
         """Probe, rank, and send to the best accepting replica. False when
         nothing is eligible or everything rejected (shed / KV pressure).
         The whole attempt runs under one ``tdt_fleet_placement`` span —
-        the parent of everything the chosen replica does for ``fr``."""
+        the parent of everything the chosen replica does for ``fr``.
+
+        A probe/send OSError here is ACCOUNTED (``_http`` drives the
+        replica's health state machine) but never enacted — the candidate
+        is just skipped; :meth:`pump` migrates once the machine reaches
+        DEAD. An expired total deadline finishes the request instead of
+        placing it (True: the caller must not park it)."""
+        if self._expire_if_due(fr):
+            return True
         with fr.trace.span(
             "tdt_fleet_placement", fleet_id=fr.fleet_id,
             migration=fr.migrations,
@@ -409,7 +805,7 @@ class Router:
                         self._stamp(fr, psp, {"prompt": fr.prompt}),
                     )))
                 except OSError:
-                    self._on_replica_failure(h, "death")
+                    continue
             if not infos:
                 note(outcome="no_replica")
                 return False
@@ -427,7 +823,7 @@ class Router:
                              reason=fr.placed_reason)
                         return True
                 except OSError:
-                    self._on_replica_failure(h, "death")
+                    continue
             note(outcome="rejected")
             return False
 
@@ -512,12 +908,24 @@ class Router:
 
     def _send(self, fr: FleetRequest, h: ReplicaHandle, pspan=None) -> bool:
         """Admit ``fr`` on ``h`` (resume when it carries history). True on
-        queued; False on a replica-side reject. OSError propagates."""
+        queued; False on a replica-side reject. OSError propagates.
+
+        Deadlines go over the wire as the REMAINING wall-clock budget
+        (measured from the router admit), so the replica scheduler enforces
+        the client's original clock — a migrated request carries a residual
+        that only ever shrinks across the splice. TTFT budgets are only
+        stamped on un-seeded admits: a resumed stream already produced its
+        first token somewhere."""
         seed = fr._seed if len(fr._seed) > len(fr.tokens) else fr.tokens
         body = self._stamp(fr, pspan, {
             "prompt": fr.prompt, "max_new": fr.max_new,
             "priority": fr.priority,
         })
+        elapsed = time.monotonic() - fr.arrived_at
+        if fr.deadline_s is not None:
+            body["deadline_s"] = fr.deadline_s - elapsed
+        if fr.ttft_deadline_s is not None and not seed:
+            body["ttft_deadline_s"] = fr.ttft_deadline_s - elapsed
         if seed:
             body["tokens"] = list(seed)
             resp = self._http(h, "/fleet/resume", body)
@@ -528,6 +936,7 @@ class Router:
         fr.replica = h.idx
         fr.remote_id = int(resp["req_id"])
         h.inflight[fr.remote_id] = fr
+        h.health.note_progress(time.monotonic())
         return True
 
     # ------------------------------------------------------------- delivery
@@ -550,22 +959,48 @@ class Router:
             fr.on_finish(fr)
 
     def pump(self) -> bool:
-        """One router iteration: detect dead replicas (migrating their
-        work), poll every live replica's streams once, retry the pending
-        queue. Returns True when anything progressed."""
+        """One router iteration — the single place gray-failure verdicts
+        are ENACTED (``_http`` only accounts; it also runs on endpoint
+        threads). Per replica: finish a boot in progress, respawn a
+        supervised dead slot when its backoff is due, migrate off a dead
+        process / a wire-DEAD peer / a stalled (wedged) one, heartbeat
+        idle peers, then poll streams. Finally retry (or expire) the
+        pending queue. Returns True when anything progressed."""
         worked = False
+        now = time.monotonic()
         for h in self._replicas:
+            if h.booting:
+                worked = self._pump_boot(h, now) or worked
+                continue
             if not h.alive:
+                worked = self._maybe_respawn(h, now) or worked
                 continue
             if h.proc is not None and h.proc.poll() is not None:
                 self._on_replica_failure(h, "death")
                 worked = True
                 continue
+            if h.health.state == HEALTH_DEAD:
+                # The wire gave up (dead_after consecutive failures): the
+                # process may still run — kill it so the journal is final
+                # before replaying it onto survivors.
+                if h.proc is not None and h.proc.poll() is None:
+                    h.proc.kill()
+                    h.proc.wait()
+                self._on_replica_failure(h, "unreachable")
+                worked = True
+                continue
+            if h.inflight and h.health.stalled(now, self._stall_s):
+                self._stall_arc(h, now)
+                worked = True
+                continue
+            self._heartbeat(h, now)
             worked = self._poll_replica(h) or worked
         if self._pending:
             still = []
             for fr in self._pending:
-                if self._try_place(fr):
+                if self._expire_if_due(fr):
+                    worked = True
+                elif self._try_place(fr):
                     worked = True
                 else:
                     still.append(fr)
@@ -574,6 +1009,100 @@ class Router:
                 "tdt_fleet_pending_requests", float(len(self._pending))
             )
         return worked
+
+    def _heartbeat(self, h: ReplicaHandle, now: float) -> None:
+        """Keep an idle replica's health current: probe ``/fleet/status``
+        once per heartbeat interval when nothing else talked to it (busy
+        replicas are implicitly heartbeated by every stream poll). A LIVE
+        replica gone heartbeat-stale (3 missed intervals) turns SUSPECT
+        even before a probe fails outright."""
+        hb = self._heartbeat_s
+        if hb <= 0:
+            return
+        if h.health.stale(now) and h.health.state == HEALTH_LIVE:
+            h.health.mark(HEALTH_SUSPECT)
+            self._health_gauge(h)
+        if now - h.health.last_ok < hb or now - h.health.last_beat < hb:
+            return
+        h.health.last_beat = now
+        try:
+            self._http(h, "/fleet/status", timeout_s=min(2.0, self.request_timeout_s),
+                       retries=0)
+        except (OSError, FleetWireError):
+            pass  # accounted in _http; pump enacts if the machine says DEAD
+
+    def _stall_arc(self, h: ReplicaHandle, now: float) -> None:
+        """The Llumnix-style proactive arc for a wedged replica (process
+        alive, HTTP possibly alive, zero token progress for stall_s):
+        quarantine → attempt a graceful drain → SIGKILL → journal-replay
+        migrate. Streams complete byte-identical on survivors; the finish
+        fsync discipline makes the on-disk journal the source of truth."""
+        h.health.mark(HEALTH_QUARANTINED)
+        self._health_gauge(h)
+        tdt_log(f"[fleet] replica {h.idx} wedged: no token progress for "
+                f"{h.health.stall_age_s(now):.1f}s "
+                f"(TDT_FLEET_STALL_S={self._stall_s}); quarantine → drain "
+                f"→ kill → migrate", level="warn")
+        try:
+            self._http(h, "/fleet/drain",
+                       timeout_s=min(1.0, self.request_timeout_s), retries=0)
+        except (OSError, FleetWireError):
+            pass  # wedged enough not to drain — escalate regardless
+        if h.proc is not None and h.proc.poll() is None:
+            h.proc.kill()
+            h.proc.wait()
+        self._on_replica_failure(h, "stall")
+
+    def _maybe_respawn(self, h: ReplicaHandle, now: float) -> bool:
+        """Supervised respawn (``TDT_FLEET_RESPAWN_S`` > 0): bring a dead
+        slot back once its backoff expires, unless the crash-loop breaker
+        tripped. The spawn is polled by ``_pump_boot`` so the rest of the
+        fleet keeps streaming while the newcomer boots."""
+        if not h.respawning or not h.health.respawn_due(now):
+            return False
+        if h.proc is not None and h.proc.poll() is None:
+            h.proc.kill()
+            h.proc.wait()
+        tdt_log(f"[fleet] respawning replica {h.idx} "
+                f"(attempt after {h.health.respawn_failures} startup "
+                f"death(s))")
+        self._spawn(h)
+        h.booting = True
+        h.boot_deadline = now + 240.0
+        return True
+
+    def _pump_boot(self, h: ReplicaHandle, now: float) -> bool:
+        """Poll one in-progress supervised boot. A startup death doubles
+        the respawn backoff and — at ``TDT_FLEET_CRASH_LOOP_N`` consecutive
+        deaths — trips the breaker: the replica stays QUARANTINED instead
+        of restart-storming while its peers keep serving."""
+        died = h.proc is None or h.proc.poll() is not None
+        if not died and now > h.boot_deadline:
+            h.proc.kill()
+            h.proc.wait()
+            died = True
+        if died:
+            h.booting = False
+            delay = h.health.respawn_result(False, now)
+            self._health_gauge(h)
+            telemetry.inc("tdt_fleet_respawns_total", outcome="crash")
+            if delay is None:
+                h.respawning = False
+                tdt_log(f"[fleet] replica {h.idx} crash-looped "
+                        f"{h.health.respawn_failures}x at startup; breaker "
+                        f"tripped — staying quarantined; see {h.log_path}",
+                        level="warn")
+            else:
+                tdt_log(f"[fleet] replica {h.idx} died during boot; next "
+                        f"respawn in {delay:.2f}s; see {h.log_path}",
+                        level="warn")
+            return True
+        if self._check_ready(h):
+            telemetry.inc("tdt_fleet_respawns_total", outcome="ok")
+            h.health.respawn_result(True, now)
+            self._health_gauge(h)
+            return True
+        return False
 
     def _poll_replica(self, h: ReplicaHandle) -> bool:
         if not h.inflight:
@@ -584,8 +1113,10 @@ class Router:
                          for rid, fr in h.inflight.items()],
             })
         except OSError:
-            self._on_replica_failure(h, "death")
-            return True
+            # Accounted in _http (the replica is now SUSPECT or DEAD);
+            # pump() enacts migration once the state machine says DEAD —
+            # a transient blip costs nothing but this one poll.
+            return False
         worked = False
         for rid, fr in list(h.inflight.items()):
             st = resp.get("streams", {}).get(str(rid))
@@ -598,17 +1129,27 @@ class Router:
                 del h.inflight[rid]
                 self._finish(fr, st["reason"])
                 worked = True
+        if worked:
+            h.health.note_progress(time.monotonic())
         return worked
 
-    def serve_all(self, timeout_s: float = 600.0, poll_s: float = 0.01) -> None:
-        """Pump until every submitted request has finished."""
+    def serve_all(self, timeout_s: float = 600.0, poll_s: float = 0.01,
+                  idle_cap_s: float = 0.1) -> None:
+        """Pump until every submitted request has finished. Idle iterations
+        back off exponentially from ``poll_s`` to ``idle_cap_s`` (reset the
+        moment anything progresses), so a fully-parked router stops burning
+        a core."""
         deadline = time.monotonic() + timeout_s
+        idle = poll_s
         while any(not fr.done for fr in self._requests):
             if time.monotonic() > deadline:
                 left = [fr.fleet_id for fr in self._requests if not fr.done]
                 raise TimeoutError(f"fleet requests not done: {left}")
-            if not self.pump():
-                time.sleep(poll_s)
+            if self.pump():
+                idle = poll_s
+            else:
+                time.sleep(idle)
+                idle = min(idle * 2.0, idle_cap_s)
 
     # ------------------------------------------------------------- migration
     def _on_replica_failure(self, h: ReplicaHandle, reason: str) -> None:
@@ -618,13 +1159,23 @@ class Router:
             return
         h.alive = False
         h.draining = False
+        h.health.mark(HEALTH_DEAD)
+        self._health_gauge(h)
         telemetry.inc("tdt_fleet_replica_failures_total", reason=reason)
         self._alive_gauge()
         tdt_log(f"[fleet] replica {h.idx} lost ({reason}); migrating "
                 f"{len(h.inflight)} in-flight request(s)", level="warn")
         self._harvest_flight(h, reason)
+        t0 = time.monotonic()
+        had_inflight = bool(h.inflight)
         records = RequestJournal.read(h.journal_path)
         self._migrate_inflight(h, records, reason=reason, cancel_donor=False)
+        if had_inflight:
+            telemetry.observe("tdt_fleet_migration_seconds",
+                              time.monotonic() - t0)
+        if self._respawn_s > 0 and not h.health.breaker_tripped:
+            h.respawning = True
+            h.health.schedule_respawn(t0)
 
     def _harvest_flight(self, h: ReplicaHandle, reason: str) -> None:
         """Read the dead replica's crash-surviving flight ring off disk and
@@ -677,6 +1228,8 @@ class Router:
             fr.remote_id = None
             fr.migrations += 1
             telemetry.inc("tdt_fleet_migrations_total", reason=reason)
+            if reason == "stall":
+                telemetry.inc("tdt_fleet_stall_migrations_total")
             fr.trace.point(
                 "tdt_fleet_migration", reason=reason, from_replica=h.idx,
                 seeded=len(fr._seed), delivered=len(fr.tokens),
@@ -772,6 +1325,8 @@ class Router:
 
     def _terminate(self, h: ReplicaHandle, timeout_s: float = 30.0) -> None:
         h.alive = False
+        h.respawning = False
+        h.booting = False
         self._alive_gauge()
         if h.proc is not None and h.proc.poll() is None:
             h.proc.terminate()
@@ -805,6 +1360,7 @@ class Router:
                     "gen": h.gen, "port": h.port,
                     "inflight": len(h.inflight),
                     "pid": None if h.proc is None else h.proc.pid,
+                    "health": h.health.state,
                 }
                 for h in self._replicas
             ],
@@ -957,6 +1513,7 @@ class Router:
         health, placement tallies, and (for live replicas) a fresh load
         probe — the same numbers the placement policy ranks on."""
         reps = []
+        now = time.monotonic()
         for h in self._replicas:
             entry = {
                 "idx": h.idx, "gen": h.gen, "port": h.port,
@@ -967,6 +1524,13 @@ class Router:
                 "prefix_hits": h.prefix_hits,
                 "hit_rate": h.prefix_hits / h.placements
                 if h.placements else 0.0,
+                "health": h.health.state,
+                "consecutive_failures": h.health.failures,
+                "probe_ewma_ms": round(h.health.ewma_ms, 3),
+                "stall_age_s": round(h.health.stall_age_s(now), 3)
+                if h.alive and h.inflight else None,
+                "respawn_failures": h.health.respawn_failures,
+                "breaker_tripped": h.health.breaker_tripped,
                 "load": None,
             }
             if h.alive:

@@ -372,7 +372,7 @@ class ChaosEvent:
 
 #: Serving-loop injection sites wired through :func:`chaos_check`.
 CHAOS_SITES = ("prefill", "decode", "recovery", "probe")
-CHAOS_ACTIONS = ("abort", "die", "revive")
+CHAOS_ACTIONS = ("abort", "die", "revive", "stall")
 
 
 class ChaosSchedule:
@@ -507,6 +507,13 @@ def chaos_check(site: str) -> None:
     if ev.action == "abort":
         mark_degraded("collectives", reason)
         raise CollectiveAbortError(reason)
+    if ev.action == "stall":
+        # Wedge the calling thread (the serving loop) while the process —
+        # including its introspection endpoint threads — stays alive: the
+        # gray-failure shape the fleet progress watchdog exists to detect.
+        # Bounded so an unattended schedule cannot hang a process forever.
+        time.sleep(get_float_env("TDT_CHAOS_STALL_S", 600.0))
+        return
     if ev.action == "die":
         # Route through the same transition real lease expiry takes (board
         # when present, registry otherwise), then surface the loss at this
@@ -527,6 +534,155 @@ def chaos_check(site: str) -> None:
             board.revive(ev.rank)
         else:
             declare_rank_revived(ev.rank)
+
+
+# ------------------------------------------------------------- wire chaos
+
+
+#: Wire-level fault actions injected by the fleet router (`TDT_FLEET_CHAOS`).
+WIRE_CHAOS_ACTIONS = ("delay", "reset", "hang", "drop")
+
+
+def _parse_duration_s(text: str) -> float:
+    """Parse ``50ms`` / ``0.5s`` / bare seconds into float seconds."""
+    t = text.strip().lower()
+    try:
+        if t.endswith("ms"):
+            return float(t[:-2]) / 1000.0
+        if t.endswith("s"):
+            return float(t[:-1])
+        return float(t)
+    except ValueError:
+        raise ValueError(
+            f"bad duration {text!r} (want e.g. '50ms' or '0.5s')"
+        ) from None
+
+
+@dataclasses.dataclass
+class WireChaosEvent:
+    """One wire fault: ``action`` on calls to ``path``, optionally only for
+    replica index ``replica``, after letting ``skip`` matching calls pass.
+    ``delay_s`` only applies to the ``delay`` action."""
+
+    action: str
+    path: str
+    replica: int | None = None
+    skip: int = 0
+    delay_s: float = 0.0
+
+
+class WireChaosSchedule:
+    """Deterministic wire-fault program for the fleet router's HTTP client —
+    :class:`ChaosSchedule`'s grammar, retargeted from serving-loop sites to
+    ``/fleet/*`` routes.
+
+    The spec is a comma-separated program of
+    ``<action>@<path>[#<replica>][:<arg>]`` steps consumed in order by
+    :meth:`take` calls from ``Router._http``:
+
+    * ``delay@/fleet/stream:50ms`` — sleep before the call (straggler);
+      the arg is a REQUIRED duration (``50ms`` / ``0.5s``).
+    * ``reset@/fleet/stream[:skip]`` — raise ``ConnectionResetError``
+      (flaky wire) after letting ``skip`` matching calls pass.
+    * ``drop@/fleet/stream[:skip]`` — raise ``TimeoutError`` (lost packet).
+    * ``hang@/fleet/stream[:skip]`` — STICKY: once fired, every later call
+      matching the path/replica hangs then times out, modelling a wedged
+      peer that never comes back (the progress-watchdog arc).
+
+    ``#<replica>`` restricts a step to one replica index; a trailing
+    ``heal`` marks the program's end. Example::
+
+        reset@/fleet/stream,hang@/fleet/stream#1:2,heal
+
+    reads "reset the first stream poll anywhere, then wedge replica 1
+    starting at its third stream poll, then run clean (except the sticky
+    hang)".
+    """
+
+    def __init__(self, spec: str):
+        self.spec = spec
+        self.events: list[WireChaosEvent] = []
+        self._sticky: list[WireChaosEvent] = []
+        self._lock = threading.Lock()
+        tokens = [t.strip() for t in spec.split(",") if t.strip()]
+        for i, tok in enumerate(tokens):
+            if tok == "heal":
+                if i != len(tokens) - 1:
+                    raise ValueError(f"'heal' must be last in {spec!r}")
+                break
+            action, sep, rest = tok.partition("@")
+            if not sep or action not in WIRE_CHAOS_ACTIONS:
+                raise ValueError(
+                    f"bad wire chaos step {tok!r} in {spec!r} (want "
+                    f"<action>@<path>[#replica][:arg], action in "
+                    f"{WIRE_CHAOS_ACTIONS})"
+                )
+            target, _, arg = rest.partition(":")
+            path, rsep, rep = target.partition("#")
+            if not path.startswith("/"):
+                raise ValueError(
+                    f"bad wire chaos step {tok!r} in {spec!r}: "
+                    f"path must start with '/'"
+                )
+            if rsep and not rep.isdigit():
+                raise ValueError(
+                    f"bad wire chaos replica in {tok!r}: want an integer index"
+                )
+            delay_s = 0.0
+            skip = 0
+            if action == "delay":
+                if not arg:
+                    raise ValueError(
+                        f"bad wire chaos step {tok!r}: 'delay' needs a "
+                        f"duration arg, e.g. delay@/fleet/stream:50ms"
+                    )
+                delay_s = _parse_duration_s(arg)
+            elif arg:
+                if not arg.isdigit():
+                    raise ValueError(
+                        f"bad wire chaos skip in {tok!r}: want an integer"
+                    )
+                skip = int(arg)
+            self.events.append(
+                WireChaosEvent(
+                    action=action,
+                    path=path,
+                    replica=int(rep) if rsep else None,
+                    skip=skip,
+                    delay_s=delay_s,
+                )
+            )
+
+    @property
+    def exhausted(self) -> bool:
+        with self._lock:
+            return not self.events and not self._sticky
+
+    def _matches(self, ev: WireChaosEvent, path: str, replica: int | None) -> bool:
+        if ev.path != path:
+            return False
+        return ev.replica is None or ev.replica == replica
+
+    def take(self, path: str, replica: int | None = None) -> WireChaosEvent | None:
+        """Return the fault (if any) this call fires. Sticky hangs fire on
+        every matching call; the head program event fires once, in order,
+        after its ``skip`` matching calls have passed."""
+        with self._lock:
+            for ev in self._sticky:
+                if self._matches(ev, path, replica):
+                    return ev
+            if not self.events:
+                return None
+            head = self.events[0]
+            if not self._matches(head, path, replica):
+                return None
+            if head.skip > 0:
+                head.skip -= 1
+                return None
+            self.events.pop(0)
+            if head.action == "hang":
+                self._sticky.append(head)
+            return head
 
 
 # ------------------------------------------------------ degradation registry

@@ -749,14 +749,21 @@ class Scheduler:
             # would shed work the pool is about to be able to serve.
             return False
         waited = now_s - max(req.submitted_at, req.arrival_time_s)
+        # A seeded (migration-resumed) request already produced its first
+        # token on the donor replica: TTFT was met there, only the total
+        # budget still binds here.
         return (
-            req.ttft_deadline_s is not None and waited > req.ttft_deadline_s
+            not req.tokens
+            and req.ttft_deadline_s is not None
+            and waited > req.ttft_deadline_s
         ) or (req.deadline_s is not None and waited > req.deadline_s)
 
     def _expire(self, req: Request, now_s: float) -> None:
         waited = now_s - max(req.submitted_at, req.arrival_time_s)
         limit = min(
-            b for b in (req.ttft_deadline_s, req.deadline_s) if b is not None
+            b for b in (
+                None if req.tokens else req.ttft_deadline_s, req.deadline_s
+            ) if b is not None
         )
         telemetry.inc("tdt_serving_deadline_expiries_total", where="queue")
         telemetry.observe(

@@ -372,6 +372,7 @@ class InferenceServer:
 
     def resume(self, prompt, max_new: int, tokens, on_token=None,
                on_finish=None, priority: int = 1,
+               ttft_deadline_s: float | None = None,
                deadline_s: float | None = None,
                trace_ctx=None) -> Request:
         """Admit a request MID-STREAM: ``tokens`` is the history another
@@ -388,7 +389,8 @@ class InferenceServer:
         toks = [int(t) for t in tokens][: int(max_new)]
         req = self.scheduler.submit(
             prompt, max_new, on_token=on_token, on_finish=on_finish,
-            now_s=self._now(), priority=priority, deadline_s=deadline_s,
+            now_s=self._now(), priority=priority,
+            ttft_deadline_s=ttft_deadline_s, deadline_s=deadline_s,
             tokens=toks, trace_ctx=trace_ctx,
         )
         if req.state is not RequestState.QUEUED:

@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""Lint: the engine's backend → mode maps stay mutually consistent.
+
+``models/engine.py`` routes each compiled program family through a literal
+dict keyed by backend (``PREFILL_MODE`` / ``DECODE_MODE`` / ``CHUNK_MODE``).
+Drift between those maps and ``_BACKENDS`` is exactly how the silent
+``mega`` → ``dist_ar`` decode demotion happened: a new backend (or a new
+map) added in one place resolves everywhere EXCEPT the map someone forgot,
+and the KeyError only fires at runtime on the forgotten path — or worse,
+a stale entry quietly routes the fast backend through the slow mode.
+
+Statically asserted, per AST (no engine import, so the lint runs without
+jax):
+
+* ``_BACKENDS`` and the three maps exist and are literals;
+* every map's key set == the ``_BACKENDS`` set (no missing, no extra);
+* every map value is one of the model-layer modes (``xla`` / ``dist`` /
+  ``dist_ar`` / ``mega``);
+* ``DECODE_MODE["mega"] == "mega"`` — the decode path is the one place the
+  megakernel MUST NOT be demoted (prefill/chunk demotion is deliberate:
+  those program families have no mega lowering).
+
+Usage: ``python scripts/check_backend_maps.py [engine.py path]``.
+Exit 1 with diagnostics on violations.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+DEFAULT_TARGET = REPO / "triton_dist_tpu" / "models" / "engine.py"
+
+MAPS = ("PREFILL_MODE", "DECODE_MODE", "CHUNK_MODE")
+ALLOWED_MODES = {"xla", "dist", "dist_ar", "mega"}
+
+
+def _literal(node: ast.AST, what: str, errors: list[str]):
+    try:
+        return ast.literal_eval(node)
+    except ValueError:
+        errors.append(f"{what} must be a pure literal (statically lintable)")
+        return None
+
+
+def check(path: pathlib.Path) -> list[str]:
+    tree = ast.parse(path.read_text(), filename=str(path))
+    found: dict[str, object] = {}
+    lines: dict[str, int] = {}
+    for node in tree.body:
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        t = node.targets[0]
+        if isinstance(t, ast.Name) and t.id in (*MAPS, "_BACKENDS"):
+            errors: list[str] = []
+            found[t.id] = _literal(node.value, t.id, errors)
+            lines[t.id] = node.lineno
+            if errors:
+                return [f"{path}:{node.lineno}: {e}" for e in errors]
+
+    errors = []
+    backends = found.get("_BACKENDS")
+    if backends is None:
+        return [f"{path}: _BACKENDS literal not found"]
+    bset = set(backends)
+    for name in MAPS:
+        m = found.get(name)
+        loc = f"{path}:{lines.get(name, 0)}"
+        if m is None:
+            errors.append(f"{path}: {name} module-level literal dict not found")
+            continue
+        missing = bset - set(m)
+        extra = set(m) - bset
+        if missing:
+            errors.append(f"{loc}: {name} missing backend(s): {sorted(missing)}")
+        if extra:
+            errors.append(f"{loc}: {name} has unknown backend(s): {sorted(extra)}")
+        bad = {k: v for k, v in m.items() if v not in ALLOWED_MODES}
+        if bad:
+            errors.append(f"{loc}: {name} values outside {sorted(ALLOWED_MODES)}: {bad}")
+    dm = found.get("DECODE_MODE")
+    if isinstance(dm, dict) and dm.get("mega") != "mega":
+        errors.append(
+            f"{path}:{lines.get('DECODE_MODE', 0)}: DECODE_MODE must route "
+            f"'mega' to 'mega' (got {dm.get('mega')!r}) — demoting the decode "
+            "path silently discards the megakernel"
+        )
+    return errors
+
+
+def main(argv: list[str]) -> int:
+    target = pathlib.Path(argv[1]) if len(argv) > 1 else DEFAULT_TARGET
+    errors = check(target)
+    if errors:
+        print("\n".join(errors))
+        print(f"check_backend_maps: FAILED ({len(errors)} error(s))")
+        return 1
+    try:
+        shown = target.relative_to(REPO)
+    except ValueError:
+        shown = target
+    print(f"check_backend_maps: OK ({shown})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -134,6 +134,12 @@ REQUIRED_NAMES = {
     "tdt_fleet_stall_migrations_total",
     "tdt_fleet_respawns_total",
     "tdt_fleet_migration_seconds",
+    # megakernel serving decode: scheduler + launch shape (megakernel/
+    # builder.py, models/engine.py) — the perf path's audit surface
+    "tdt_mega_tasks_scheduled_total",
+    "tdt_mega_fusion_hits_total",
+    "tdt_mega_steps_per_launch",
+    "tdt_mega_ready_depth",
     # span names
     "tdt_serving_probe",
     "tdt_serving_restore",

@@ -447,6 +447,314 @@ def test_graph_mutation_changes_lowering():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-4)
 
 
+def test_step_graph_scoreboard_interleaves_layers():
+    """The serving step graph under policy="scoreboard": layer 0's HBM
+    cache scatter (off the critical path — the fused sweep spliced the new
+    token in VMEM) is DEFERRED behind layer 1's attn-front, and the ready
+    set is ≥2 deep — the adjacent-layer overlap the reference gets from
+    its runtime work queue, emitted here as a static schedule."""
+    from triton_dist_tpu.models.config import PRESETS
+
+    mb = ModelBuilder(PRESETS["test-dense"], world=1,
+                      schedule_policy="scoreboard")
+    step_fn = mb.build_step_fn(2)
+    plan = list(step_fn.plan)
+    assert any(p.startswith("attn_sweep@0→fused_attn_sweep_ex") for p in plan), plan
+    i_cu0 = next(i for i, p in enumerate(plan) if p.startswith("cache_update@0"))
+    i_front1 = next(i for i, p in enumerate(plan) if p.startswith("attn_front@1"))
+    assert i_front1 < i_cu0, plan  # layer-0 scatter deferred past layer-1 front
+    st = mb.graph.stats
+    assert st["policy"] == "scoreboard"
+    assert st["max_ready_depth"] >= 2
+    assert st["fusion_hits"] >= 6  # front+sweep+mlp per layer
+    assert st["tasks"] == len(mb.graph.tasks)
+
+    # The static policy keeps strict layer order (no interleave) — the
+    # env knob picks between them without touching code.
+    mb2 = ModelBuilder(PRESETS["test-dense"], world=1,
+                       schedule_policy="static")
+    plan2 = list(mb2.build_step_fn(2).plan)
+    i_cu0 = next(i for i, p in enumerate(plan2) if p.startswith("cache_update@0"))
+    i_front1 = next(i for i, p in enumerate(plan2) if p.startswith("attn_front@1"))
+    assert i_cu0 < i_front1, plan2
+
+
+def test_mega_policy_env_knob(monkeypatch):
+    from triton_dist_tpu.megakernel import builder as bmod
+    from triton_dist_tpu.models.config import PRESETS
+
+    monkeypatch.setenv("TDT_MEGA_POLICY", "static")
+    assert bmod.default_schedule_policy() == "static"
+    mb = ModelBuilder(PRESETS["test-dense"], world=1)
+    assert mb.schedule_policy == "static"
+    monkeypatch.delenv("TDT_MEGA_POLICY")
+    assert ModelBuilder(PRESETS["test-dense"], world=1).schedule_policy == "scoreboard"
+
+
+def test_explicit_deps_and_cycle_detection():
+    g = TaskGraph()
+    g.add(Task("a", "linear", ("input:x", "param:w"), ("v:a",)))
+    g.add(Task("b", "add", ("input:x", "v:a"), ("v:b",)))
+    # Explicit dep merges with the derived producer dep, deduped.
+    t = g.add(Task("c", "add", ("v:a", "v:b"), ("v:c",), deps=("a",)))
+    assert t.deps == ("a", "b")
+    with pytest.raises(ValueError, match="unknown task"):
+        g.add(Task("d", "add", ("v:c",), ("v:d",), deps=("nope",)))
+    with pytest.raises(ValueError, match="already recorded"):
+        g.add(Task("a", "add", ("v:c",), ("v:dup",)))
+
+
+def _serving_refs(model, requests):
+    from triton_dist_tpu.models import Engine
+
+    eng = Engine(model, backend="xla", max_len=32)
+    return [
+        np.asarray(eng.serve(jnp.asarray([p], jnp.int32), gen_len=g))[0]
+        for p, g in requests
+    ]
+
+
+@pytest.fixture(scope="module")
+def model1():
+    from triton_dist_tpu.models import DenseLLM, PRESETS
+    from triton_dist_tpu.runtime.mesh import initialize_distributed
+    from triton_dist_tpu.runtime.platform import cpu_mesh
+
+    m = cpu_mesh((1,), ("tp",))
+    ctx = initialize_distributed(
+        devices=list(m.devices.flat), axis_names=("tp",), set_default=False
+    )
+    return DenseLLM(PRESETS["test-dense"], ctx, key=jax.random.PRNGKey(1))
+
+
+def test_mega_masked_decode_steps_parity(model1):
+    """Ragged active masks through the persistent-step program: mega
+    decode_steps (contiguous) and decode_steps_paged (direct pool walk,
+    no gather/scatter bounce) both match xla token-for-token, including
+    the inactive slots' -1 cells and frozen lengths. Also pins the
+    tdt_mega_* telemetry contract."""
+    import dataclasses
+    from triton_dist_tpu.models import Engine
+    from triton_dist_tpu.runtime import telemetry
+
+    ids = jnp.asarray([[3, 17, 42, 7, 99, 5]], jnp.int32)
+    results = {}
+    telemetry.reset()
+    for backend in ("xla", "mega"):
+        eng = Engine(model1, backend=backend, max_len=32)
+        # -- contiguous, ragged mask: slot 1 is free (remaining 0)
+        cache = eng.alloc_slots(3)
+        t_a, cache = eng.prefill_into_slot(cache, 0, ids)
+        t_b, cache = eng.prefill_into_slot(cache, 2, ids[:, :4])
+        toks = jnp.asarray([t_a, 0, t_b], jnp.int32)
+        rem = jnp.asarray([5, 0, 3], jnp.int32)
+        out_c, _, cache, rem_c = eng.decode_steps(cache, toks, rem, 6)
+        # -- paged, same composition, decoded against the block pool
+        paged = eng.alloc_paged(3, block_size=8, num_blocks=32)
+        tables = np.zeros((3, paged.tables.shape[1]), np.int32)
+        tables[0, :4] = np.arange(1, 5)
+        tables[2, :4] = np.arange(5, 9)
+        paged = dataclasses.replace(paged, tables=jnp.asarray(tables))
+        logits_a, ka, va = eng._prefill(model1.params, ids)
+        pk, pv = eng._paged_scatter_prefill(
+            paged.k, paged.v, ka, va, jnp.asarray(tables[0]), jnp.int32(0))
+        logits_b, kb, vb = eng._prefill(model1.params, ids[:, :4])
+        pad = ids.shape[1] - 4
+        kb = jnp.pad(kb, ((0, 0),) * 3 + ((0, pad), (0, 0)))
+        vb = jnp.pad(vb, ((0, 0),) * 3 + ((0, pad), (0, 0)))
+        pk, pv = eng._paged_scatter_prefill(
+            pk, pv, kb, vb, jnp.asarray(tables[2]), jnp.int32(0))
+        key = jax.random.PRNGKey(0)
+        toks_p = jnp.asarray([eng.sample_logits(logits_a, key)[0], 0,
+                              eng.sample_logits(logits_b, key)[0]], jnp.int32)
+        paged = dataclasses.replace(
+            paged, k=pk, v=pv,
+            lengths=jnp.asarray([ids.shape[1], 0, 4], jnp.int32))
+        out_p, _, paged, rem_p = eng.decode_steps_paged(
+            paged, toks_p, jnp.asarray([5, 0, 3], jnp.int32), 6)
+        results[backend] = (np.asarray(out_c), np.asarray(rem_c),
+                            np.asarray(out_p), np.asarray(rem_p))
+        if backend == "mega":
+            gauges = telemetry.snapshot()["gauges"]
+            assert "tdt_mega_ready_depth" in gauges
+            paths = {g["labels"]["path"]
+                     for g in gauges["tdt_mega_steps_per_launch"]}
+            assert paths == {"contiguous", "paged"}
+            counters = telemetry.snapshot()["counters"]
+            assert "tdt_mega_tasks_scheduled_total" in counters
+            assert "tdt_mega_fusion_hits_total" in counters
+
+    for got, ref in zip(results["mega"], results["xla"]):
+        np.testing.assert_array_equal(got, ref)
+    # Inactive slot stayed masked the whole chunk.
+    assert (results["mega"][0][1] == -1).all()
+
+
+def test_ep_moe_serves_on_mega(model1):
+    """EPMoELLM builds and serves on backend="mega" (the old hard
+    rejection is gone): the graph's moe task lowers through the EP
+    router → a2a → grouped-GEMM path and greedy decode is byte-identical
+    to both xla and the op-by-op dist_ar backend."""
+    from triton_dist_tpu.models import EPMoELLM, Engine, PRESETS
+
+    model = EPMoELLM(PRESETS["test-moe"], model1.ctx, key=jax.random.PRNGKey(1))
+    ids = jnp.asarray([[3, 5, 7, 11, 2, 9]], jnp.int32)
+    out_x = np.asarray(Engine(model, backend="xla", max_len=32).serve(ids, 6))
+    eng_m = Engine(model, backend="mega", max_len=32)
+    out_m = np.asarray(eng_m.serve(ids, 6))
+    out_d = np.asarray(Engine(model, backend="dist_ar", max_len=32).serve(ids, 6))
+    np.testing.assert_array_equal(out_m, out_x)
+    np.testing.assert_array_equal(out_m, out_d)
+    # The EP lowering went through the builder's moe_impl hook, not TP_MoE.
+    mb = model._mega_builder()
+    fn = mb.build_step_fn(model.config.num_layers)
+    assert any("moe" in p and "moe_impl_ex" in p for p in fn.plan), fn.plan
+
+
+def test_mega_staggered_serving_parity(model1):
+    """Staggered joins/leaves under the serving loop: a mega-backed
+    InferenceServer (paged, chunked) streams byte-identical tokens to the
+    xla one-shot references, across ragged batch compositions."""
+    from triton_dist_tpu.models import Engine
+    from triton_dist_tpu.serving import InferenceServer
+
+    requests = [
+        ([3, 17, 42, 7, 99], 6),
+        ([8, 1, 13], 4),
+        ([100, 200, 30], 5),
+        ([91, 12, 55, 2, 8, 41], 4),
+    ]
+    refs = _serving_refs(model1, requests)
+
+    eng = Engine(model1, backend="mega", max_len=32)
+    srv = InferenceServer(eng, num_slots=2, chunk=2)
+    streams: dict[int, list[int]] = {}
+    handles = [
+        srv.submit(p, g, on_token=lambda r, t, i: streams.setdefault(
+            r.req_id, []).append(t))
+        for p, g in requests
+    ]
+    srv.run()
+    for h, ref in zip(handles, refs):
+        assert h.done
+        np.testing.assert_array_equal(np.asarray(h.tokens, np.int32), ref)
+        assert streams[h.req_id] == list(h.tokens)
+    assert eng.backend == "mega"  # never silently demoted
+
+
+def test_mega_chaos_arc_restores_mega(model1, monkeypatch):
+    """The breaker treats mega as a restorable preferred backend: chaos
+    abort mid-decode → degraded xla recovery (zero loss/dup) → half-open
+    probe → mega restored IN-PROCESS, streams byte-identical to the
+    one-shot references throughout."""
+    import time
+    from triton_dist_tpu.models import Engine
+    from triton_dist_tpu.runtime import resilience, telemetry
+    from triton_dist_tpu.serving import InferenceServer
+
+    monkeypatch.setenv("TDT_DEGRADE_PROBE_S", "0.01")
+    telemetry.reset()
+    resilience.reset_degradation()
+    requests = [
+        ([3, 17, 42, 7, 99], 6),
+        ([8, 1, 13], 4),
+        ([100, 200, 30], 5),
+    ]
+    refs = _serving_refs(model1, requests)
+    try:
+        eng = Engine(model1, backend="mega", max_len=32)
+        assert eng.preferred_backend == "mega"
+        srv = InferenceServer(eng, num_slots=2, chunk=2)
+        streams: dict[int, list[int]] = {}
+        with resilience.chaos_schedule("abort@decode:1,heal"):
+            handles = [
+                srv.submit(p, g, on_token=lambda r, t, i: streams.setdefault(
+                    r.req_id, []).append(t))
+                for p, g in requests
+            ]
+            srv.run()
+            deadline = time.monotonic() + 30.0
+            while eng.backend != "mega":
+                assert time.monotonic() < deadline, "probe never restored mega"
+                if not srv.step():
+                    time.sleep(0.005)
+
+        for h, ref in zip(handles, refs):
+            assert h.done
+            np.testing.assert_array_equal(np.asarray(h.tokens, np.int32), ref)
+            assert streams[h.req_id] == list(h.tokens)
+        assert eng.backend == "mega"
+        assert eng.preferred_backend == "mega"  # survived the xla round-trip
+        assert not resilience.any_degraded()
+        assert telemetry.counter_value(
+            "tdt_serving_restores_total", to_backend="mega") == 1.0
+        assert telemetry.counter_value(
+            "tdt_serving_recoveries_total", from_backend="mega") == 1.0
+    finally:
+        telemetry.reset()
+        resilience.reset_degradation()
+
+
+def _skip_if_cpu_cant_interpret_collectives(exc: Exception):
+    if "get_barrier_semaphore" in str(exc):
+        pytest.skip("one-shot AR barrier semaphores are not interpretable "
+                    "on CPU (runs on real TPU)")
+    raise exc
+
+
+def test_mega_masked_paged_parity_world4(dense_model, monkeypatch):
+    """World-4 ragged-mask byte parity vs the op-by-op dist_ar path,
+    contiguous AND paged. TDT_FLASH_BLOCK_K pins the contiguous sweep's
+    block partition to the paged block size so the two table walks share
+    one online-softmax accumulation order (docs/megakernel.md parity
+    contract). On CPU the world-4 one-shot AR cannot interpret — the
+    test skips there and runs on hardware."""
+    import dataclasses
+    from triton_dist_tpu.models import Engine
+
+    monkeypatch.setenv("TDT_FLASH_BLOCK_K", "8")
+    ids = jnp.asarray([[3, 17, 42, 7, 99, 5]], jnp.int32)
+    results = {}
+    try:
+        for backend in ("dist_ar", "mega"):
+            eng = Engine(dense_model, backend=backend, max_len=32)
+            cache = eng.alloc_slots(3)
+            t_a, cache = eng.prefill_into_slot(cache, 0, ids)
+            t_b, cache = eng.prefill_into_slot(cache, 2, ids[:, :4])
+            toks = jnp.asarray([t_a, 0, t_b], jnp.int32)
+            out_c, _, cache, _ = eng.decode_steps(
+                cache, toks, jnp.asarray([5, 0, 3], jnp.int32), 6)
+
+            paged = eng.alloc_paged(3, block_size=8, num_blocks=32)
+            tables = np.zeros((3, paged.tables.shape[1]), np.int32)
+            tables[0, :4] = np.arange(1, 5)
+            tables[2, :4] = np.arange(5, 9)
+            paged = dataclasses.replace(paged, tables=jnp.asarray(tables))
+            logits_a, ka, va = eng._prefill(dense_model.params, ids)
+            pk, pv = eng._paged_scatter_prefill(
+                paged.k, paged.v, ka, va, jnp.asarray(tables[0]), jnp.int32(0))
+            logits_b, kb, vb = eng._prefill(dense_model.params, ids[:, :4])
+            pad = ids.shape[1] - 4
+            kb = jnp.pad(kb, ((0, 0),) * 3 + ((0, pad), (0, 0)))
+            vb = jnp.pad(vb, ((0, 0),) * 3 + ((0, pad), (0, 0)))
+            pk, pv = eng._paged_scatter_prefill(
+                pk, pv, kb, vb, jnp.asarray(tables[2]), jnp.int32(0))
+            key = jax.random.PRNGKey(0)
+            toks_p = jnp.asarray(
+                [eng.sample_logits(logits_a, key)[0], 0,
+                 eng.sample_logits(logits_b, key)[0]], jnp.int32)
+            paged = dataclasses.replace(
+                paged, k=pk, v=pv,
+                lengths=jnp.asarray([ids.shape[1], 0, 4], jnp.int32))
+            out_p, _, paged, _ = eng.decode_steps_paged(
+                paged, toks_p, jnp.asarray([5, 0, 3], jnp.int32), 6)
+            results[backend] = (np.asarray(out_c), np.asarray(out_p))
+    except NotImplementedError as e:
+        _skip_if_cpu_cant_interpret_collectives(e)
+    for got, ref in zip(results["mega"], results["dist_ar"]):
+        np.testing.assert_array_equal(got, ref)
+
+
 def test_mega_decode_agrees_on_multi_axis_mesh(ctx24):
     """Regression (r5, found by the dp×tp dryrun): the mega backend's
     standalone ARs must pass mesh_axes into the one-shot push kernel — on

@@ -194,9 +194,18 @@ def test_moe_engine_prefill_routes_fused_above_crossover(moe_model1):
     assert _route_count("fused") > base_fused
 
 
-def test_moe_mega_backend_is_rejected(moe_model1):
-    with pytest.raises(NotImplementedError, match="mega decode"):
-        make_engine(moe_model1, backend="mega")
+def test_moe_mega_backend_serves(moe_model1, moe_refs):
+    """The old hard rejection is gone: the EP model builds on the mega
+    backend (step-graph decode with the EP MoE lowered via the builder's
+    ``moe_impl`` hook) and greedy output is byte-identical to the XLA
+    reference. Full serving/chaos coverage lives in test_megakernel.py."""
+    import jax.numpy as jnp
+
+    eng = make_engine(moe_model1, backend="mega")
+    assert eng.preferred_backend == "mega"
+    p, g = REQUESTS[1]
+    out = np.asarray(eng.serve(jnp.asarray([p], jnp.int32), gen_len=g))[0]
+    np.testing.assert_array_equal(out, moe_refs[1])
 
 
 # ============================================== chaos: abort → probe arc

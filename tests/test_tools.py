@@ -631,6 +631,65 @@ def test_tuned_defaults_lint_repo_is_clean():
     assert r.returncode == 0, r.stdout + r.stderr
 
 
+def test_backend_maps_lint_repo_is_clean():
+    import subprocess
+    import sys
+
+    r = subprocess.run(
+        [sys.executable, "scripts/check_backend_maps.py"],
+        capture_output=True, text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_backend_maps_lint_flags_drift(tmp_path):
+    """A map missing a backend, a stale extra entry, a non-literal map, and
+    a demoted DECODE_MODE['mega'] are each flagged with diagnostics."""
+    import subprocess
+    import sys
+
+    def run(src):
+        bad = tmp_path / "engine_bad.py"
+        bad.write_text(src)
+        return subprocess.run(
+            [sys.executable, "scripts/check_backend_maps.py", str(bad)],
+            capture_output=True, text=True,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+
+    base = '_BACKENDS = ("xla", "dist", "dist_ar", "mega")\n'
+    ok_maps = (
+        'PREFILL_MODE = {"xla": "xla", "dist": "dist", "dist_ar": "dist_ar", "mega": "dist_ar"}\n'
+        'DECODE_MODE = {"xla": "xla", "dist": "dist", "dist_ar": "dist_ar", "mega": "mega"}\n'
+        'CHUNK_MODE = {"xla": "xla", "dist": "dist", "dist_ar": "dist_ar", "mega": "dist_ar"}\n'
+    )
+    r = run(base + ok_maps)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+    # A backend added to _BACKENDS but forgotten in one map.
+    r = run(base + ok_maps.replace(', "mega": "dist_ar"}\nDECODE', '}\nDECODE', 1))
+    assert r.returncode == 1
+    assert "PREFILL_MODE missing backend" in r.stdout
+
+    # A stale entry no longer in _BACKENDS.
+    r = run(base + ok_maps.replace(
+        'CHUNK_MODE = {"xla": "xla"', 'CHUNK_MODE = {"legacy": "xla", "xla": "xla"'))
+    assert r.returncode == 1
+    assert "CHUNK_MODE has unknown backend" in r.stdout
+
+    # The one hard routing invariant: decode must not demote mega.
+    r = run(base + ok_maps.replace('"mega": "mega"', '"mega": "dist_ar"'))
+    assert r.returncode == 1
+    assert "DECODE_MODE must route 'mega' to 'mega'" in r.stdout
+
+    # Non-literal maps defeat static linting and are rejected outright.
+    r = run(base + ok_maps.replace(
+        'PREFILL_MODE = {"xla": "xla"', 'PREFILL_MODE = {"xla": some_mode()'))
+    assert r.returncode == 1
+    assert "pure literal" in r.stdout
+
+
 def test_tuned_defaults_lint_flags_violations(tmp_path):
     """A resolver that reads the cache rank-locally, a getter that skips
     ``agreed_cfg_value``, and an AUTO resolver that never reaches it are

@@ -47,7 +47,19 @@ def flash_decode_config_for(q_sds, k_sds, v_sds) -> int:
     ``autotune`` times and persists under, same convention as
     ``flash_attn.flash_config_for`` (a reader keying on fewer args than
     the writer would silently never hit). Falls back to the 256 default —
-    ``fit_block`` shrinks it for short caches."""
+    ``fit_block`` shrinks it for short caches.
+
+    ``TDT_FLASH_BLOCK_K`` (int > 0) overrides both the cache and the
+    default: the online-softmax accumulation order follows the swept block
+    partition, so two lowerings of the same attention are bitwise-identical
+    only at the SAME block_k. Pinning it (typically to the paged KV block
+    size) makes the contiguous path byte-comparable with the paged
+    table-walk — the megakernel parity contract (docs/megakernel.md)."""
+    import os
+
+    pinned = int(os.environ.get("TDT_FLASH_BLOCK_K", "0") or "0")
+    if pinned > 0:
+        return pinned
     from triton_dist_tpu.tools.tune import lookup
 
     hit = lookup(flash_decode_op_name(), [q_sds, k_sds, v_sds])

@@ -10,9 +10,24 @@ mutated graph observably changes the generated kernel sequence (the
 load-bearing analog of the reference's codegen dispatching on task_type,
 ``core/code_generator.py:158-166``). The chosen lowering is recorded in
 ``ModelBuilder.plan``.
+
+Serving shape (``build_step_fn``): the whole model's decode step is ONE
+graph — every layer's tasks recorded with ``@<layer>``-suffixed names, the
+scoreboard policy emitting groups in dependency order so a layer's off-path
+HBM cache scatter defers behind the next layer's attn-front. Per-slot
+active masks and paged block tables enter as DATA operands (``input:active``
+/ ``input:tables``), so one compiled step program serves every batch
+composition — the Orca-style iteration-level masking and the
+vLLM/PagedAttention table walk, inside mega tasks.
+
+Knobs: ``TDT_MEGA_POLICY`` picks the schedule policy when the caller
+doesn't (``scoreboard`` default; ``static`` / ``cost`` as in
+``TaskGraph.schedule``).
 """
 
 from __future__ import annotations
+
+import os
 
 import jax
 import jax.numpy as jnp
@@ -24,11 +39,18 @@ from triton_dist_tpu.megakernel.kernels import (
     fused_ln_qkv_rope,
     fused_mlp_block,
     fused_moe_block,
+    fused_paged_attn_back,
 )
 
 
+def default_schedule_policy() -> str:
+    """Schedule policy when the caller doesn't pick one: ``TDT_MEGA_POLICY``
+    env override, else ``scoreboard`` (the serving decode default)."""
+    return os.environ.get("TDT_MEGA_POLICY", "scoreboard")
+
+
 class ModelBuilder:
-    """Records one transformer layer group's decode tasks and lowers them.
+    """Records a transformer decode step's tasks and lowers them.
 
     Usage (mirrors the reference's builder):
         mb = ModelBuilder(config, axis="tp")
@@ -38,18 +60,27 @@ class ModelBuilder:
 
     To audit/override the fusion, record first, mutate ``mb.graph``, then
     call ``build_layer_fn()`` — it lowers whatever the graph holds.
+
+    ``paged=True`` switches the cache tasks to the block-pool layout
+    (tables + active mask as data operands); ``moe_impl`` replaces the
+    ``moe`` task's lowering with a caller-supplied ``(lp, x) -> y`` — the
+    EP MoE model routes its AUTO-resolved a2a path through it.
     """
 
     def __init__(self, config, axis: str = "tp", world: int = 1,
-                 mesh_axes=None, schedule_policy: str = "static",
-                 batch_hint: int = 8, ctx_hint: int = 4096):
+                 mesh_axes=None, schedule_policy: str | None = None,
+                 batch_hint: int = 8, ctx_hint: int = 4096,
+                 paged: bool = False, moe_impl=None):
         self.config = config
         self.axis = axis
         self.world = world
         self.mesh_axes = mesh_axes
-        self.schedule_policy = schedule_policy
+        self.schedule_policy = (schedule_policy if schedule_policy is not None
+                                else default_schedule_policy())
         self.batch_hint = batch_hint
         self.ctx_hint = ctx_hint
+        self.paged = paged
+        self.moe_impl = moe_impl
         self.graph = TaskGraph()
         self.plan: list[str] = []
 
@@ -75,7 +106,7 @@ class ModelBuilder:
         if gname == "attn_front":
             saved = 2 * (b * d + 2 * b * cols)
             base = d * cols + b * d
-        elif gname == "attn_back":
+        elif gname in ("attn_back", "attn_sweep"):
             saved = 2 * b * hq * hd  # attention output round-trip
             base = hq * hd * d + 2 * hkv * self.ctx_hint * hd * b
         elif gname == "mlp_block":
@@ -96,42 +127,119 @@ class ModelBuilder:
         return saved / max(base, 1)
 
     # ------------------------------------------------------------- recording
-    def make_attn_front(self):
+    # All make_* accept a ``tag`` (task/value name suffix, "@<layer>" in the
+    # step graph) and the wiring values that differ per layer; the defaults
+    # reproduce the classic single-layer graph byte-for-byte.
+    def make_attn_front(self, *, tag: str = "", x_in: str = "input:x"):
         g = self.graph
-        g.add(Task("ln1", "rmsnorm", ("input:x", "param:ln1"), ("v:xn1",)))
-        g.add(Task("qkv_proj", "linear", ("v:xn1", "param:wqkv"), ("v:qkv",)))
-        g.add(Task("qk_norm", "head_norm", ("v:qkv", "param:q_norm", "param:k_norm"), ("v:qkv_n",)))
-        g.add(Task("rope", "rope", ("v:qkv_n", "input:pos"), ("v:q", "v:k", "v:v")))
+        g.add(Task(f"ln1{tag}", "rmsnorm", (x_in, "param:ln1"), (f"v:xn1{tag}",)))
+        g.add(Task(f"qkv_proj{tag}", "linear", (f"v:xn1{tag}", "param:wqkv"), (f"v:qkv{tag}",)))
+        g.add(Task(f"qk_norm{tag}", "head_norm", (f"v:qkv{tag}", "param:q_norm", "param:k_norm"), (f"v:qkv_n{tag}",)))
+        g.add(Task(f"rope{tag}", "rope", (f"v:qkv_n{tag}", "input:pos"), (f"v:q{tag}", f"v:k{tag}", f"v:v{tag}")))
 
-    def make_attn_back(self):
+    def make_attn_back(self, *, tag: str = "", x_in: str = "input:x",
+                       kc_in: str = "input:kc", vc_in: str = "input:vc",
+                       split_sweep: bool = False):
+        """Attention back-leg. Three recorded shapes:
+
+        * classic (default): ``cache_update → flash_decode → o-proj-AR →
+          residual`` — the 4-chain ``attn_back`` group.
+        * ``split_sweep=True`` (contiguous step graph): the sweep
+          (``flash_decode_append``, in-VMEM splice of the new token) runs
+          first and the HBM cache scatter is a SEPARATE task depending only
+          on k/v — the scoreboard defers it behind later-ready work.
+        * ``self.paged``: the cache tasks take ``input:active`` +
+          ``input:tables`` data operands and scatter/walk the block pool
+          (scatter must precede the walk — a paged write has no in-VMEM
+          splice to hide behind, so the classic chain order stands).
+        """
         g = self.graph
-        g.add(Task("cache_update", "cache_update", ("v:k", "v:v", "input:kc", "input:vc", "input:lengths"), ("v:kc2", "v:vc2")))
-        g.add(Task("flash_decode", "flash_decode", ("v:q", "v:kc2", "v:vc2", "input:lengths"), ("v:attn",)))
-        g.add(Task("o_proj_ar", "linear_allreduce", ("v:attn", "param:wo"), ("v:attn_out",)))
-        g.add(Task("resid1", "add", ("input:x", "v:attn_out"), ("v:x1",)))
+        if self.paged:
+            g.add(Task(f"cache_update{tag}", "cache_update",
+                       (f"v:k{tag}", f"v:v{tag}", kc_in, vc_in, "input:lengths",
+                        "input:active", "input:tables"),
+                       (f"v:kc2{tag}", f"v:vc2{tag}")))
+            g.add(Task(f"flash_decode{tag}", "flash_decode",
+                       (f"v:q{tag}", f"v:kc2{tag}", f"v:vc2{tag}", "input:lengths",
+                        "input:active", "input:tables"),
+                       (f"v:attn{tag}",)))
+            g.add(Task(f"o_proj_ar{tag}", "linear_allreduce",
+                       (f"v:attn{tag}", "param:wo"), (f"v:attn_out{tag}",)))
+            g.add(Task(f"resid1{tag}", "add", (x_in, f"v:attn_out{tag}"), (f"v:x1{tag}",)))
+            return
+        if split_sweep:
+            g.add(Task(f"flash_decode{tag}", "flash_decode_append",
+                       (f"v:q{tag}", f"v:k{tag}", f"v:v{tag}", kc_in, vc_in,
+                        "input:lengths"),
+                       (f"v:attn{tag}",)))
+            g.add(Task(f"o_proj_ar{tag}", "linear_allreduce",
+                       (f"v:attn{tag}", "param:wo"), (f"v:attn_out{tag}",)))
+            g.add(Task(f"resid1{tag}", "add", (x_in, f"v:attn_out{tag}"), (f"v:x1{tag}",)))
+            g.add(Task(f"cache_update{tag}", "cache_update",
+                       (f"v:k{tag}", f"v:v{tag}", kc_in, vc_in, "input:lengths"),
+                       (f"v:kc2{tag}", f"v:vc2{tag}")))
+            return
+        g.add(Task(f"cache_update{tag}", "cache_update",
+                   (f"v:k{tag}", f"v:v{tag}", kc_in, vc_in, "input:lengths"),
+                   (f"v:kc2{tag}", f"v:vc2{tag}")))
+        g.add(Task(f"flash_decode{tag}", "flash_decode",
+                   (f"v:q{tag}", f"v:kc2{tag}", f"v:vc2{tag}", "input:lengths"),
+                   (f"v:attn{tag}",)))
+        g.add(Task(f"o_proj_ar{tag}", "linear_allreduce",
+                   (f"v:attn{tag}", "param:wo"), (f"v:attn_out{tag}",)))
+        g.add(Task(f"resid1{tag}", "add", (x_in, f"v:attn_out{tag}"), (f"v:x1{tag}",)))
 
-    def make_mlp_block(self):
+    def make_mlp_block(self, *, tag: str = ""):
         g = self.graph
-        g.add(Task("ln2", "rmsnorm", ("v:x1", "param:ln2"), ("v:xn2",)))
-        g.add(Task("gate_up", "linear", ("v:xn2", "param:mlp_gate", "param:mlp_up"), ("v:gu",)))
-        g.add(Task("swiglu", "swiglu", ("v:gu",), ("v:h",)))
-        g.add(Task("down", "linear", ("v:h", "param:mlp_down"), ("v:mlp_partial",)))
-        g.add(Task("mlp_ar", "allreduce", ("v:mlp_partial",), ("v:mlp_out",)))
-        g.add(Task("resid2", "add", ("v:x1", "v:mlp_out"), ("v:x2",)))
+        g.add(Task(f"ln2{tag}", "rmsnorm", (f"v:x1{tag}", "param:ln2"), (f"v:xn2{tag}",)))
+        g.add(Task(f"gate_up{tag}", "linear", (f"v:xn2{tag}", "param:mlp_gate", "param:mlp_up"), (f"v:gu{tag}",)))
+        g.add(Task(f"swiglu{tag}", "swiglu", (f"v:gu{tag}",), (f"v:h{tag}",)))
+        g.add(Task(f"down{tag}", "linear", (f"v:h{tag}", "param:mlp_down"), (f"v:mlp_partial{tag}",)))
+        g.add(Task(f"mlp_ar{tag}", "allreduce", (f"v:mlp_partial{tag}",), (f"v:mlp_out{tag}",)))
+        g.add(Task(f"resid2{tag}", "add", (f"v:x1{tag}", f"v:mlp_out{tag}"), (f"v:x2{tag}",)))
 
-    def make_moe_block(self):
+    def make_moe_block(self, *, tag: str = ""):
         """MoE variant of the MLP block: routed grouped-expert MLP + AR in
-        one task (``TP_MoE`` lowers it — the reference's MoE stays outside
-        its megakernel too, ``model_builder.py`` dense-only)."""
+        one task. Lowered through TP_MoE / the fused routed-experts kernel
+        by default, or through the builder's ``moe_impl`` callback (the EP
+        model's router → LL a2a dispatch → grouped GEMM → combine path)."""
         g = self.graph
-        g.add(Task("ln2", "rmsnorm", ("v:x1", "param:ln2"), ("v:xn2",)))
+        g.add(Task(f"ln2{tag}", "rmsnorm", (f"v:x1{tag}", "param:ln2"), (f"v:xn2{tag}",)))
         g.add(Task(
-            "moe", "moe",
-            ("v:xn2", "param:router", "param:mlp_gate", "param:mlp_up",
+            f"moe{tag}", "moe",
+            (f"v:xn2{tag}", "param:router", "param:mlp_gate", "param:mlp_up",
              "param:mlp_down"),
-            ("v:mlp_out",),
+            (f"v:mlp_out{tag}",),
         ))
-        g.add(Task("resid2", "add", ("v:x1", "v:mlp_out"), ("v:x2",)))
+        g.add(Task(f"resid2{tag}", "add", (f"v:x1{tag}", f"v:mlp_out{tag}"), (f"v:x2{tag}",)))
+
+    def _record_layer(self, i: int):
+        tag = f"@{i}"
+        x_in = "input:x" if i == 0 else f"v:x2@{i - 1}"
+        kc_in = "input:kc" if i == 0 else f"v:kc2@{i - 1}"
+        vc_in = "input:vc" if i == 0 else f"v:vc2@{i - 1}"
+        self.make_attn_front(tag=tag, x_in=x_in)
+        self.make_attn_back(tag=tag, x_in=x_in, kc_in=kc_in, vc_in=vc_in,
+                            split_sweep=not self.paged)
+        if getattr(self.config, "is_moe", False):
+            self.make_moe_block(tag=tag)
+        else:
+            self.make_mlp_block(tag=tag)
+
+    def _publish_schedule_stats(self):
+        """Emit the scheduler's ``tdt_mega_*`` series — from the builder,
+        once per build: ``summary()`` re-runs ``schedule``, so emitting
+        inside the scheduler would double-count every audit call."""
+        from triton_dist_tpu.runtime import telemetry
+
+        st = self.graph.stats
+        policy = str(st.get("policy", self.schedule_policy))
+        telemetry.inc("tdt_mega_tasks_scheduled_total",
+                      float(st.get("tasks", 0)), policy=policy)
+        telemetry.inc("tdt_mega_fusion_hits_total",
+                      float(st.get("fusion_hits", 0)), policy=policy)
+        telemetry.set_gauge("tdt_mega_ready_depth",
+                            float(st.get("max_ready_depth", 1)), policy=policy)
 
     # --------------------------------------------------------------- codegen
     def build_layer_fn(self):
@@ -151,13 +259,14 @@ class ModelBuilder:
                 self.make_mlp_block()
         groups = self.graph.schedule(policy=self.schedule_policy,
                                      cost_fn=self.group_cost)
+        self._publish_schedule_stats()
 
         c = self.config
         hq = c.num_q_heads // self.world
         hkv = c.num_kv_heads // self.world
         hd = c.head_dim
 
-        executors = []  # list of (env, lp, state) -> None closures
+        executors = []  # list of (env, lp) -> None closures
         self.plan = []
         for group in groups:
             gname = group[0].group.split(":")[0]
@@ -190,10 +299,69 @@ class ModelBuilder:
         layer_fn.plan = tuple(self.plan)
         return layer_fn
 
+    def build_step_fn(self, num_layers: int):
+        """The serving-shaped persistent step: ALL ``num_layers`` layers
+        recorded into ONE graph (``@<layer>``-suffixed tasks), scheduled as
+        one unit — under the scoreboard policy, a layer's deferred cache
+        scatter interleaves with the next layer's attn-front. Returns
+        ``step_fn(layers, x, ks, vs, lengths, active=None, tables=None) ->
+        (x', ks, vs)`` where ``layers`` is the pre-split per-layer param
+        list (``split_layer_params``) and ks/vs are the stacked contiguous
+        caches — or, with ``paged=True``, the stacked block POOLS, with
+        ``tables`` (B, max_blocks) and ``active`` (B,) flowing as data so
+        one compiled program covers every batch composition."""
+        if self.graph.tasks:
+            raise ValueError("build_step_fn records its own graph — use a fresh builder")
+        for i in range(num_layers):
+            self._record_layer(i)
+        groups = self.graph.schedule(policy=self.schedule_policy,
+                                     cost_fn=self.group_cost)
+        self._publish_schedule_stats()
+
+        c = self.config
+        hq = c.num_q_heads // self.world
+        hkv = c.num_kv_heads // self.world
+        hd = c.head_dim
+
+        executors = []  # (executor, layer_index) in emission order
+        self.plan = []
+        for group in groups:
+            gname = group[0].group.split(":")[0]
+            li = int(group[0].name.rsplit("@", 1)[1])
+            ex = self._lower_group(gname, group, hq=hq, hkv=hkv, hd=hd, li=li)
+            self.plan.append(f"{gname}@{li}→{ex.__name__}")
+            executors.append((ex, li))
+
+        last = num_layers - 1
+        final_out = f"v:x2@{last}"
+        kc_out, vc_out = f"v:kc2@{last}", f"v:vc2@{last}"
+        paged = self.paged
+
+        def step_fn(layers, x, ks, vs, lengths, active=None, tables=None):
+            env = {"input:x": x, "input:pos": lengths, "input:lengths": lengths,
+                   "input:kc": (ks, 0), "input:vc": (vs, 0)}
+            if paged:
+                if active is None or tables is None:
+                    raise ValueError("paged step_fn needs active + tables operands")
+                env["input:active"] = active
+                env["input:tables"] = tables
+            for ex, li in executors:
+                ex(env, layers[li])
+            ks, _ = env[kc_out]
+            vs, _ = env[vc_out]
+            return env[final_out], ks, vs
+
+        step_fn.plan = tuple(self.plan)
+        return step_fn
+
     # ------------------------------------------------------ group lowering
-    def _lower_group(self, gname: str, group, *, hq: int, hkv: int, hd: int):
+    def _lower_group(self, gname: str, group, *, hq: int, hkv: int, hd: int,
+                     li: int | None = None):
         """Return an executor closure for one fusion group (or one
-        standalone task). Executors read/write the value environment."""
+        standalone task). Executors read/write the value environment.
+        ``li`` binds the layer index at lowering time (the step graph's
+        groups each belong to one layer); ``li=None`` reads it from the
+        cache value tuples the per-layer ``layer_fn`` threads through."""
         c = self.config
         axis = self.axis
         # Snapshot like `axis`/`world`: executors must not pin the whole
@@ -201,12 +369,15 @@ class ModelBuilder:
         mesh_axes = self.mesh_axes
         eps = c.rms_eps
 
-        from triton_dist_tpu.kernels.flash_decode import flash_decode
+        from triton_dist_tpu.kernels.flash_decode import flash_decode, paged_flash_decode
         from triton_dist_tpu.kernels.gemm_allreduce import gemm_ar_shard
         from triton_dist_tpu.kernels.allreduce import AllReduceMethod, all_reduce_shard
         from triton_dist_tpu.layers.tp import apply_rope
 
         param = lambda name: name.split(":", 1)[1]
+
+        def cache_li(env_li):
+            return env_li if li is None else li
 
         # The fused executors consume the GROUP's recorded dataflow (task
         # inputs/outputs), same contract as the standalone lowerings — a
@@ -234,6 +405,49 @@ class ModelBuilder:
                 env[out_v] = v.reshape(b, hkv, hd)
             return fused_attn_front
 
+        if gname == "attn_back" and self.paged:
+            # [cache_update(k,v,pk,pv,len,active,tables), flash_decode(·),
+            #  linear_allreduce(·, wo), add(x, ·)] — pool scatter + block-
+            #  table walk + o-proj partial in one jit step (the walk is the
+            #  Pallas kernel); AR + residual at graph level.
+            cu_t, fd_t, oar_t, add_t = group
+            k_in, v_in = cu_t.inputs[0], cu_t.inputs[1]
+            kc_in, vc_in, len_in = cu_t.inputs[2], cu_t.inputs[3], cu_t.inputs[4]
+            act_in, tab_in = cu_t.inputs[5], cu_t.inputs[6]
+            q_in = fd_t.inputs[0]
+            wo_p = param(oar_t.inputs[1])
+            resid_in = (add_t.inputs[0] if add_t.inputs[1] == oar_t.outputs[0]
+                        else add_t.inputs[1])
+            kc_out, vc_out = cu_t.outputs
+            out_v = add_t.outputs[0]
+            world = self.world
+
+            def fused_paged_attn_back_ex(env, lp):
+                q = env[q_in]
+                k_new, v_new = env[k_in], env[v_in]
+                pk, env_li = env[kc_in]
+                pv, _ = env[vc_in]
+                lengths = env[len_in]
+                li_ = cache_li(env_li)
+                b = q.shape[0]
+                partial, pk, pv = fused_paged_attn_back(
+                    q, k_new, v_new, pk, pv, li_, env[tab_in], lengths,
+                    env[act_in], lp[wo_p],
+                )
+                # Same rounding points as the contiguous back-leg (and as
+                # gemm_ar_shard's decode ONE_SHOT path): cast the f32
+                # partial to model dtype, then all-reduce.
+                attn_out = partial.astype(q.dtype).reshape(b, -1)
+                if world > 1:
+                    attn_out = all_reduce_shard(
+                        attn_out, axis=axis, mesh_axes=mesh_axes,
+                        method=AllReduceMethod.ONE_SHOT,
+                    )
+                env[out_v] = env[resid_in] + attn_out
+                env[kc_out] = (pk, li_)
+                env[vc_out] = (pv, li_)
+            return fused_paged_attn_back_ex
+
         if gname == "attn_back":
             # [cache_update(k,v,kc,vc,len), flash_decode(q,·,·,len),
             #  linear_allreduce(·, wo), add(x, ·)] — one fused kernel for the
@@ -253,12 +467,13 @@ class ModelBuilder:
             def fused_attn_back_ex(env, lp):
                 q = env[q_in]
                 k_new, v_new = env[k_in], env[v_in]
-                ks, li = env[kc_in]
+                ks, env_li = env[kc_in]
                 vs, _ = env[vc_in]
                 lengths = env[len_in]
+                li_ = cache_li(env_li)
                 b = q.shape[0]
                 partial = fused_attn_back(
-                    q, k_new, v_new, ks[li], vs[li], lengths, lp[wo_p],
+                    q, k_new, v_new, ks[li_], vs[li_], lengths, lp[wo_p],
                 )  # (B, d_model) f32 o-proj partial
                 # Same rounding points as gemm_ar_shard's decode (ONE_SHOT)
                 # path: cast the partial to model dtype, then all-reduce.
@@ -278,23 +493,67 @@ class ModelBuilder:
                 # scatter per sequence, scheduled by XLA in parallel with
                 # the fused sweep (which already folded the new token in).
                 bids = jnp.arange(b)
-                ks = ks.at[li, bids, :, lengths].set(k_new)
-                vs = vs.at[li, bids, :, lengths].set(v_new)
-                env[kc_out] = (ks, li)
-                env[vc_out] = (vs, li)
+                ks = ks.at[li_, bids, :, lengths].set(k_new)
+                vs = vs.at[li_, bids, :, lengths].set(v_new)
+                env[kc_out] = (ks, li_)
+                env[vc_out] = (vs, li_)
             return fused_attn_back_ex
 
+        if gname == "attn_sweep":
+            # [flash_decode_append(q,k,v,kc,vc,len), linear_allreduce(·, wo),
+            #  add(x, ·)] — the step graph's SPLIT back-leg: same fused
+            #  kernel (in-VMEM splice of the new token, so it never waits on
+            #  the HBM append), but the cache scatter is a separate task the
+            #  scoreboard defers behind the next layer's front.
+            fd_t, oar_t, add_t = group
+            q_in, k_in, v_in = fd_t.inputs[0], fd_t.inputs[1], fd_t.inputs[2]
+            kc_in, vc_in, len_in = fd_t.inputs[3], fd_t.inputs[4], fd_t.inputs[5]
+            wo_p = param(oar_t.inputs[1])
+            resid_in = (add_t.inputs[0] if add_t.inputs[1] == oar_t.outputs[0]
+                        else add_t.inputs[1])
+            out_v = add_t.outputs[0]
+            world = self.world
+
+            def fused_attn_sweep_ex(env, lp):
+                q = env[q_in]
+                k_new, v_new = env[k_in], env[v_in]
+                ks, env_li = env[kc_in]
+                vs, _ = env[vc_in]
+                lengths = env[len_in]
+                li_ = cache_li(env_li)
+                b = q.shape[0]
+                partial = fused_attn_back(
+                    q, k_new, v_new, ks[li_], vs[li_], lengths, lp[wo_p],
+                )
+                attn_out = partial.astype(q.dtype).reshape(b, -1)
+                if world > 1:
+                    attn_out = all_reduce_shard(
+                        attn_out, axis=axis, mesh_axes=mesh_axes,
+                        method=AllReduceMethod.ONE_SHOT,
+                    )
+                env[out_v] = env[resid_in] + attn_out
+            return fused_attn_sweep_ex
+
         if gname == "moe_block":
+            t_task = group[0]
+            x_in = t_task.inputs[0]
+            out_v = t_task.outputs[0]
+            if self.moe_impl is not None:
+                # Caller-supplied MoE lowering — the EP model's router → LL
+                # a2a dispatch → grouped GEMM → combine path becomes the
+                # graph's moe task body (AUTO route resolved at trace time).
+                impl = self.moe_impl
+
+                def moe_impl_ex(env, lp):
+                    env[out_v] = impl(lp, env[x_in])
+                return moe_impl_ex
             # The routed-experts MLP through ONE Pallas kernel (fused
             # gate/up→SwiGLU→down, h never in HBM) — routing/dispatch, AR
             # and the weighted unpermute stay at graph level with TP_MoE's
             # exact rounding points (fp32 partials on the wire). BEYOND the
             # reference megakernel (dense-only). pin_standalone("moe")
             # falls back to the jit-level TP_MoE lowering.
-            t_task = group[0]
-            x_in = t_task.inputs[0]
             r_p, g_p, u_p, d_p = (param(i) for i in t_task.inputs[1:])
-            out_v = t_task.outputs[0]
             world = self.world
             mesh_axes = self.mesh_axes
 
@@ -392,30 +651,90 @@ class ModelBuilder:
                 env[t.outputs[2]] = h3[:, hq + hkv :]
             return standalone_rope
 
+        if op == "cache_update" and self.paged:
+            def standalone_cache_update_paged(env, lp, t=task):
+                k_new, v_new = env[t.inputs[0]], env[t.inputs[1]]
+                pk, env_li = env[t.inputs[2]]
+                pv, _ = env[t.inputs[3]]
+                lengths = env[t.inputs[4]]
+                active = env[t.inputs[5]]
+                tables = env[t.inputs[6]]
+                li_ = cache_li(env_li)
+                bs = pk.shape[3]
+                blk = jnp.take_along_axis(
+                    tables, (lengths // bs)[:, None], axis=1)[:, 0]
+                # Inactive slots redirect to the NULL block: their old
+                # blocks may already belong to another tenant.
+                phys = jnp.where(active, blk, 0)
+                sub = lengths % bs
+                pk = pk.at[li_, phys, :, sub, :].set(k_new)
+                pv = pv.at[li_, phys, :, sub, :].set(v_new)
+                env[t.outputs[0]] = (pk, li_)
+                env[t.outputs[1]] = (pv, li_)
+            return standalone_cache_update_paged
+
         if op == "cache_update":
             def standalone_cache_update(env, lp, t=task):
                 k_new, v_new = env[t.inputs[0]], env[t.inputs[1]]
-                ks, li = env[t.inputs[2]]
+                ks, env_li = env[t.inputs[2]]
                 vs, _ = env[t.inputs[3]]
                 lengths = env[t.inputs[4]]
+                li_ = cache_li(env_li)
                 bids = jnp.arange(k_new.shape[0])
-                ks = ks.at[li, bids, :, lengths].set(k_new)
-                vs = vs.at[li, bids, :, lengths].set(v_new)
-                env[t.outputs[0]] = (ks, li)
-                env[t.outputs[1]] = (vs, li)
+                ks = ks.at[li_, bids, :, lengths].set(k_new)
+                vs = vs.at[li_, bids, :, lengths].set(v_new)
+                env[t.outputs[0]] = (ks, li_)
+                env[t.outputs[1]] = (vs, li_)
             return standalone_cache_update
+
+        if op == "flash_decode" and self.paged:
+            def standalone_paged_flash_decode(env, lp, t=task):
+                q = env[t.inputs[0]]
+                pk, env_li = env[t.inputs[1]]
+                pv, _ = env[t.inputs[2]]
+                lengths = env[t.inputs[3]]
+                active = env[t.inputs[4]]
+                tables = env[t.inputs[5]]
+                li_ = cache_li(env_li)
+                b = q.shape[0]
+                step = active.astype(lengths.dtype)
+                env[t.outputs[0]] = paged_flash_decode(
+                    q, pk[li_], pv[li_], tables, lengths + step,
+                ).reshape(b, hq * hd)
+            return standalone_paged_flash_decode
 
         if op == "flash_decode":
             def standalone_flash_decode(env, lp, t=task):
                 q = env[t.inputs[0]]
-                ks, li = env[t.inputs[1]]
+                ks, env_li = env[t.inputs[1]]
                 vs, _ = env[t.inputs[2]]
                 lengths = env[t.inputs[3]]
+                li_ = cache_li(env_li)
                 b = q.shape[0]
                 env[t.outputs[0]] = flash_decode(
-                    q, ks[li], vs[li], lengths + 1,
+                    q, ks[li_], vs[li_], lengths + 1,
                 ).reshape(b, hq * hd)
             return standalone_flash_decode
+
+        if op == "flash_decode_append":
+            def standalone_flash_decode_append(env, lp, t=task):
+                # Append-then-attend on a COPY of the layer slice — the
+                # bitwise oracle for the fused sweep's in-VMEM splice (the
+                # real HBM append stays the cache_update task's job).
+                q = env[t.inputs[0]]
+                k_new, v_new = env[t.inputs[1]], env[t.inputs[2]]
+                ks, env_li = env[t.inputs[3]]
+                vs, _ = env[t.inputs[4]]
+                lengths = env[t.inputs[5]]
+                li_ = cache_li(env_li)
+                b = q.shape[0]
+                bids = jnp.arange(b)
+                kl = ks[li_].at[bids, :, lengths].set(k_new)
+                vl = vs[li_].at[bids, :, lengths].set(v_new)
+                env[t.outputs[0]] = flash_decode(
+                    q, kl, vl, lengths + 1,
+                ).reshape(b, hq * hd)
+            return standalone_flash_decode_append
 
         if op == "linear_allreduce":
             def standalone_linear_ar(env, lp, t=task):
@@ -455,6 +774,13 @@ class ModelBuilder:
             return standalone_allreduce
 
         if op == "moe":
+            if self.moe_impl is not None:
+                impl = self.moe_impl
+
+                def standalone_moe_impl(env, lp, t=task):
+                    env[t.outputs[0]] = impl(lp, env[t.inputs[0]])
+                return standalone_moe_impl
+
             from triton_dist_tpu.layers.tp import MOE_CAPACITY_FACTOR, TP_MoE
 
             mesh_axes = self.mesh_axes

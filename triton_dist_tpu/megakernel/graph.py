@@ -7,8 +7,22 @@ Reference: ``mega_triton_kernel/core/graph.py:101`` (task graph),
 on its inputs, nothing else), so what remains load-bearing is (a) an
 auditable record of the model's op structure and (b) the **fusion grouping**
 deciding which task runs inside which generated Pallas kernel. The scheduler
-here greedily merges adjacent tasks into the known fusable group shapes
-(attn-front, mlp-block); everything else lowers to its standalone kernel.
+here merges tasks into the known fusable group shapes (attn-front,
+mlp-block); everything else lowers to its standalone kernel.
+
+Scheduling policies:
+
+* ``"static"`` / ``"cost"`` — linear scan over the builder's append order
+  (a single layer's tasks are already a topological line).
+* ``"scoreboard"`` — the reference's scoreboard dependency model
+  (``core/scheduler.py``: a task becomes runnable when its producer tasks
+  have retired, not when its program-order predecessor has). Tasks carry
+  explicit producer ``deps`` (derived from the dataflow at ``add`` time),
+  emission walks a Kahn ready set, and chains are matched along the actual
+  dataflow rather than list adjacency — so independent groups from
+  *adjacent layers* interleave: with the serving step graph's split
+  attention back-leg, layer N's off-path HBM cache scatter is deferred
+  behind layer N+1's attn-front whenever both are ready.
 """
 
 from __future__ import annotations
@@ -26,6 +40,11 @@ class Task:
     outputs: tuple[str, ...]
     group: str | None = None  # fusion group id assigned by the scheduler
     pinned: bool = False  # pinned tasks never fuse (scheduler override)
+    # Producer-task names this task waits on (the scoreboard's dependency
+    # row). ``TaskGraph.add`` merges the dataflow-derived producers into
+    # whatever the builder passed explicitly (explicit entries express
+    # ordering constraints the value names alone don't, e.g. an audit pin).
+    deps: tuple[str, ...] = ()
 
 
 # Minimum modeled fraction of a group's HBM traffic that fusion must save
@@ -39,12 +58,23 @@ class Task:
 # "static" policy fuses it regardless.)
 COST_FUSE_THRESHOLD = 0.005
 
+# Ops the scoreboard defers while other work is ready: the HBM cache
+# scatter is off every consumer's critical path within its own layer (the
+# fused sweep already spliced the new token in VMEM), so emitting it late
+# lets the next layer's attn-front start first — the adjacent-layer
+# overlap the reference gets from its runtime work queue.
+DEFERRABLE_OPS = frozenset({"cache_update"})
+
 # Chains the codegen knows how to fuse into one Pallas kernel, checked in
 # order (longest first). Reference analog: the generated kernel's
 # per-task-type dispatch (code_generator.py:158-166).
 FUSABLE_CHAINS = (
     (("rmsnorm", "linear", "head_norm", "rope"), "attn_front"),
     (("cache_update", "flash_decode", "linear_allreduce", "add"), "attn_back"),
+    # The serving step graph splits the attention back-leg: the sweep
+    # (in-VMEM append + online softmax + o-proj partial) fuses here while
+    # the HBM cache scatter stays a separate, deferrable task.
+    (("flash_decode_append", "linear_allreduce", "add"), "attn_sweep"),
     (("rmsnorm", "linear", "swiglu", "linear"), "mlp_block"),
     # Length-1 "chain": routes the moe task through the fused routed-experts
     # kernel; pin_standalone("moe") falls back to the jit-level TP_MoE.
@@ -58,7 +88,13 @@ class TaskGraph:
     def __init__(self):
         self.tasks: list[Task] = []
         self._producers: dict[str, str] = {}
+        self._names: set[str] = set()
         self._last_schedule_args = ("static", None)
+        #: Stats of the last ``schedule`` run (task/group counts, fusion
+        #: hits, peak ready-set depth) — published as ``tdt_mega_*`` gauges
+        #: by the builder, never from here (``summary()`` re-runs schedule
+        #: and would double-count).
+        self.stats: dict[str, float] = {}
 
     def pin_standalone(self, name: str) -> None:
         """Exclude a task from fusion (scheduler override): any chain window
@@ -72,38 +108,55 @@ class TaskGraph:
         raise KeyError(f"no task named {name!r}")
 
     def add(self, task: Task) -> Task:
+        if task.name in self._names:
+            raise ValueError(f"task name {task.name!r} already recorded")
         for out in task.outputs:
             if out in self._producers:
                 raise ValueError(f"value {out!r} already produced by {self._producers[out]!r}")
         for inp in task.inputs:
             if inp not in self._producers and not inp.startswith(("param:", "input:")):
                 raise ValueError(f"task {task.name!r} consumes unproduced value {inp!r}")
+        for dep in task.deps:
+            if dep not in self._names:
+                raise ValueError(f"task {task.name!r} declares dep on unknown task {dep!r}")
+        derived = [self._producers[i] for i in task.inputs if i in self._producers]
+        task.deps = tuple(dict.fromkeys((*task.deps, *derived)))
         for out in task.outputs:
             self._producers[out] = task.name
+        self._names.add(task.name)
         self.tasks.append(task)
         return task
 
     def schedule(self, policy: str = "static", cost_fn=None) -> list[list[Task]]:
-        """Fusion grouping: scan the (already topologically ordered —
-        builders append in dependency order) task list and merge maximal
-        chains matching FUSABLE_CHAINS; each group becomes one generated
-        kernel. Returns the grouped schedule and stamps task.group.
+        """Fusion grouping: merge tasks into maximal chains matching
+        FUSABLE_CHAINS; each group becomes one generated kernel. Returns the
+        grouped schedule (in emission order) and stamps task.group.
 
         ``policy`` (the reference scheduler's static round-robin vs runtime
         work-queue choice, ``core/scheduler.py:103-157``, re-thought for a
         compiler target — XLA compiles ONE static schedule and the Pallas
         grid does the load balancing a GPU work-queue buys, so the
-        load-bearing decision on TPU is WHICH chains become fused kernels):
+        load-bearing decisions on TPU are WHICH chains become fused kernels
+        and in WHAT ORDER the groups are emitted):
 
-        * ``"static"`` — fuse every matching chain (default; the generated
-          kernels are measured wins in the decode regime).
-        * ``"cost"`` — fuse a chain only when ``cost_fn(gname, window)``
-          (a modeled fraction of the group's HBM traffic saved by keeping
-          intermediates in VMEM) clears ``COST_FUSE_THRESHOLD``; below it
-          the tasks lower standalone and XLA's own fusion is trusted.
-          ``ModelBuilder`` supplies the cost model from its config.
+        * ``"static"`` — linear scan of the append order; fuse every
+          matching chain (the generated kernels are measured wins in the
+          decode regime).
+        * ``"cost"`` — linear scan; fuse a chain only when
+          ``cost_fn(gname, window)`` (a modeled fraction of the group's HBM
+          traffic saved by keeping intermediates in VMEM) clears
+          ``COST_FUSE_THRESHOLD``; below it the tasks lower standalone and
+          XLA's own fusion is trusted. ``ModelBuilder`` supplies the cost
+          model from its config.
+        * ``"scoreboard"`` — dependency-driven emission (reference
+          scoreboard model): walk the ready set, match chains along the
+          dataflow, defer ``DEFERRABLE_OPS`` while other work is ready so
+          adjacent layers' independent groups interleave. Fuses every
+          matching chain like "static" (the cost gate stays the "cost"
+          policy's job); the default for serving decode via
+          ``TDT_MEGA_POLICY``.
         """
-        if policy not in ("static", "cost"):
+        if policy not in ("static", "cost", "scoreboard"):
             raise ValueError(f"unknown schedule policy {policy!r}")
         if policy == "cost" and cost_fn is None:
             raise ValueError(
@@ -113,10 +166,27 @@ class TaskGraph:
         self._last_schedule_args = (policy, cost_fn)
 
         def fuse_ok(gname, window):
-            if policy == "static":
+            if policy != "cost":
                 return True
             return cost_fn(gname, window) >= COST_FUSE_THRESHOLD
 
+        if policy == "scoreboard":
+            groups = self._schedule_scoreboard(fuse_ok)
+        else:
+            groups = self._schedule_linear(fuse_ok)
+        fusion_hits = sum(1 for g in groups if g[0].group.split(":")[0] in
+                          {gn for _, gn in FUSABLE_CHAINS})
+        self.stats.update(
+            policy=policy, tasks=len(self.tasks), groups=len(groups),
+            fusion_hits=fusion_hits,
+        )
+        self.stats.setdefault("max_ready_depth", 1)
+        return groups
+
+    def _schedule_linear(self, fuse_ok) -> list[list[Task]]:
+        """Append-order scan (the builders append in dependency order, so a
+        single layer's task list is already a topological line)."""
+        self.stats = {"max_ready_depth": 1}
         groups: list[list[Task]] = []
         i = 0
         gid = 0
@@ -149,6 +219,98 @@ class TaskGraph:
                 groups.append([t])
                 i += 1
                 gid += 1
+        return groups
+
+    def _schedule_scoreboard(self, fuse_ok) -> list[list[Task]]:
+        """Kahn ready-set emission over ``Task.deps`` with dataflow-driven
+        chain matching. Ties in the ready set break by append order
+        (deterministic — the schedule is compiled, so it must be stable
+        across retraces), except that DEFERRABLE_OPS yield to any other
+        ready task."""
+        by_name = {t.name: t for t in self.tasks}
+        order = {t.name: i for i, t in enumerate(self.tasks)}
+        indeg = {t.name: len(t.deps) for t in self.tasks}
+        consumers: dict[str, list[str]] = {t.name: [] for t in self.tasks}
+        for t in self.tasks:
+            for d in t.deps:
+                consumers[d].append(t.name)
+        for lst in consumers.values():
+            lst.sort(key=order.__getitem__)
+
+        emitted: set[str] = set()
+
+        def grow_chain(head: Task):
+            """Try to grow a fusable chain from ``head`` along the dataflow:
+            each next link is a direct consumer of the previous link whose
+            other producers have all retired (or sit earlier in the same
+            window) — the fused kernel must be runnable as one unit."""
+            if head.pinned:
+                return None, None
+            for ops, gname in FUSABLE_CHAINS:
+                if head.op != ops[0]:
+                    continue
+                window = [head]
+                names = {head.name}
+                ok = True
+                for nxt_op in ops[1:]:
+                    prev = window[-1]
+                    cand = None
+                    for cn in consumers[prev.name]:
+                        ct = by_name[cn]
+                        if (ct.op != nxt_op or ct.pinned or ct.name in emitted
+                                or not (set(prev.outputs) & set(ct.inputs))):
+                            continue
+                        if all(d in emitted or d in names for d in ct.deps):
+                            cand = ct
+                            break
+                    if cand is None:
+                        ok = False
+                        break
+                    window.append(cand)
+                    names.add(cand.name)
+                if ok and fuse_ok(gname, window):
+                    return window, gname
+            return None, None
+
+        ready = sorted((t for t in self.tasks if indeg[t.name] == 0),
+                       key=lambda t: order[t.name])
+        groups: list[list[Task]] = []
+        gid = 0
+        max_ready = 0
+        while ready:
+            max_ready = max(max_ready, len(ready))
+            head = ready[0]
+            if head.op in DEFERRABLE_OPS and len(ready) > 1:
+                head = ready[1]
+            window, gname = grow_chain(head)
+            if window is not None:
+                g = f"{gname}:{gid}"
+                for t in window:
+                    t.group = g
+            else:
+                window = [head]
+                head.group = f"{head.op}:{gid}"
+            groups.append(window)
+            gid += 1
+            for t in window:
+                emitted.add(t.name)
+            released: list[Task] = []
+            for t in window:
+                for cn in consumers[t.name]:
+                    if cn in emitted:
+                        continue
+                    indeg[cn] -= 1
+                    if indeg[cn] == 0:
+                        released.append(by_name[cn])
+            ready = [r for r in ready if r.name not in emitted]
+            ready.extend(r for r in released if r not in ready)
+            ready.sort(key=lambda t: order[t.name])
+        if len(emitted) != len(self.tasks):
+            stuck = [t.name for t in self.tasks if t.name not in emitted]
+            raise ValueError(
+                f"scoreboard schedule never released {stuck!r} — dependency "
+                "cycle or a dep on a task that was never recorded")
+        self.stats = {"max_ready_depth": max_ready}
         return groups
 
     def summary(self) -> str:

@@ -494,6 +494,63 @@ def fused_attn_back(
     )(lengths.astype(jnp.int32), qr, k_new, v_new, k_cache, v_cache, wo)
 
 
+def fused_paged_attn_back(
+    q: jax.Array,  # (B, Hq, D) — roped decode queries
+    k_new: jax.Array,  # (B, Hkv, D) — this step's K token
+    v_new: jax.Array,  # (B, Hkv, D)
+    pk: jax.Array,  # (L, num_blocks, Hkv, bs, D) — stacked block pool
+    pv: jax.Array,
+    li: int,  # layer index into the pool's leading dim
+    tables: jax.Array,  # (B, max_blocks) int32 physical block ids
+    lengths: jax.Array,  # (B,) int32 valid length BEFORE this step
+    active: jax.Array,  # (B,) bool — serving slot mask (DATA, not shape)
+    wo: jax.Array,  # (Hq*D, n) — o-projection shard (TP rows)
+    *,
+    scale: float | None = None,
+):
+    """Paged attention back-leg: pool scatter → block-table walk →
+    o-projection partial, the serving-shaped analog of ``fused_attn_back``.
+
+    The table walk IS the Pallas kernel here (``paged_flash_decode``'s
+    scalar-prefetched grid, the vLLM/PagedAttention layout); the one-row
+    scatter and the o-proj GEMM ride the same jit step, where XLA overlaps
+    them against the sweep. Unlike the contiguous leg there is no in-VMEM
+    splice — a paged write lands at ``tables[b, pos//bs]`` which only the
+    same step's walk reads, so scatter-then-attend IS append-then-attend
+    and the accumulation partition is the pool's block size by
+    construction. That makes this path bitwise-comparable with the
+    contiguous op-by-op decode exactly when the contiguous sweep runs at
+    ``block_k == bs`` (pin via ``TDT_FLASH_BLOCK_K`` or the tune cache —
+    the megakernel parity contract, docs/megakernel.md).
+
+    ``active`` is per-slot DATA: inactive slots redirect their write to the
+    reserved NULL block 0 (a freed slot's old blocks may already belong to
+    another tenant — the contiguous mode's "harmless junk write" would be
+    cross-slot corruption here) and attend only their frozen ``lengths``
+    rows. Returns ``(o_proj_partial (B, n) f32, pk', pv')``; the caller
+    all-reduces the partial over tp and adds the residual."""
+    from triton_dist_tpu.kernels.flash_decode import paged_flash_decode
+
+    b, hq, d = q.shape
+    bs = pk.shape[3]
+    scale = scale if scale is not None else d ** -0.5
+
+    step = active.astype(lengths.dtype)
+    pos = lengths  # the new token's row (write position)
+    blk = jnp.take_along_axis(tables, (pos // bs)[:, None], axis=1)[:, 0]
+    phys = jnp.where(active, blk, 0)
+    sub = pos % bs
+    pk = pk.at[li, phys, :, sub, :].set(k_new)
+    pv = pv.at[li, phys, :, sub, :].set(v_new)
+    o = paged_flash_decode(
+        q, pk[li], pv[li], tables, lengths + step, scale=scale
+    )
+    part = jnp.dot(
+        o.reshape(b, hq * d), wo, preferred_element_type=jnp.float32
+    )
+    return part, pk, pv
+
+
 def _norm_head_kernel(x_ref, nw_ref, w_ref, o_ref, xn, *, eps):
     vi = pl.program_id(0)
 

@@ -285,25 +285,54 @@ class DenseLLM:
             jax.tree.map(lambda a: a[i], stack) for i in range(self.config.num_layers)
         ]
 
-    def decode_shard_mega(self, p: DenseParams, mega_layers: list, token, ks, vs, lengths):
-        """Megakernel decode: each block is one fused Pallas kernel
-        (megakernel/builder.py), layers python-unrolled over the pre-split
-        ``mega_layers`` param dicts. MoE models lower their MLP through the
-        graph's ``moe`` task (TP_MoE — routed grouped experts, like the
-        reference's MoE staying outside its megakernel)."""
-        c = self.config
+    def _mega_moe_impl(self):
+        """Lowering callback for the graph's ``moe`` task, or None to use
+        the builder's default (fused routed-experts TP path). The EP model
+        overrides this to route its a2a decode path through the graph."""
+        return None
+
+    def _mega_builder(self, *, paged: bool = False):
         from triton_dist_tpu.megakernel.builder import ModelBuilder
 
-        mega_layer = ModelBuilder(
-            c, axis=self.axis, world=self.world, mesh_axes=self.ctx.axis_names
-        ).build_layer_fn()
+        return ModelBuilder(
+            self.config, axis=self.axis, world=self.world,
+            mesh_axes=self.ctx.axis_names, paged=paged,
+            moe_impl=self._mega_moe_impl(),
+        )
+
+    def decode_shard_mega(self, p: DenseParams, mega_layers: list, token, ks, vs, lengths):
+        """Megakernel decode: the WHOLE model's step is one recorded task
+        graph (``build_step_fn``) — fused Pallas kernels per group, the
+        scoreboard policy interleaving a layer's deferred cache scatter
+        with the next layer's attn-front. MoE models lower their MLP
+        through the graph's ``moe`` task (``_mega_moe_impl`` hook; the EP
+        model routes its AUTO a2a decode path through it)."""
+        c = self.config
+        step_fn = self._mega_builder().build_step_fn(c.num_layers)
         x = p.embed[token]
-        for i, lp in enumerate(mega_layers):
-            x, ks, vs = mega_layer(lp, x, ks, vs, i, lengths)
+        x, ks, vs = step_fn(mega_layers, x, ks, vs, lengths)
         from triton_dist_tpu.megakernel.kernels import fused_norm_head
 
         logits = fused_norm_head(x, p.final_norm, p.lm_head, eps=c.rms_eps)
         return logits, ks, vs
+
+    def decode_shard_mega_paged(self, p: DenseParams, mega_layers: list, token,
+                                pk, pv, tables, lengths, active):
+        """Paged megakernel decode: same persistent-step graph, but the
+        cache tasks scatter into / walk the stacked block POOLS directly —
+        ``tables`` (B, max_blocks) and ``active`` (B,) are DATA operands,
+        so one compiled program serves every batch composition with no
+        whole-pool gather/scatter per chunk. Inactive slots write to the
+        NULL block (0) and their logits are masked by the caller."""
+        c = self.config
+        step_fn = self._mega_builder(paged=True).build_step_fn(c.num_layers)
+        x = p.embed[token]
+        x, pk, pv = step_fn(mega_layers, x, pk, pv, lengths, active=active,
+                            tables=tables)
+        from triton_dist_tpu.megakernel.kernels import fused_norm_head
+
+        logits = fused_norm_head(x, p.final_norm, p.lm_head, eps=c.rms_eps)
+        return logits, pk, pv
 
     def decode_shard(self, p: DenseParams, token: jax.Array, ks, vs, lengths, mode: str):
         """Inside shard_map. token (B,) → (logits (B, V_local), updated caches).

@@ -33,6 +33,17 @@ from triton_dist_tpu.runtime import telemetry, tracing
 
 _BACKENDS = ("xla", "dist", "dist_ar", "mega")
 
+# Backend → per-program mode maps, as MODULE-LEVEL LITERALS so
+# scripts/check_backend_maps.py can statically assert every _BACKENDS entry
+# resolves in every map (the silent mega→dist_ar decode demotion this file
+# once grew was exactly this drift). The chunk map's mega→dist_ar is
+# deliberate and load-bearing: chunked PREFILL has no mega lowering — the
+# megakernel graph is decode-shaped (one token per slot per step) — so a
+# mega engine prefills op-by-op and decodes fused.
+PREFILL_MODE = {"xla": "xla", "dist": "dist", "dist_ar": "dist_ar", "mega": "dist_ar"}
+DECODE_MODE = {"xla": "xla", "dist": "dist_ar", "dist_ar": "dist_ar", "mega": "mega"}
+CHUNK_MODE = {"xla": "xla", "dist": "dist_ar", "dist_ar": "dist_ar", "mega": "dist_ar"}
+
 
 def sample_token(
     logits: jax.Array,  # (B, V) fp32
@@ -71,6 +82,11 @@ class Engine:
         self.sample_method = sample
         self.temperature = temperature
         self.top_p = top_p
+        # The backend this engine was ASKED for — never mutated by rebuild()
+        # or degraded-mode fallback, so the serving layer's breaker probe
+        # always knows the restore target even after mega → xla → probe
+        # round-trips (self.backend tracks what is currently built).
+        self.preferred_backend = backend
         self._build(backend)
 
     def rebuild(self, backend: str) -> None:
@@ -102,8 +118,8 @@ class Engine:
         mesh = ctx.mesh
         axis = model.axis
 
-        prefill_mode = {"xla": "xla", "dist": "dist", "dist_ar": "dist_ar", "mega": "dist_ar"}[backend]
-        decode_mode = {"xla": "xla", "dist": "dist_ar", "dist_ar": "dist_ar", "mega": "mega"}[backend]
+        prefill_mode = PREFILL_MODE[backend]
+        decode_mode = DECODE_MODE[backend]
 
         if backend == "dist":
             # Resolve the prefill routing crossovers ONCE at build time:
@@ -146,6 +162,8 @@ class Engine:
         len_spec = P(dp)
         kv_spec = P(None, dp, "tp")  # (L, B over dp, Hkv over tp, S, D)
         self._kv_sharding = ctx.sharding(*kv_spec)
+        pool_spec = P(None, None, "tp")  # (L, blocks, Hkv over tp, bs, D)
+        self._pool_sharding = ctx.sharding(*pool_spec)
 
         def prefill_fn(params, tokens):
             logits, (ks, vs) = model.prefill_shard(params, tokens, prefill_mode)
@@ -196,7 +214,26 @@ class Engine:
             # tunneled remote compile rejects it outright with HTTP 413).
             self._decode_extra = self._mega_layers
             self._decode_shard = sm
+
+            # Paged persistent step: the block tables and per-slot active
+            # mask enter the fused program as DATA, so the pool is decoded
+            # in place — no whole-pool gather/scatter per chunk (the
+            # contiguous-bounce path below pays ~2 pool copies per chunk).
+            def decode_paged_fn(params, mega, token, pk, pv, tables, lengths, active):
+                logits, pk, pv = model.decode_shard_mega_paged(
+                    params, mega, token, pk, pv, tables, lengths, active
+                )
+                return jax.lax.all_gather(logits, axis, axis=1, tiled=True), pk, pv
+
+            self._decode_shard_paged = jax.shard_map(
+                decode_paged_fn, mesh=mesh,
+                in_specs=(p_specs, mega_specs, tok_spec, pool_spec, pool_spec,
+                          P(dp), len_spec, len_spec),
+                out_specs=(tok_spec, pool_spec, pool_spec),
+                check_vma=False,
+            )
         else:
+            self._decode_shard_paged = None
             def decode_fn(params, token, ks, vs, lengths):
                 logits, ks, vs = model.decode_shard(params, token, ks, vs, lengths, decode_mode)
                 return jax.lax.all_gather(logits, axis, axis=1, tiled=True), ks, vs
@@ -302,18 +339,54 @@ class Engine:
 
         self._decode_chunk = decode_chunk
 
+        # Paged twin of decode_chunk, used when the backend decodes the
+        # block pool directly (mega): same active-mask/re-feed/freeze
+        # semantics per step, but the carry is the POOL pair and the block
+        # tables ride as data — one compiled program per chunk size, zero
+        # recompiles across batch compositions.
+        @partial(jax.jit, static_argnums=(8,), donate_argnums=(3, 4))
+        def decode_chunk_paged(params, extra, token, pk, pv, tables, lengths,
+                               remaining, chunk, key):
+            bsz = token.shape[0]
+            out0 = jnp.full((bsz, chunk), -1, jnp.int32)
+
+            def body(i, carry):
+                out, token, pk, pv, lengths, remaining, key = carry
+                active = remaining > 0
+                logits, pk, pv = self._decode_shard_paged(
+                    params, extra, token, pk, pv, tables, lengths, active
+                )
+                key, sub = jax.random.split(key)
+                nxt = sample_token(
+                    logits, sub, self.sample_method, self.temperature, self.top_p
+                )
+                # Inactive slots re-feed their last token and freeze their
+                # lengths (decode_chunk's rule); their KV write redirects to
+                # the NULL block inside the fused step — a freed slot's old
+                # blocks may already belong to another tenant.
+                nxt = jnp.where(active, nxt, token)
+                out = out.at[:, i].set(jnp.where(active, nxt, jnp.int32(-1)))
+                step = active.astype(lengths.dtype)
+                return (out, nxt, pk, pv, lengths + step, remaining - step, key)
+
+            carry = (out0, token, pk, pv, lengths, remaining, key)
+            out, token, pk, pv, lengths, remaining, _ = jax.lax.fori_loop(
+                0, chunk, body, carry
+            )
+            return out, token, pk, pv, lengths, remaining
+
+        self._decode_chunk_paged = decode_chunk_paged
+
         # ---- paged-KV serving programs (block pool + tables) --------------
         # The paged layout splits the slot cache into a global block pool;
         # everything below keeps the fixed-shape discipline: block tables
-        # are DATA (int32 operands), pool/buffer shapes are static, and the
-        # decode math still runs through self._decode_chunk — the paged
-        # path is gather → proven contiguous chunk → masked scatter-back,
-        # so every decode guarantee (active masks, chaos hooks, donation)
-        # carries over unchanged.
-        chunk_mode = {"xla": "xla", "dist": "dist_ar", "dist_ar": "dist_ar",
-                      "mega": "dist_ar"}[backend]
-        pool_spec = P(None, None, "tp")  # (L, blocks, Hkv over tp, bs, D)
-        self._pool_sharding = ctx.sharding(*pool_spec)
+        # are DATA (int32 operands) and pool/buffer shapes are static. On
+        # op-by-op backends the decode math still runs through
+        # self._decode_chunk — gather → proven contiguous chunk → masked
+        # scatter-back, so every decode guarantee (active masks, chaos
+        # hooks, donation) carries over unchanged; the mega backend skips
+        # the bounce and decodes the pool in place (decode_chunk_paged).
+        chunk_mode = CHUNK_MODE[backend]
 
         def chunk_fn(params, toks, kb, vb, off, last_idx):
             logits, (kb, vb) = model.prefill_chunk_shard(
@@ -540,14 +613,29 @@ class Engine:
     def decode_steps_paged(self, paged: PagedKVCache, tokens: jax.Array,
                            remaining: jax.Array, chunk: int,
                            key: jax.Array | None = None):
-        """Paged analog of ``decode_steps``: gather the block pool into the
-        contiguous layout, run the SAME ``self._decode_chunk`` program (every
-        contiguous-mode decode guarantee — active masks, donation, the chaos
-        suite's dispatch hook — applies verbatim), then scatter the chunk's
-        written rows back into the pool with the null-block mask. Returns
-        ``(out, last_tokens, paged', remaining')``."""
+        """Paged analog of ``decode_steps``. On the mega backend the chunk
+        runs DIRECTLY against the block pool — the persistent-step program
+        takes tables + active mask as data, so there is no whole-pool
+        gather/scatter bounce per chunk. Op-by-op backends gather the pool
+        into the contiguous layout, run the SAME ``self._decode_chunk``
+        program (every contiguous-mode decode guarantee — active masks,
+        donation, the chaos suite's dispatch hook — applies verbatim), then
+        scatter the chunk's written rows back with the null-block mask.
+        Returns ``(out, last_tokens, paged', remaining')``."""
         if key is None:
             key = jax.random.PRNGKey(0)
+        if self.backend == "mega":
+            out, tok, pk, pv, lengths, rem = self._decode_chunk_paged(
+                self.model.params, self._decode_extra, tokens, paged.k,
+                paged.v, paged.tables, paged.lengths, remaining, int(chunk),
+                key,
+            )
+            telemetry.set_gauge(
+                "tdt_mega_steps_per_launch", float(chunk), path="paged"
+            )
+            return out, tok, dataclasses.replace(
+                paged, k=pk, v=pv, lengths=lengths
+            ), rem
         kc, vc = self._paged_gather(paged.k, paged.v, paged.tables)
         out, tok, k2, v2, lengths, rem = self._decode_chunk(
             self.model.params, self._decode_extra, tokens, kc, vc,
@@ -581,6 +669,13 @@ class Engine:
         replace their handle with cache'."""
         if key is None:
             key = jax.random.PRNGKey(0)
+        if self.backend == "mega":
+            # The whole chunk is `chunk` dispatches of ONE fused step
+            # program (the persistent-step graph) inside a single on-device
+            # fori_loop launch.
+            telemetry.set_gauge(
+                "tdt_mega_steps_per_launch", float(chunk), path="contiguous"
+            )
         out, tok, k2, v2, lengths, rem = self._decode_chunk(
             self.model.params, self._decode_extra, tokens, cache.k, cache.v,
             cache.lengths, remaining, int(chunk), key,

@@ -203,16 +203,17 @@ class EPMoELLM(DenseLLM):
             counts, dropped, jax.lax.axis_index(self.axis),
         )
 
-    # Unsupported backends ---------------------------------------------
-    def decode_shard_mega(self, *args, **kwargs):
-        raise NotImplementedError(
-            "mega decode is not supported for the EP-sharded MoE model: the "
-            "megakernel graph lowers MoE through TP_MoE (ffe-sharded "
-            "slabs); use backend 'dist_ar' (AUTO-routed low-latency EP a2a)."
-        )
+    # Megakernel lowering ----------------------------------------------
+    def _mega_moe_impl(self):
+        """The megakernel graph's ``moe`` task lowers to the EP decode
+        path: router → a2a dispatch → grouped expert GEMM → combine, with
+        the route AUTO-resolved at trace time (LL a2a at decode token
+        counts; identity a2a at world=1). Same code the op-by-op
+        ``dist_ar`` backend runs, so mega decode stays byte-identical —
+        the expert slabs ride through ``split_layer_params`` unchanged
+        (leading-L stacked, engine shards them P(None, "tp", ...))."""
 
-    def split_layer_params(self) -> list[dict]:
-        # The mega build pre-splits params BEFORE tracing anything, so
-        # raising here rejects backend="mega" at Engine construction
-        # instead of at the first (lazy) decode trace.
-        return self.decode_shard_mega()
+        def ep_moe(lp, x):
+            return self._ep_mlp(lp, x, "dist_ar")
+
+        return ep_moe

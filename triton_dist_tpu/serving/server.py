@@ -152,8 +152,14 @@ class InferenceServer:
         )
         assert self.num_slots >= 1 and self.chunk >= 1
         #: The backend the operator asked for — the restore target whenever
-        #: a breaker closes while the engine is running degraded.
-        self._preferred_backend = engine.backend
+        #: a breaker closes while the engine is running degraded. Read off
+        #: the engine's own construction-time record, NOT engine.backend:
+        #: an engine that already degraded (or was probed) before the
+        #: server wrapped it would otherwise bake the fallback in as the
+        #: "preferred" target and the probe could never restore mega.
+        self._preferred_backend = getattr(
+            engine, "preferred_backend", engine.backend
+        )
         #: Paged-KV serving (block pool + prefix reuse + chunked prefill).
         #: Default ON; TDT_SERVING_PAGED=0 restores the slot-row cache.
         self.paged = get_int_env("TDT_SERVING_PAGED", 1) != 0

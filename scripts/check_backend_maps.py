@@ -2,7 +2,8 @@
 """Lint: the engine's backend → mode maps stay mutually consistent.
 
 ``models/engine.py`` routes each compiled program family through a literal
-dict keyed by backend (``PREFILL_MODE`` / ``DECODE_MODE`` / ``CHUNK_MODE``).
+dict keyed by backend (``PREFILL_MODE`` / ``DECODE_MODE`` / ``CHUNK_MODE``
+/ ``VERIFY_MODE``).
 Drift between those maps and ``_BACKENDS`` is exactly how the silent
 ``mega`` → ``dist_ar`` decode demotion happened: a new backend (or a new
 map) added in one place resolves everywhere EXCEPT the map someone forgot,
@@ -18,7 +19,10 @@ jax):
   ``dist_ar`` / ``mega``);
 * ``DECODE_MODE["mega"] == "mega"`` — the decode path is the one place the
   megakernel MUST NOT be demoted (prefill/chunk demotion is deliberate:
-  those program families have no mega lowering).
+  those program families have no mega lowering);
+* ``VERIFY_MODE["mega"] == "mega"`` — same contract for the speculative
+  k-wide verify step: turning spec on must not silently trade the fused
+  persistent-step program for per-token decode.
 
 Usage: ``python scripts/check_backend_maps.py [engine.py path]``.
 Exit 1 with diagnostics on violations.
@@ -33,7 +37,7 @@ import sys
 REPO = pathlib.Path(__file__).resolve().parent.parent
 DEFAULT_TARGET = REPO / "triton_dist_tpu" / "models" / "engine.py"
 
-MAPS = ("PREFILL_MODE", "DECODE_MODE", "CHUNK_MODE")
+MAPS = ("PREFILL_MODE", "DECODE_MODE", "CHUNK_MODE", "VERIFY_MODE")
 ALLOWED_MODES = {"xla", "dist", "dist_ar", "mega"}
 
 
@@ -86,6 +90,14 @@ def check(path: pathlib.Path) -> list[str]:
             f"{path}:{lines.get('DECODE_MODE', 0)}: DECODE_MODE must route "
             f"'mega' to 'mega' (got {dm.get('mega')!r}) — demoting the decode "
             "path silently discards the megakernel"
+        )
+    vm = found.get("VERIFY_MODE")
+    if isinstance(vm, dict) and vm.get("mega") != "mega":
+        errors.append(
+            f"{path}:{lines.get('VERIFY_MODE', 0)}: VERIFY_MODE must route "
+            f"'mega' to 'mega' (got {vm.get('mega')!r}) — the k-wide "
+            "speculative verify step must not silently demote the megakernel "
+            "to per-token decode"
         )
     return errors
 

@@ -140,6 +140,12 @@ REQUIRED_NAMES = {
     "tdt_mega_fusion_hits_total",
     "tdt_mega_steps_per_launch",
     "tdt_mega_ready_depth",
+    # speculative decoding: drafter proposals vs k-wide verify acceptance
+    # (serving/server.py, models/engine.py) — see docs/speculative.md
+    "tdt_spec_proposed_total",
+    "tdt_spec_accepted_total",
+    "tdt_spec_accept_len",
+    "tdt_spec_k",
     # span names
     "tdt_serving_probe",
     "tdt_serving_restore",

@@ -645,7 +645,8 @@ def test_backend_maps_lint_repo_is_clean():
 
 def test_backend_maps_lint_flags_drift(tmp_path):
     """A map missing a backend, a stale extra entry, a non-literal map, and
-    a demoted DECODE_MODE['mega'] are each flagged with diagnostics."""
+    a demoted DECODE_MODE['mega'] / VERIFY_MODE['mega'] are each flagged
+    with diagnostics."""
     import subprocess
     import sys
 
@@ -663,6 +664,7 @@ def test_backend_maps_lint_flags_drift(tmp_path):
         'PREFILL_MODE = {"xla": "xla", "dist": "dist", "dist_ar": "dist_ar", "mega": "dist_ar"}\n'
         'DECODE_MODE = {"xla": "xla", "dist": "dist", "dist_ar": "dist_ar", "mega": "mega"}\n'
         'CHUNK_MODE = {"xla": "xla", "dist": "dist", "dist_ar": "dist_ar", "mega": "dist_ar"}\n'
+        'VERIFY_MODE = {"xla": "xla", "dist": "dist", "dist_ar": "dist_ar", "mega": "mega"}\n'
     )
     r = run(base + ok_maps)
     assert r.returncode == 0, r.stdout + r.stderr
@@ -678,10 +680,19 @@ def test_backend_maps_lint_flags_drift(tmp_path):
     assert r.returncode == 1
     assert "CHUNK_MODE has unknown backend" in r.stdout
 
-    # The one hard routing invariant: decode must not demote mega.
-    r = run(base + ok_maps.replace('"mega": "mega"', '"mega": "dist_ar"'))
+    # The hard routing invariants: neither decode nor the speculative
+    # verify step may demote mega off the fused path.
+    r = run(base + ok_maps.replace('"mega": "mega"', '"mega": "dist_ar"', 1))
     assert r.returncode == 1
     assert "DECODE_MODE must route 'mega' to 'mega'" in r.stdout
+
+    r = run(base + ok_maps.replace(
+        'VERIFY_MODE = {"xla": "xla", "dist": "dist", "dist_ar": "dist_ar", '
+        '"mega": "mega"}',
+        'VERIFY_MODE = {"xla": "xla", "dist": "dist", "dist_ar": "dist_ar", '
+        '"mega": "dist_ar"}'))
+    assert r.returncode == 1
+    assert "VERIFY_MODE must route 'mega' to 'mega'" in r.stdout
 
     # Non-literal maps defeat static linting and are rejected outright.
     r = run(base + ok_maps.replace(

@@ -354,6 +354,39 @@ class ModelBuilder:
         step_fn.plan = tuple(self.plan)
         return step_fn
 
+    def build_verify_fn(self, num_layers: int, k: int):
+        """Speculative k-wide verify program: the persistent step graph of
+        ``build_step_fn`` replayed ``k`` times inside ONE launch. Sub-step
+        ``j`` scores column ``j`` of each slot's draft window at position
+        ``lengths + min(j, steps)`` — ``steps`` (B,) is the per-slot
+        participating width, flowing as DATA (like the paged path's masks
+        and tables), so one compiled program covers every acceptance
+        pattern, batch composition and adaptive-k backoff state; the jit
+        cache above is keyed on ``k`` alone. In paged mode each sub-step's
+        active mask is ``j < steps``: a non-participating slot's cache
+        write redirects to the NULL block and its attention bound stays at
+        its frozen length, exactly the non-speculative inactive-slot
+        contract. Returns ``verify_fn(layers, xs (B, k, d), ks, vs,
+        lengths, steps, tables=None) -> (x2 (B, k, d), ks, vs)``."""
+        step_fn = self.build_step_fn(num_layers)
+        paged = self.paged
+
+        def verify_fn(layers, xs, ks, vs, lengths, steps, tables=None):
+            outs = []
+            for j in range(k):
+                pos = lengths + jnp.minimum(jnp.int32(j), steps)
+                if paged:
+                    act = j < steps
+                    x, ks, vs = step_fn(layers, xs[:, j], ks, vs, pos,
+                                        active=act, tables=tables)
+                else:
+                    x, ks, vs = step_fn(layers, xs[:, j], ks, vs, pos)
+                outs.append(x)
+            return jnp.stack(outs, axis=1), ks, vs
+
+        verify_fn.plan = step_fn.plan
+        return verify_fn
+
     # ------------------------------------------------------ group lowering
     def _lower_group(self, gname: str, group, *, hq: int, hkv: int, hd: int,
                      li: int | None = None):

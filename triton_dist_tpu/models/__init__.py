@@ -5,10 +5,16 @@ loading HF checkpoints into the TP layout).
 """
 
 from triton_dist_tpu.models.config import ModelConfig, PRESETS
-from triton_dist_tpu.models.kv_cache import KVCache
+from triton_dist_tpu.models.kv_cache import KVCache, PagedKVCache
 from triton_dist_tpu.models.dense import DenseLLM, Qwen3MoE, DenseParams, init_params
 from triton_dist_tpu.models.moe import EPMoELLM, ep_specs
 from triton_dist_tpu.models.engine import Engine
+from triton_dist_tpu.models.drafter import (
+    Drafter,
+    GDNDrafter,
+    ScriptedDrafter,
+    TruncatedDrafter,
+)
 from triton_dist_tpu.models.weights import AutoLLM, load_hf_weights
 from triton_dist_tpu.models import checkpoint
 
@@ -16,6 +22,7 @@ __all__ = [
     "ModelConfig",
     "PRESETS",
     "KVCache",
+    "PagedKVCache",
     "DenseLLM",
     "Qwen3MoE",
     "EPMoELLM",
@@ -23,6 +30,10 @@ __all__ = [
     "DenseParams",
     "init_params",
     "Engine",
+    "Drafter",
+    "TruncatedDrafter",
+    "GDNDrafter",
+    "ScriptedDrafter",
     "AutoLLM",
     "checkpoint",
     "load_hf_weights",

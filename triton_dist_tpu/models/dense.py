@@ -368,6 +368,65 @@ class DenseLLM:
         logits = jnp.dot(x, p.lm_head, preferred_element_type=jnp.float32)
         return logits, ks, vs
 
+    # -- speculative k-wide verify -----------------------------------------
+
+    def verify_shard(self, p: DenseParams, tokens, ks, vs, lengths, steps, mode: str):
+        """k-wide greedy verify inside shard_map: score every slot's draft
+        window ``tokens`` (B, k) in one launch by sequencing k sub-steps of
+        the EXACT ``decode_shard`` program — sub-step j runs at position
+        ``lengths + min(j, steps)`` so every accepted token's logits are
+        bitwise what plain decode would have produced. ``steps`` (B,) is
+        the per-slot participating width (0 for inactive slots: they re-run
+        at their frozen position, same as non-speculative decode). Returns
+        (logits (B, k, V_local), ks, vs) — draft KV rows past the accepted
+        prefix stay in the cache as garbage beyond the rewound length,
+        overwritten by the next round before anything attends to them."""
+        k = tokens.shape[1]
+        outs = []
+        for j in range(k):
+            pos = lengths + jnp.minimum(jnp.int32(j), steps)
+            logits, ks, vs = self.decode_shard(p, tokens[:, j], ks, vs, pos, mode)
+            outs.append(logits)
+        return jnp.stack(outs, axis=1), ks, vs
+
+    def verify_shard_mega(self, p: DenseParams, mega_layers: list, tokens,
+                          ks, vs, lengths, steps):
+        """Megakernel k-wide verify: the persistent step graph replayed k
+        times inside ONE launch (``build_verify_fn``), plus a single fused
+        norm+head over all B·k scored positions."""
+        c = self.config
+        k = tokens.shape[1]
+        vfn = self._mega_builder().build_verify_fn(c.num_layers, k)
+        xs = p.embed[tokens]  # (B, k, d)
+        x2, ks, vs = vfn(mega_layers, xs, ks, vs, lengths, steps)
+        from triton_dist_tpu.megakernel.kernels import fused_norm_head
+
+        b = x2.shape[0]
+        logits = fused_norm_head(
+            x2.reshape(b * k, -1), p.final_norm, p.lm_head, eps=c.rms_eps
+        )
+        return logits.reshape(b, k, -1), ks, vs
+
+    def verify_shard_mega_paged(self, p: DenseParams, mega_layers: list, tokens,
+                                pk, pv, tables, lengths, steps):
+        """Paged megakernel k-wide verify: same replayed step graph over the
+        block pools — per-sub-step masks derive from ``steps`` as data, so
+        one compiled program serves every acceptance pattern and batch
+        composition (jit cache keyed on k alone). Non-participating
+        sub-steps write to the NULL block."""
+        c = self.config
+        k = tokens.shape[1]
+        vfn = self._mega_builder(paged=True).build_verify_fn(c.num_layers, k)
+        xs = p.embed[tokens]
+        x2, pk, pv = vfn(mega_layers, xs, pk, pv, lengths, steps, tables=tables)
+        from triton_dist_tpu.megakernel.kernels import fused_norm_head
+
+        b = x2.shape[0]
+        logits = fused_norm_head(
+            x2.reshape(b * k, -1), p.final_norm, p.lm_head, eps=c.rms_eps
+        )
+        return logits.reshape(b, k, -1), pk, pv
+
 
 class Qwen3MoE(DenseLLM):
     """Reference ``Qwen3MoE`` (``models/qwen_moe.py:108``): same skeleton,

@@ -1,0 +1,458 @@
+"""Speculative-decode drafters: cheap proposal models for the k-wide verify.
+
+A drafter proposes ``k`` tokens per active slot per spec round; the target
+model then scores the whole window in ONE wide verify launch
+(``Engine.spec_decode_steps*``) and keeps the longest greedy-matching prefix.
+The drafter only influences *which* tokens get proposed — every emitted token
+is the target's own argmax — so drafter numerics affect acceptance rate,
+never output correctness.
+
+Contract (everything below is pure jax, traceable inside the engine's jitted
+spec program — no collectives, no host state mutation):
+
+* ``params``      — pytree of arrays, passed through the spec jit each call.
+* ``init_state(num_slots)`` — fresh functional state (the drafter's own KV /
+  recurrent state for every slot).
+* ``propose(params, token, state, active, k)`` — (B,) last committed tokens
+  → ``(drafts (B, k) int32, pending)``. ``pending`` is consumed by
+  ``commit`` in the same trace; it carries whatever the drafter needs to
+  roll its state forward by exactly the accepted prefix.
+* ``commit(params, state, pending, accepted)`` — per-slot accepted counts
+  (B,) → new state. A slot with ``accepted == 0`` must come back unchanged:
+  rejection is a rewind, the pool never keeps speculative rows.
+* ``prefill_state(state, slot, ids)`` — host-level (called once per join /
+  recovery re-prefill): seed the slot's drafter state with the full token
+  history ``ids = prompt + generated[:-1]``; the pending last token is
+  consumed by the first ``propose``.
+
+``TruncatedDrafter`` reuses the target's first L layers (sliced off the
+stacked ``DenseParams`` pytree, the ``split_layer_params`` layout) and keeps
+its own small paged KV pool with fixed per-slot block chains — draft rows
+land in the pool only on ``commit``, and only the accepted prefix does.
+``GDNDrafter`` is a Gated DeltaNet stub (arXiv:2412.06464) wired to
+``kernels/gdn.py``: constant-size recurrent state, no KV at all.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from triton_dist_tpu.layers.tp import RMSNorm, apply_rope
+from triton_dist_tpu.models.dense import DenseParams
+
+
+class Drafter:
+    """Base contract; see module docstring. Subclasses are duck-typed by the
+    engine — only the five methods below (plus ``params``/``name``) are used."""
+
+    name = "drafter"
+    params = None
+
+    def init_state(self, num_slots: int):
+        raise NotImplementedError
+
+    def propose(self, params, token, state, active, k: int):
+        raise NotImplementedError
+
+    def commit(self, params, state, pending, accepted):
+        raise NotImplementedError
+
+    def prefill_state(self, state, slot: int, ids):
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# Truncated-target drafter
+# ---------------------------------------------------------------------------
+
+
+def truncate_params(p: DenseParams, num_layers: int) -> DenseParams:
+    """First-L slice of a stacked ``DenseParams`` pytree (the
+    ``split_layer_params`` layer layout, kept stacked). Embedding, final
+    norm and lm_head are shared with the target — the drafter predicts in
+    the target's own vocabulary."""
+    L = num_layers
+    return DenseParams(
+        embed=p.embed,
+        ln1=p.ln1[:L],
+        wqkv=p.wqkv[:L],
+        wo=p.wo[:L],
+        q_norm=p.q_norm[:L],
+        k_norm=p.k_norm[:L],
+        ln2=p.ln2[:L],
+        mlp_gate=p.mlp_gate[:L],
+        mlp_up=p.mlp_up[:L],
+        mlp_down=p.mlp_down[:L],
+        router=None if p.router is None else p.router[:L],
+        final_norm=p.final_norm,
+        lm_head=p.lm_head,
+    )
+
+
+class TruncatedDrafter(Drafter):
+    """First-L layers of the target as the proposal model.
+
+    Runs replicated (plain jnp, full heads — no tp collectives) so it can be
+    traced anywhere in the engine's spec program. Keeps its own small paged
+    KV pool: block chains are fixed per slot at init (no allocator — the
+    drafter's pool is private, nothing shares it), ``propose`` gathers the
+    chains into a contiguous scratch and runs k plain decode steps there,
+    and ``commit`` scatters ONLY the accepted rows back — the pool never
+    holds a rejected draft's KV."""
+
+    name = "truncated"
+
+    def __init__(self, model, num_layers: int | None = None, *,
+                 max_len: int = 512, block_size: int = 16, top_k: int | None = None):
+        c = model.config
+        L = num_layers if num_layers is not None else max(1, c.num_layers // 2)
+        L = max(1, min(L, c.num_layers))
+        self.config = c
+        self.num_layers = L
+        self.max_len = int(max_len)
+        self.block_size = int(block_size)
+        self.max_blocks = -(-self.max_len // self.block_size)
+        self.top_k = top_k if top_k is not None else getattr(c, "top_k", 0)
+        self.params = truncate_params(model.params, L)
+
+    # -- state ------------------------------------------------------------
+    def init_state(self, num_slots: int):
+        c = self.config
+        dt = self.params.wqkv.dtype
+        mb, bs = self.max_blocks, self.block_size
+        nb = num_slots * mb + 1  # block 0 = null row for masked writes
+        pool = jnp.zeros((self.num_layers, nb, c.num_kv_heads, bs, c.head_dim), dt)
+        tables = 1 + jnp.arange(num_slots * mb, dtype=jnp.int32).reshape(num_slots, mb)
+        return {
+            "k": pool,
+            "v": jnp.copy(pool),
+            "tables": tables,
+            "lengths": jnp.zeros((num_slots,), jnp.int32),
+        }
+
+    # -- forward core (plain jnp, full heads, replicated weights) ---------
+    def _layer(self, dp: DenseParams, l: int, x, kc, vc, pos, bound):
+        """One decoder layer, single-token decode. x: (B, d); kc/vc:
+        (L, B, Hkv, S, D) scratch caches; pos: (B,) write positions;
+        bound: (B,) attention length bound (cols < bound attend)."""
+        c = self.config
+        hq, hkv, hd = c.num_q_heads, c.num_kv_heads, c.head_dim
+        b = x.shape[0]
+        h = RMSNorm(dp.ln1[l], eps=c.rms_eps)(x)
+        qkv = jnp.dot(h, dp.wqkv[l], preferred_element_type=jnp.float32).astype(x.dtype)
+        qkv = qkv.reshape(b, 1, hq + 2 * hkv, hd)
+        q = qkv[:, :, :hq]
+        kk = qkv[:, :, hq:hq + hkv]
+        vv = qkv[:, :, hq + hkv:]
+        q = RMSNorm(dp.q_norm[l], eps=c.rms_eps)(q)
+        kk = RMSNorm(dp.k_norm[l], eps=c.rms_eps)(kk)
+        q = q.transpose(0, 2, 1, 3)   # (B, Hq, 1, D)
+        kk = kk.transpose(0, 2, 1, 3)
+        vv = vv.transpose(0, 2, 1, 3)
+        q = apply_rope(q, pos[:, None], c.rope_theta)
+        kk = apply_rope(kk, pos[:, None], c.rope_theta)
+        b_ids = jnp.arange(b)
+        kl = kc[l].at[b_ids, :, pos].set(kk[:, :, 0])
+        vl = vc[l].at[b_ids, :, pos].set(vv[:, :, 0])
+        kc = kc.at[l].set(kl)
+        vc = vc.at[l].set(vl)
+        rep = hq // hkv
+        kr = jnp.repeat(kl, rep, axis=1)
+        vr = jnp.repeat(vl, rep, axis=1)
+        scores = jnp.einsum("bhqd,bhsd->bhqs", q, kr,
+                            preferred_element_type=jnp.float32)
+        scores = scores[:, :, 0, :] * (1.0 / jnp.sqrt(jnp.float32(hd)))
+        smax = kr.shape[2]
+        mask = jnp.arange(smax)[None, None, :] < bound[:, None, None]
+        scores = jnp.where(mask, scores, -jnp.inf)
+        probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+        o = jnp.einsum("bhs,bhsd->bhd", probs, vr).reshape(b, hq * hd)
+        x = x + jnp.dot(o, dp.wo[l], preferred_element_type=jnp.float32).astype(x.dtype)
+        h = RMSNorm(dp.ln2[l], eps=c.rms_eps)(x)
+        x = x + self._mlp(dp, l, h)
+        return x, kc, vc
+
+    def _mlp(self, dp: DenseParams, l: int, h):
+        c = self.config
+        if dp.router is None:
+            g = jnp.dot(h, dp.mlp_gate[l], preferred_element_type=jnp.float32)
+            u = jnp.dot(h, dp.mlp_up[l], preferred_element_type=jnp.float32)
+            hs = (jax.nn.silu(g) * u).astype(h.dtype)
+            return jnp.dot(hs, dp.mlp_down[l], preferred_element_type=jnp.float32).astype(h.dtype)
+        # MoE: softmax-topk routing with a dense all-experts combine — no
+        # capacity limit (the drafter trades FLOPs for simplicity; with
+        # ample capacity this matches the target's routing exactly).
+        e = dp.router.shape[-1]
+        logits = jnp.dot(h, dp.router[l], preferred_element_type=jnp.float32)
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        w, idx = jax.lax.top_k(probs, self.top_k)
+        w = w / jnp.maximum(w.sum(-1, keepdims=True), 1e-20)
+        gate_full = jnp.sum(
+            jax.nn.one_hot(idx, e, dtype=jnp.float32) * w[..., None], axis=-2
+        )  # (T, E)
+        g = jnp.einsum("td,edf->tef", h, dp.mlp_gate[l],
+                       preferred_element_type=jnp.float32)
+        u = jnp.einsum("td,edf->tef", h, dp.mlp_up[l],
+                       preferred_element_type=jnp.float32)
+        hs = (jax.nn.silu(g) * u).astype(h.dtype)
+        y = jnp.einsum("tef,efd->ted", hs, dp.mlp_down[l],
+                       preferred_element_type=jnp.float32)
+        return jnp.einsum("te,ted->td", gate_full, y).astype(h.dtype)
+
+    def _step(self, dp: DenseParams, token, kc, vc, pos):
+        """One decode step over all truncated layers. Returns (logits fp32,
+        kc, vc)."""
+        c = self.config
+        x = dp.embed[token]
+        for l in range(self.num_layers):
+            x, kc, vc = self._layer(dp, l, x, kc, vc, pos, pos + 1)
+        x = RMSNorm(dp.final_norm, eps=c.rms_eps)(x)
+        logits = jnp.dot(x, dp.lm_head, preferred_element_type=jnp.float32)
+        return logits, kc, vc
+
+    # -- pool <-> scratch movement ---------------------------------------
+    def _gather(self, state):
+        tables = state["tables"]
+        kc = jnp.take(state["k"], tables, axis=1)  # (L, B, mb, H, bs, D)
+        vc = jnp.take(state["v"], tables, axis=1)
+        L, b, mb, hh, bs, d = kc.shape
+        kc = kc.transpose(0, 1, 3, 2, 4, 5).reshape(L, b, hh, mb * bs, d)
+        vc = vc.transpose(0, 1, 3, 2, 4, 5).reshape(L, b, hh, mb * bs, d)
+        return kc, vc
+
+    def _scatter_rows(self, state, kc, vc, base, count, max_rows: int):
+        """Write rows ``base + r`` (r < count per slot) from the contiguous
+        scratch back into the paged pool; rows past ``count`` redirect to
+        the null block — rejected drafts never reach the pool."""
+        pk, pv, tables = state["k"], state["v"], state["tables"]
+        bs = self.block_size
+        smax = kc.shape[3]
+        b_ids = jnp.arange(tables.shape[0])
+        for r in range(max_rows):
+            pos = jnp.minimum(base + r, smax - 1)
+            blk = jnp.take_along_axis(tables, (pos // bs)[:, None], axis=1)[:, 0]
+            phys = jnp.where(r < count, blk, 0)
+            sub = pos % bs
+            pk = pk.at[:, phys, :, sub, :].set(kc[:, b_ids, :, pos])
+            pv = pv.at[:, phys, :, sub, :].set(vc[:, b_ids, :, pos])
+        return dict(state, k=pk, v=pv)
+
+    # -- contract ---------------------------------------------------------
+    def propose(self, params, token, state, active, k: int):
+        kc, vc = self._gather(state)
+        base = state["lengths"]
+        step = active.astype(jnp.int32)
+        drafts = []
+        t = token
+        for j in range(k):
+            pos = base + j * step
+            logits, kc, vc = self._step(params, t, kc, vc, pos)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            t = jnp.where(active, nxt, token)
+            drafts.append(t)
+        pending = {"kc": kc, "vc": vc, "base": base, "k": k}
+        return jnp.stack(drafts, axis=1), pending
+
+    def commit(self, params, state, pending, accepted):
+        """Roll the pool forward by exactly the accepted prefix."""
+        new = self._scatter_rows(state, pending["kc"], pending["vc"],
+                                 pending["base"], accepted, pending["k"])
+        new["lengths"] = pending["base"] + accepted
+        return new
+
+    def prefill_state(self, state, slot: int, ids):
+        n = len(ids)
+        if n == 0:
+            return dict(state, lengths=state["lengths"].at[slot].set(0))
+        krows, vrows = self._prefill_kv(self.params, jnp.asarray([list(ids)], jnp.int32))
+        bs, mb = self.block_size, self.max_blocks
+        pad = (-n) % bs
+        krows = jnp.pad(krows, ((0, 0), (0, 0), (0, 0), (0, pad), (0, 0)))
+        vrows = jnp.pad(vrows, ((0, 0), (0, 0), (0, 0), (0, pad), (0, 0)))
+        L, hh, npad, d = krows[:, 0].shape
+        kb = krows[:, 0].reshape(L, hh, npad // bs, bs, d)
+        vb = vrows[:, 0].reshape(L, hh, npad // bs, bs, d)
+        pk, pv = state["k"], state["v"]
+        chain = [1 + slot * mb + j for j in range(mb)]
+        for j in range((n + bs - 1) // bs):
+            pk = pk.at[:, chain[j]].set(kb[:, :, j])
+            pv = pv.at[:, chain[j]].set(vb[:, :, j])
+        return dict(state, k=pk, v=pv,
+                    lengths=state["lengths"].at[slot].set(n))
+
+    @partial(jax.jit, static_argnums=(0,))
+    def _prefill_kv(self, dp: DenseParams, ids):
+        """Full causal forward over the prompt, returning per-layer K/V rows
+        (L, 1, Hkv, n, D). Logits are discarded — prefill only seeds state."""
+        c = self.config
+        hq, hkv, hd = c.num_q_heads, c.num_kv_heads, c.head_dim
+        b, n = ids.shape
+        x = dp.embed[ids].reshape(b * n, -1)
+        pos = jnp.arange(n, dtype=jnp.int32)[None, :]
+        ks, vs = [], []
+        for l in range(self.num_layers):
+            h = RMSNorm(dp.ln1[l], eps=c.rms_eps)(x)
+            qkv = jnp.dot(h, dp.wqkv[l], preferred_element_type=jnp.float32).astype(x.dtype)
+            qkv = qkv.reshape(b, n, hq + 2 * hkv, hd)
+            q = qkv[:, :, :hq]
+            kk = qkv[:, :, hq:hq + hkv]
+            vv = qkv[:, :, hq + hkv:]
+            q = RMSNorm(dp.q_norm[l], eps=c.rms_eps)(q)
+            kk = RMSNorm(dp.k_norm[l], eps=c.rms_eps)(kk)
+            q = apply_rope(q.transpose(0, 2, 1, 3), pos, c.rope_theta)
+            kk = apply_rope(kk.transpose(0, 2, 1, 3), pos, c.rope_theta)
+            vv = vv.transpose(0, 2, 1, 3)
+            rep = hq // hkv
+            kr = jnp.repeat(kk, rep, axis=1)
+            vr = jnp.repeat(vv, rep, axis=1)
+            scores = jnp.einsum("bhqd,bhsd->bhqs", q, kr,
+                                preferred_element_type=jnp.float32)
+            scores = scores * (1.0 / jnp.sqrt(jnp.float32(hd)))
+            causal = jnp.arange(n)[:, None] >= jnp.arange(n)[None, :]
+            scores = jnp.where(causal[None, None], scores, -jnp.inf)
+            probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+            o = jnp.einsum("bhqs,bhsd->bhqd", probs, vr)
+            o = o.transpose(0, 2, 1, 3).reshape(b * n, hq * hd)
+            x = x + jnp.dot(o, dp.wo[l], preferred_element_type=jnp.float32).astype(x.dtype)
+            h = RMSNorm(dp.ln2[l], eps=c.rms_eps)(x)
+            x = x + self._mlp(dp, l, h)
+            ks.append(kk)
+            vs.append(vv)
+        return jnp.stack(ks), jnp.stack(vs)
+
+
+# ---------------------------------------------------------------------------
+# Gated DeltaNet drafter (stub)
+# ---------------------------------------------------------------------------
+
+
+class GDNDrafter(Drafter):
+    """Gated DeltaNet proposal stub wired to ``kernels/gdn.py``.
+
+    One linear-attention layer over a constant-size (H, dk, dv) recurrent
+    state per slot — no KV cache, no rollback machinery beyond selecting the
+    post-accept state out of the k per-step states ``propose`` stacks into
+    ``pending``. Weights are randomly initialized (this is the wiring stub
+    the GDN path grows from; acceptance is what it is until distilled)."""
+
+    name = "gdn"
+
+    def __init__(self, model, *, hidden: int = 64, num_heads: int = 2,
+                 head_k: int = 16, head_v: int = 16, key=None):
+        c = model.config
+        key = key if key is not None else jax.random.PRNGKey(0)
+        ks = jax.random.split(key, 7)
+        dm, H, dk, dv = hidden, num_heads, head_k, head_v
+        sc = 0.02
+        self.hidden, self.num_heads, self.head_k, self.head_v = dm, H, dk, dv
+        self.vocab = c.vocab_size
+        self.params = {
+            "embed": jax.random.normal(ks[0], (c.vocab_size, dm), jnp.float32) * sc,
+            "wq": jax.random.normal(ks[1], (dm, H * dk), jnp.float32) * sc,
+            "wk": jax.random.normal(ks[2], (dm, H * dk), jnp.float32) * sc,
+            "wv": jax.random.normal(ks[3], (dm, H * dv), jnp.float32) * sc,
+            "wg": jax.random.normal(ks[4], (dm, 2 * H), jnp.float32) * sc,
+            "wo": jax.random.normal(ks[5], (H * dv, dm), jnp.float32) * sc,
+            "head": jax.random.normal(ks[6], (dm, c.vocab_size), jnp.float32) * sc,
+        }
+
+    def init_state(self, num_slots: int):
+        H, dk, dv = self.num_heads, self.head_k, self.head_v
+        return {"S": jnp.zeros((num_slots, H, dk, dv), jnp.float32)}
+
+    def _project(self, params, tokens):
+        """tokens (B, T) -> per-head q/k/v/alpha/beta for gdn_fwd."""
+        H, dk, dv = self.num_heads, self.head_k, self.head_v
+        b, t = tokens.shape
+        x = params["embed"][tokens]  # (B, T, dm)
+        q = jnp.dot(x, params["wq"]).reshape(b, t, H, dk).transpose(0, 2, 1, 3)
+        k = jnp.dot(x, params["wk"]).reshape(b, t, H, dk).transpose(0, 2, 1, 3)
+        v = jnp.dot(x, params["wv"]).reshape(b, t, H, dv).transpose(0, 2, 1, 3)
+        gates = jax.nn.sigmoid(jnp.dot(x, params["wg"]))  # (B, T, 2H)
+        alpha = gates[..., :H].transpose(0, 2, 1)  # (B, H, T)
+        beta = gates[..., H:].transpose(0, 2, 1)
+        return x, q, k, v, alpha, beta
+
+    def _scan_step(self, params, token, state_s):
+        """One recurrent step for every slot: (B,) token -> (logits, S')."""
+        from triton_dist_tpu.kernels.gdn import gdn_fwd
+
+        x, q, k, v, alpha, beta = self._project(params, token[:, None])
+
+        def one(qb, kb, vb, ab, bb, sb):
+            return gdn_fwd(qb, kb, vb, ab, bb, state=sb, impl="scan")
+
+        o, s2 = jax.vmap(one)(q, k, v, alpha, beta, state_s)
+        y = jnp.dot(o[:, :, 0].reshape(token.shape[0], -1), params["wo"])
+        logits = jnp.dot(y, params["head"], preferred_element_type=jnp.float32)
+        return logits, s2
+
+    def propose(self, params, token, state, active, k: int):
+        s = state["S"]
+        states = [s]
+        drafts = []
+        t = token
+        for _ in range(k):
+            logits, s2 = self._scan_step(params, t, s)
+            s = jnp.where(active[:, None, None, None], s2, s)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            t = jnp.where(active, nxt, token)
+            drafts.append(t)
+            states.append(s)
+        pending = {"states": jnp.stack(states, axis=1)}  # (B, k+1, H, dk, dv)
+        return jnp.stack(drafts, axis=1), pending
+
+    def commit(self, params, state, pending, accepted):
+        st = pending["states"]  # (B, k+1, H, dk, dv)
+        idx = accepted[:, None, None, None, None].astype(jnp.int32)
+        sel = jnp.take_along_axis(st, idx, axis=1)[:, 0]
+        return {"S": sel}
+
+    def prefill_state(self, state, slot: int, ids):
+        from triton_dist_tpu.kernels.gdn import gdn_fwd
+
+        if len(ids) == 0:
+            H, dk, dv = self.num_heads, self.head_k, self.head_v
+            return {"S": state["S"].at[slot].set(jnp.zeros((H, dk, dv), jnp.float32))}
+        toks = jnp.asarray([list(ids)], jnp.int32)
+        _, q, k, v, alpha, beta = self._project(self.params, toks)
+        _, s = gdn_fwd(q[0], k[0], v[0], alpha[0], beta[0], impl="chunked")
+        return {"S": state["S"].at[slot].set(s)}
+
+
+# ---------------------------------------------------------------------------
+# Scripted drafter (tests)
+# ---------------------------------------------------------------------------
+
+
+class ScriptedDrafter(Drafter):
+    """Deterministic test drafter: round r proposes ``drafts[r]`` verbatim.
+
+    Lets tests force exact acceptance patterns (accept 0..k at every step
+    boundary) — pass the target's own greedy continuation for cells that
+    must accept and a poisoned token for cells that must reject."""
+
+    name = "scripted"
+
+    def __init__(self, drafts):
+        drafts = jnp.asarray(drafts, jnp.int32)  # (rounds, B, k)
+        self.params = {"drafts": drafts}
+
+    def init_state(self, num_slots: int):
+        return {"cursor": jnp.zeros((), jnp.int32)}
+
+    def propose(self, params, token, state, active, k: int):
+        table = params["drafts"]
+        r = jnp.minimum(state["cursor"], table.shape[0] - 1)
+        row = jax.lax.dynamic_index_in_dim(table, r, axis=0, keepdims=False)
+        return row[:, :k], {"cursor": state["cursor"]}
+
+    def commit(self, params, state, pending, accepted):
+        return {"cursor": pending["cursor"] + 1}
+
+    def prefill_state(self, state, slot: int, ids):
+        return state

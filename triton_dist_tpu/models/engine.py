@@ -43,6 +43,12 @@ _BACKENDS = ("xla", "dist", "dist_ar", "mega")
 PREFILL_MODE = {"xla": "xla", "dist": "dist", "dist_ar": "dist_ar", "mega": "dist_ar"}
 DECODE_MODE = {"xla": "xla", "dist": "dist_ar", "dist_ar": "dist_ar", "mega": "mega"}
 CHUNK_MODE = {"xla": "xla", "dist": "dist_ar", "dist_ar": "dist_ar", "mega": "dist_ar"}
+# Speculative k-wide verify: MUST track DECODE_MODE exactly — the verify
+# program is k sequenced sub-steps of the decode program, and byte-identity
+# of spec vs non-spec greedy decode depends on the two resolving to the same
+# per-layer mode. In particular mega stays mega: demoting the verify path to
+# per-token decode would silently discard the megakernel while spec is on.
+VERIFY_MODE = {"xla": "xla", "dist": "dist_ar", "dist_ar": "dist_ar", "mega": "mega"}
 
 
 def sample_token(
@@ -87,6 +93,7 @@ class Engine:
         # always knows the restore target even after mega → xla → probe
         # round-trips (self.backend tracks what is currently built).
         self.preferred_backend = backend
+        self._drafter = None
         self._build(backend)
 
     def rebuild(self, backend: str) -> None:
@@ -232,6 +239,38 @@ class Engine:
                 out_specs=(tok_spec, pool_spec, pool_spec),
                 check_vma=False,
             )
+
+            # Speculative k-wide verify: the persistent step graph replayed
+            # k times inside ONE shard_map launch (build_verify_fn) — the
+            # per-slot participating width rides as data, so the jit cache
+            # above keys on (chunk, k) alone.
+            def verify_fn(params, mega, tokens, ks, vs, lengths, steps):
+                logits, ks, vs = model.verify_shard_mega(
+                    params, mega, tokens, ks, vs, lengths, steps
+                )
+                return jax.lax.all_gather(logits, axis, axis=2, tiled=True), ks, vs
+
+            self._verify_shard = jax.shard_map(
+                verify_fn, mesh=mesh,
+                in_specs=(p_specs, mega_specs, tok_spec, kv_spec, kv_spec,
+                          len_spec, len_spec),
+                out_specs=(tok_spec, kv_spec, kv_spec),
+                check_vma=False,
+            )
+
+            def verify_paged_fn(params, mega, tokens, pk, pv, tables, lengths, steps):
+                logits, pk, pv = model.verify_shard_mega_paged(
+                    params, mega, tokens, pk, pv, tables, lengths, steps
+                )
+                return jax.lax.all_gather(logits, axis, axis=2, tiled=True), pk, pv
+
+            self._verify_shard_paged = jax.shard_map(
+                verify_paged_fn, mesh=mesh,
+                in_specs=(p_specs, mega_specs, tok_spec, pool_spec, pool_spec,
+                          P(dp), len_spec, len_spec),
+                out_specs=(tok_spec, pool_spec, pool_spec),
+                check_vma=False,
+            )
         else:
             self._decode_shard_paged = None
             def decode_fn(params, token, ks, vs, lengths):
@@ -248,6 +287,28 @@ class Engine:
             self._decode_shard = lambda p_, extra, t_, k_, v_, l_: sm(
                 p_, t_, k_, v_, l_
             )
+
+            # Speculative k-wide verify: k sequenced sub-steps of the exact
+            # decode program in one launch (DenseLLM.verify_shard) — byte
+            # identity with plain decode is structural, not numerical luck.
+            verify_mode = VERIFY_MODE[backend]
+
+            def verify_fn(params, tokens, ks, vs, lengths, steps):
+                logits, ks, vs = model.verify_shard(
+                    params, tokens, ks, vs, lengths, steps, verify_mode
+                )
+                return jax.lax.all_gather(logits, axis, axis=2, tiled=True), ks, vs
+
+            vsm = jax.shard_map(
+                verify_fn, mesh=mesh,
+                in_specs=(p_specs, tok_spec, kv_spec, kv_spec, len_spec, len_spec),
+                out_specs=(tok_spec, kv_spec, kv_spec),
+                check_vma=False,
+            )
+            self._verify_shard = lambda p_, extra, t_, k_, v_, l_, s_: vsm(
+                p_, t_, k_, v_, l_, s_
+            )
+            self._verify_shard_paged = None
 
         # One compiled program per gen_len: the whole decode loop on device
         # (the XLA analog of replaying a captured CUDA graph gen_len times,
@@ -500,6 +561,35 @@ class Engine:
             out_shardings=(self._kv_sharding, self._kv_sharding),
         )
 
+        @partial(jax.jit, static_argnums=(7,), donate_argnums=(0, 1))
+        def paged_scatter_rows(pk, pv, kc, vc, tables, lengths0, nv, max_rows):
+            """Generalized ``paged_scatter_decode``: the per-slot valid row
+            count ``nv`` is DATA, not derived from the chunk's remaining —
+            the speculative path writes back exactly the accepted prefix
+            (``lengths' - lengths0``), so rejected draft rows in the
+            contiguous bounce buffer never reach the pool. Masked rows
+            redirect to the NULL block, as everywhere."""
+            bs = pk.shape[3]
+            b = tables.shape[0]
+            smax = kc.shape[3]
+            b_ids = jnp.arange(b)
+            for r in range(max_rows):
+                pos = jnp.minimum(lengths0 + r, smax - 1)
+                blk = jnp.take_along_axis(tables, (pos // bs)[:, None], axis=1)[:, 0]
+                phys = jnp.where(r < nv, blk, 0)
+                sub = pos % bs
+                pk = pk.at[:, phys, :, sub, :].set(kc[:, b_ids, :, pos])
+                pv = pv.at[:, phys, :, sub, :].set(vc[:, b_ids, :, pos])
+            return pk, pv
+
+        self._paged_scatter_rows = paged_scatter_rows
+
+        # A rebuild (degrade → xla, probe-restore → mega) must re-create the
+        # spec programs on the new backend so speculation stays armed across
+        # the whole recovery arc.
+        if getattr(self, "_drafter", None) is not None:
+            self._build_spec_programs()
+
     # ------------------------------------------------------------------ kv
     def _make_cache(self, ks: jax.Array, vs: jax.Array, seq: int) -> KVCache:
         """Pad prefill caches to max_len into a KVCache handle.
@@ -656,6 +746,179 @@ class Engine:
         return sample_token(
             logits, key, self.sample_method, self.temperature, self.top_p
         )
+
+    # ------------------------------------------------- speculative decoding
+    def attach_drafter(self, drafter) -> None:
+        """Attach a speculative drafter (``models/drafter.py`` contract) and
+        build the spec-decode programs. Greedy-only: the k-wide verify's
+        acceptance rule IS greedy argmax comparison — every emitted token is
+        the target's own argmax, which is what makes spec output
+        byte-identical to plain greedy decode. Survives ``rebuild()``:
+        ``_build_impl`` re-creates the spec programs for the new backend, so
+        a mega → degraded-xla → probe-restore arc keeps speculation armed
+        the whole way."""
+        assert self.sample_method == "greedy", "speculative decoding is greedy-only"
+        self._drafter = drafter
+        self._build_spec_programs()
+
+    def _build_spec_programs(self) -> None:
+        """Jitted speculative chunk programs. Static keys are (chunk, k)
+        ONLY — batch composition, acceptance patterns, and the per-slot
+        adaptive-k state (``kcap``) all flow as data, so nothing recompiles
+        while serving.
+
+        Per spec round: the drafter proposes k tokens from the last
+        committed token; the target scores the window [t_last, d_1..d_{k-1}]
+        with k sequenced sub-steps of the exact decode program in ONE
+        launch; the longest prefix where draft j equals the target's argmax
+        at j-1 is accepted, plus the bonus token (the target's argmax is
+        always correct), capped by the per-slot width. Emitted tokens are
+        the TARGET's argmaxes, never the drafts. Rejected draft KV rows sit
+        past the rewound length and are overwritten by the next round
+        before anything attends to them — rollback is a lengths rewind, not
+        a copy."""
+        drafter = self._drafter
+
+        def spec_round(r, carry, dparams, kcap, k, verify):
+            out, token, store, lengths, remaining, dstate, stats = carry
+            active = remaining > 0
+            cols = jnp.arange(k, dtype=jnp.int32)[None, :]
+            # Per-slot participating width: adaptive kcap, never past the
+            # request's remaining budget, zero for inactive slots.
+            ec = jnp.where(
+                active, jnp.clip(jnp.minimum(kcap, remaining), 1, k), 0
+            )
+            drafts, pending = drafter.propose(dparams, token, dstate, active, k)
+            win = jnp.concatenate([token[:, None], drafts[:, : k - 1]], axis=1)
+            logits, store = verify(win, store, lengths, ec)
+            g = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            match = (win[:, 1:] == g[:, :-1]).astype(jnp.int32)
+            m = jnp.sum(jnp.cumprod(match, axis=1), axis=1)
+            a = jnp.minimum(m + 1, ec)
+            emit = jnp.where(cols < a[:, None], g, jnp.int32(-1))
+            out = jax.lax.dynamic_update_slice(out, emit, (jnp.int32(0), r * k))
+            idx = jnp.maximum(a - 1, 0)[:, None]
+            nxt = jnp.take_along_axis(g, idx, axis=1)[:, 0]
+            token = jnp.where(a > 0, nxt, token)
+            dstate = drafter.commit(dparams, dstate, pending, a)
+            adv = a.astype(lengths.dtype)
+            stats = stats + jnp.stack(
+                [ec, a, (ec > 0).astype(jnp.int32)], axis=1
+            )
+            return (out, token, store, lengths + adv, remaining - adv, dstate, stats)
+
+        @partial(jax.jit, static_argnums=(9, 10), donate_argnums=(4, 5))
+        def spec_chunk(params, extra, dparams, token, ks, vs, lengths,
+                       remaining, kcap, chunk, k, dstate):
+            bsz = token.shape[0]
+            out0 = jnp.full((bsz, chunk * k), -1, jnp.int32)
+            stats0 = jnp.zeros((bsz, 3), jnp.int32)
+
+            def verify(win, store, lengths, ec):
+                ks, vs = store
+                logits, ks, vs = self._verify_shard(
+                    params, extra, win, ks, vs, lengths, ec
+                )
+                return logits, (ks, vs)
+
+            def body(r, carry):
+                return spec_round(r, carry, dparams, kcap, k, verify)
+
+            carry = (out0, token, (ks, vs), lengths, remaining, dstate, stats0)
+            out, token, (ks, vs), lengths, remaining, dstate, stats = (
+                jax.lax.fori_loop(0, chunk, body, carry)
+            )
+            return out, token, ks, vs, lengths, remaining, dstate, stats
+
+        self._spec_chunk = spec_chunk
+
+        if self._verify_shard_paged is not None:
+            @partial(jax.jit, static_argnums=(10, 11), donate_argnums=(4, 5))
+            def spec_chunk_paged(params, extra, dparams, token, pk, pv, tables,
+                                 lengths, remaining, kcap, chunk, k, dstate):
+                bsz = token.shape[0]
+                out0 = jnp.full((bsz, chunk * k), -1, jnp.int32)
+                stats0 = jnp.zeros((bsz, 3), jnp.int32)
+
+                def verify(win, store, lengths, ec):
+                    pk, pv = store
+                    logits, pk, pv = self._verify_shard_paged(
+                        params, extra, win, pk, pv, tables, lengths, ec
+                    )
+                    return logits, (pk, pv)
+
+                def body(r, carry):
+                    return spec_round(r, carry, dparams, kcap, k, verify)
+
+                carry = (out0, token, (pk, pv), lengths, remaining, dstate, stats0)
+                out, token, (pk, pv), lengths, remaining, dstate, stats = (
+                    jax.lax.fori_loop(0, chunk, body, carry)
+                )
+                return out, token, pk, pv, lengths, remaining, dstate, stats
+
+            self._spec_chunk_paged = spec_chunk_paged
+        else:
+            self._spec_chunk_paged = None
+
+    def spec_decode_steps(self, cache: KVCache, dstate, tokens: jax.Array,
+                          remaining: jax.Array, kcap: jax.Array, chunk: int,
+                          k: int, key: jax.Array | None = None):
+        """Speculative twin of ``decode_steps``: ``chunk`` spec rounds, each
+        accepting 1..k tokens per active slot. Returns ``(out (B, chunk·k)
+        int32 with -1 holes, last_tokens, cache', remaining', dstate',
+        stats (B, 3) [proposed, accepted, rounds])``. ``key`` is accepted
+        for call-site symmetry and unused — spec decode is greedy-only."""
+        del key
+        assert self._drafter is not None, "attach_drafter first"
+        out, tok, k2, v2, lengths, rem, dstate, stats = self._spec_chunk(
+            self.model.params, self._decode_extra, self._drafter.params,
+            tokens, cache.k, cache.v, cache.lengths, remaining, kcap,
+            int(chunk), int(k), dstate,
+        )
+        if self.backend == "mega":
+            telemetry.set_gauge(
+                "tdt_mega_steps_per_launch", float(chunk * k), path="spec"
+            )
+        return out, tok, KVCache(k=k2, v=v2, lengths=lengths), rem, dstate, stats
+
+    def spec_decode_steps_paged(self, paged: PagedKVCache, dstate,
+                                tokens: jax.Array, remaining: jax.Array,
+                                kcap: jax.Array, chunk: int, k: int,
+                                key: jax.Array | None = None):
+        """Speculative twin of ``decode_steps_paged``. Mega runs the spec
+        rounds directly against the block pool (tables + per-sub-step masks
+        as data); op-by-op backends bounce through the contiguous layout
+        and scatter back ONLY the accepted rows (``paged_scatter_rows`` with
+        the data-driven count ``lengths' - lengths0``) — the pool never
+        holds a rejected draft's KV."""
+        del key
+        assert self._drafter is not None, "attach_drafter first"
+        if self.backend == "mega":
+            out, tok, pk, pv, lengths, rem, dstate, stats = self._spec_chunk_paged(
+                self.model.params, self._decode_extra, self._drafter.params,
+                tokens, paged.k, paged.v, paged.tables, paged.lengths,
+                remaining, kcap, int(chunk), int(k), dstate,
+            )
+            telemetry.set_gauge(
+                "tdt_mega_steps_per_launch", float(chunk * k), path="spec_paged"
+            )
+            return out, tok, dataclasses.replace(
+                paged, k=pk, v=pv, lengths=lengths
+            ), rem, dstate, stats
+        kc, vc = self._paged_gather(paged.k, paged.v, paged.tables)
+        out, tok, k2, v2, lengths, rem, dstate, stats = self._spec_chunk(
+            self.model.params, self._decode_extra, self._drafter.params,
+            tokens, kc, vc, paged.lengths, remaining, kcap,
+            int(chunk), int(k), dstate,
+        )
+        nv = lengths - paged.lengths
+        pk, pv = self._paged_scatter_rows(
+            paged.k, paged.v, k2, v2, paged.tables, paged.lengths, nv,
+            int(chunk) * int(k),
+        )
+        return out, tok, dataclasses.replace(
+            paged, k=pk, v=pv, lengths=lengths
+        ), rem, dstate, stats
 
     def decode_steps(self, cache: KVCache, tokens: jax.Array, remaining: jax.Array,
                      chunk: int, key: jax.Array | None = None):

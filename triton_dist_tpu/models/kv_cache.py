@@ -197,3 +197,18 @@ jax.tree_util.register_dataclass(
     data_fields=["k", "v", "tables", "lengths"],
     meta_fields=["block_size"],
 )
+
+
+def draft_block_range(length: int, width: int, block_size: int) -> tuple[int, int]:
+    """Chain positions ``[lo, hi)`` a speculative draft window may touch.
+
+    A k-wide verify chunk writes draft KV rows at ``[length, length +
+    width)`` of a slot's logical sequence (``width = chunk * k`` bounds the
+    whole chunk; per-round clipping to ``remaining`` keeps actual writes
+    inside the reserved chain). The serving loop runs
+    ``BlockAllocator.ensure_exclusive`` over exactly these chain positions
+    before dispatch so rejected drafts can be rolled back by a pure length
+    rewind — no shared (prefix-donor) block is ever dirtied."""
+    lo = length // block_size
+    hi = -(-(length + width) // block_size)
+    return lo, hi

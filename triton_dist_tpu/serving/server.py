@@ -92,6 +92,14 @@ Env knobs::
                           slot can hold a full max_len chain, + 1)
     TDT_PREFILL_CHUNK     prefill rows per chunk dispatch (default max_len)
     TDT_PREFIX_REUSE      share block-aligned prompt-prefix KV (default 1)
+    TDT_SPEC_K            speculative draft width k (default 0 = off; >=2
+                          turns on speculative greedy decode — see
+                          docs/speculative.md)
+    TDT_SPEC_MIN_ACCEPT   adaptive-k backoff threshold on the per-slot
+                          acceptance-fraction EWMA (default 0.5)
+    TDT_SPEC_DRAFTER      drafter kind: truncated (default) | gdn
+    TDT_SPEC_DRAFT_LAYERS target layers the truncated drafter keeps
+                          (default: half the stack)
     TDT_DEADLINE_TTFT_S   default TTFT budget, s (<=0/unset = none)
     TDT_DEADLINE_TOTAL_S  default total budget, s (<=0/unset = none)
     TDT_SHED_WAIT_S       global projected-wait shed budget, s (0 = off)
@@ -142,7 +150,7 @@ class InferenceServer:
                  key: jax.Array | None = None, watchdog=None,
                  shed_wait_s: float | None = None,
                  shed_priority: int | None = None,
-                 journal=None):
+                 journal=None, spec_k: int | None = None, drafter=None):
         self.engine = engine
         self.num_slots = (
             get_int_env("TDT_SERVE_SLOTS", 4) if num_slots is None else int(num_slots)
@@ -189,6 +197,31 @@ class InferenceServer:
             shed_wait_s=shed_wait_s, shed_priority=shed_priority,
             kv_ledger=self.kv_ledger,
         )
+        #: Speculative decoding (TDT_SPEC_K >= 2 turns it on; 0/1 = off).
+        #: Greedy-only: the verify program replays the target's own decode
+        #: step per draft position, so acceptance == argmax agreement and
+        #: the stream is byte-identical to non-speculative greedy decode.
+        self.spec_k = (
+            get_int_env("TDT_SPEC_K", 0) if spec_k is None else int(spec_k)
+        )
+        self.spec_min_accept = get_float_env("TDT_SPEC_MIN_ACCEPT", 0.5)
+        self._drafter = drafter
+        self._dstate = None
+        self._kcap = np.zeros((self.num_slots,), np.int32)
+        self._accept_ewma = np.ones((self.num_slots,), np.float64)
+        if self.spec_k >= 2 and engine.sample_method != "greedy":
+            telemetry.emit(
+                "serving_spec_disabled", why="non-greedy sampling",
+                sample_method=engine.sample_method,
+            )
+            self.spec_k = 0
+        if self.spec_k >= 2:
+            if self._drafter is None:
+                self._drafter = self._build_drafter()
+            self.engine.attach_drafter(self._drafter)
+            self._dstate = self._drafter.init_state(self.num_slots)
+            self._kcap[:] = self.spec_k
+            telemetry.set_gauge("tdt_spec_k", float(self.spec_k))
         #: In-flight chunked prefills: slot idx -> cursor state (ids, row
         #: offset, context buffers, sampling key). One chunk per slot per
         #: step keeps decode within one chunk boundary of a long prompt.
@@ -246,6 +279,38 @@ class InferenceServer:
         introspect.set_health_provider(self._health_info)
         introspect.set_requests_provider(self._requests_info)
 
+    def _build_drafter(self):
+        """Construct the env-selected drafter (``TDT_SPEC_DRAFTER``):
+        ``truncated`` (default) runs the first ``TDT_SPEC_DRAFT_LAYERS``
+        layers of the target over its own small paged KV; ``gdn`` runs the
+        single-layer Gated-DeltaNet linear-attention stub."""
+        kind = os.environ.get("TDT_SPEC_DRAFTER", "truncated").strip().lower()
+        if kind == "gdn":
+            from triton_dist_tpu.models.drafter import GDNDrafter
+
+            return GDNDrafter(self.engine.model)
+        from triton_dist_tpu.models.drafter import TruncatedDrafter
+
+        layers = get_int_env("TDT_SPEC_DRAFT_LAYERS", 0)
+        return TruncatedDrafter(
+            self.engine.model,
+            num_layers=layers if layers >= 1 else None,
+            max_len=self.engine.max_len,
+            block_size=self.block_size if self.paged else 16,
+        )
+
+    def _spec_prefill(self, idx: int, ids) -> None:
+        """Re-seed the drafter for ``idx``'s tenant from the same token
+        history the target prefilled (fresh join, recovery, restore and
+        journal replay all come through here) and reset its adaptive-k
+        state. ``ids`` is the prefill history (``prompt + tokens[:-1]``);
+        the pending last streamed token is deliberately NOT in the drafter
+        KV — the next propose consumes it, exactly like the target."""
+        if self.spec_k >= 2:
+            self._dstate = self._drafter.prefill_state(self._dstate, idx, ids)
+            self._kcap[idx] = self.spec_k
+            self._accept_ewma[idx] = 1.0
+
     def _health_info(self) -> dict:
         shedding = self.scheduler.shedding(self._now())
         return {
@@ -293,9 +358,23 @@ class InferenceServer:
                         kv_len=int(self._lengths[slot.idx]),
                         prefilling=slot.idx in self._prefilling,
                     )
+                if self.spec_k >= 2:
+                    entry.update(
+                        spec_k=int(self._kcap[slot.idx]),
+                        spec_accept_ewma=round(
+                            float(self._accept_ewma[slot.idx]), 4
+                        ),
+                    )
             slots.append(entry)
         return {
             **({"kv": self.kv_ledger.stats()} if self.kv_ledger else {}),
+            **({"spec": {
+                "k": self.spec_k,
+                "min_accept": self.spec_min_accept,
+                "drafter": self._drafter.name,
+                "proposed": telemetry.counter_total("tdt_spec_proposed_total"),
+                "accepted": telemetry.counter_total("tdt_spec_accepted_total"),
+            }} if self.spec_k >= 2 else {}),
             **({"ep": self._ep_info()} if self._is_ep_model() else {}),
             "mesh_epoch": resilience.mesh_epoch(),
             "backend": self.engine.backend,
@@ -538,6 +617,10 @@ class InferenceServer:
         the shrunk effective pool can no longer hold (possible only with an
         overcommitted ``TDT_KV_BLOCKS``) is preempted back to the queue
         with its token history intact — the next join re-prefills it."""
+        if self.spec_k >= 2:
+            # Speculative state is never durable: a fresh cache always
+            # pairs with a drafter reset + per-slot re-prefill from history.
+            self._dstate = self._drafter.init_state(self.num_slots)
         if not self.paged:
             return self.engine.alloc_slots(self.num_slots)
         self._prefilling.clear()
@@ -647,6 +730,7 @@ class InferenceServer:
             token0, self.cache = self.engine.prefill_into_slot(
                 self.cache, slot.idx, jnp.asarray([ids], jnp.int32), key=sub
             )
+        self._spec_prefill(slot.idx, ids)
         if req.tokens:
             self._last[slot.idx] = req.tokens[-1]
             # Host decode state must derive from the durable history, not
@@ -762,6 +846,7 @@ class InferenceServer:
         self._push_tables()
         self._publish_kv_gauges()
         telemetry.observe("tdt_serving_prefill_chunks", float(st["n_chunks"]))
+        self._spec_prefill(slot.idx, st["ids"])
         if req.tokens:
             # Recovery re-prefill: mirror the slot-mode branch — the last
             # streamed token's KV is pending, nothing streams twice.
@@ -787,6 +872,9 @@ class InferenceServer:
 
     # ----------------------------------------------------------------- decode
     def _decode_once(self) -> None:
+        if self.spec_k >= 2:
+            self._spec_decode_once()
+            return
         resilience.chaos_check("decode")
         decoding = self.scheduler.decoding_slots()
         pre = {s.idx: int(self._remaining[s.idx]) for s in decoding}
@@ -854,6 +942,137 @@ class InferenceServer:
             telemetry.inc("tdt_serving_tokens_total", float(n_streamed))
             telemetry.observe("tdt_serving_chunk_token_seconds", wall / n_streamed)
             # Feed the admission-time overload projection.
+            self.scheduler.note_decode_rate(n_streamed, wall)
+
+    def _pin_draft_blocks(self, decoding) -> None:
+        """CoW-isolate every block the coming draft window may write.
+
+        The verify step writes draft KV at rows ``[length, length + ec)``
+        per round — always inside the tenant's reserved chain, past its
+        full prompt blocks, so structurally these blocks are already
+        exclusive (the prefix index never indexes them and
+        ``_complete_prefill`` pre-pins the decode tail). This sweep is the
+        speculative analog of that safety net: ``ensure_exclusive`` on the
+        whole draft window turns any future sharing-invariant slip into a
+        block copy instead of silently corrupting a prefix donor's KV. A
+        copy remaps the chain, so the device tables are re-pushed."""
+        from triton_dist_tpu.models.kv_cache import draft_block_range
+
+        copied_any = False
+        for slot in decoding:
+            req = slot.request
+            lo, hi = draft_block_range(
+                int(self._lengths[slot.idx]), self.chunk * self.spec_k,
+                self.block_size,
+            )
+            for j in range(lo, min(hi, len(req.kv_blocks))):
+                _, copied = self.kv_ledger.make_writable(req, j)
+                copied_any = copied_any or copied
+        if copied_any:
+            self._push_tables()
+            self._publish_kv_gauges()
+
+    def _spec_decode_once(self) -> None:
+        """One speculative decode chunk: the drafter proposes up to
+        ``kcap[slot]`` tokens per active slot per round, the target scores
+        every draft in ONE k-wide masked verify dispatch, and only the
+        greedy-agreeing prefix (plus the target's own next token) is
+        accepted — rejected rows are rolled back by rewinding the device
+        lengths, so the stream stays byte-identical to plain greedy
+        decode. Acceptance stats feed per-slot adaptive k backoff."""
+        resilience.chaos_check("decode")
+        decoding = self.scheduler.decoding_slots()
+        pre = {s.idx: int(self._remaining[s.idx]) for s in decoding}
+        if self.paged:
+            self._pin_draft_blocks(decoding)
+        self._key, sub = jax.random.split(self._key)
+        t0 = time.perf_counter()
+        d_start = tracing.now_s()
+        with self._trace.span(
+            "tdt_serving_dispatch", n_active=len(decoding), chunk=self.chunk,
+            spec_k=self.spec_k,
+        ) as dsp:
+            spec = (
+                self.engine.spec_decode_steps_paged if self.paged
+                else self.engine.spec_decode_steps
+            )
+            out, tok, cache, _, dstate, stats = self._watchdog.call(
+                spec, self.cache, self._dstate,
+                jnp.asarray(self._last), jnp.asarray(self._remaining),
+                jnp.asarray(self._kcap), self.chunk, self.spec_k, sub,
+            )
+        d_end = tracing.now_s()
+        dispatch_id = dsp["span_id"] if dsp is not None else None
+        self.cache = cache
+        self._dstate = dstate
+        out_np = np.asarray(out)
+        stats_np = np.asarray(stats)
+        self._last = np.asarray(tok, dtype=np.int32).copy()
+        wall = time.perf_counter() - t0
+        telemetry.inc("tdt_serving_decode_chunks_total")
+        n_streamed = 0
+        n_proposed = 0
+        n_accepted = 0
+        for slot in decoding:
+            req = slot.request
+            # The out row is (chunk * k) wide with -1 holes after each
+            # round's accepted prefix — compact to the accepted stream.
+            toks = [int(t) for t in out_np[slot.idx] if t >= 0]
+            n_valid = min(len(toks), pre[slot.idx])
+            toks = toks[:n_valid]
+            req.trace.record(
+                "tdt_serving_decode_chunk", d_start, d_end,
+                slot=slot.idx, n_tokens=n_valid, dispatch=dispatch_id,
+                spec_k=self.spec_k,
+            )
+            s_start = tracing.now_s()
+            for t in toks:
+                self._stream(req, t)
+            if n_valid:
+                req.trace.record(
+                    "tdt_serving_stream", s_start, tracing.now_s(),
+                    slot=slot.idx, n_tokens=n_valid,
+                )
+                if self._journal is not None:
+                    # Only ACCEPTED tokens ever reach the journal — replay
+                    # and migration never see speculative state.
+                    self._journal.append(
+                        "chunk", req_id=req.req_id,
+                        start=len(req.tokens) - n_valid, tokens=toks,
+                    )
+            self._remaining[slot.idx] -= n_valid
+            if self.paged:
+                self._lengths[slot.idx] += n_valid
+            n_streamed += n_valid
+            proposed, accepted, rounds = (int(x) for x in stats_np[slot.idx])
+            n_proposed += proposed
+            n_accepted += accepted
+            if rounds > 0:
+                telemetry.observe("tdt_spec_accept_len", accepted / rounds)
+            if proposed > 0:
+                # Adaptive k: EWMA of the per-chunk acceptance fraction;
+                # persistent rejection shrinks this slot's draft width to
+                # 1, recovery grows it back toward TDT_SPEC_K.
+                frac = accepted / proposed
+                ew = 0.5 * self._accept_ewma[slot.idx] + 0.5 * frac
+                self._accept_ewma[slot.idx] = ew
+                if ew < self.spec_min_accept:
+                    self._kcap[slot.idx] = max(int(self._kcap[slot.idx]) - 1, 1)
+                elif int(self._kcap[slot.idx]) < self.spec_k:
+                    self._kcap[slot.idx] += 1
+            telemetry.set_gauge(
+                "tdt_spec_k", float(self._kcap[slot.idx]), slot=str(slot.idx)
+            )
+        if n_proposed:
+            telemetry.inc("tdt_spec_proposed_total", float(n_proposed))
+        if n_accepted:
+            telemetry.inc("tdt_spec_accepted_total", float(n_accepted))
+        for slot in decoding:
+            if slot.request is not None and self._remaining[slot.idx] == 0:
+                self._finish(slot)
+        if n_streamed:
+            telemetry.inc("tdt_serving_tokens_total", float(n_streamed))
+            telemetry.observe("tdt_serving_chunk_token_seconds", wall / n_streamed)
             self.scheduler.note_decode_rate(n_streamed, wall)
 
     # -------------------------------------------------------------- streaming

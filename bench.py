@@ -1355,6 +1355,138 @@ def bench_serving_fleet_gray(on_tpu):
     return out
 
 
+def bench_serving_fleet_autoscale(on_tpu):
+    """Elastic-fleet benchmark (the autoscaler + per-tenant WFQ in
+    fleet/router.py): boots a 1-replica fleet with ``TDT_FLEET_SCALE_MAX=2``
+    and low thresholds, then drives a two-tenant burst — ``tier0``
+    (priority 0, WFQ weight 4 via ``TDT_TENANT_WEIGHTS``) vs ``tier1``
+    (priority 1, weight 1) — hot enough that the demand EWMA crosses the
+    scale-up bar and a second replica boots mid-burst. After the burst a
+    trickle keeps the fleet alive while demand decays below the scale-down
+    bar, so the drain -> journal-handoff -> retire state machine runs with
+    real streams in flight. Reported: per-tier goodput
+    (``serving_fleet_autoscale_tier0_tokens_per_s`` /
+    ``..._tier1_tokens_per_s``, both gated higher-better — tier0's WFQ
+    weight should keep its share ahead under contention), p99 TTFT pooled
+    across grow + shrink phases (``serving_fleet_autoscale_ttft_p99_ms``,
+    gated lower-better — the scale-down drain must not stall first
+    tokens), and the informational scale-event count. Zero rejects is the
+    correctness bar (``serve_all`` raises on anything left behind); the
+    chaos suite's ``fleet-scale-down-kill`` / ``fleet-tenant-burst`` rows
+    assert the byte-parity side of the same arcs."""
+    import math
+    import os
+    import shutil
+    import tempfile
+    import time
+
+    from triton_dist_tpu.fleet import Router
+    from triton_dist_tpu.runtime.utils import get_int_env
+
+    env = {
+        "JAX_PLATFORMS": "cpu",
+        "TDT_INTERPRET_FALLBACK": "1",
+        "TDT_SERVE_SLOTS": "2",
+        "TDT_SERVE_CHUNK": "2",
+    }
+    # Router-process knobs: aggressive thresholds + near-instant EWMA so
+    # the bench's small burst crosses both bars inside one section.
+    knobs = {
+        "TDT_FLEET_SCALE_MAX": "2",
+        "TDT_FLEET_SCALE_MIN": "1",
+        "TDT_FLEET_SCALE_UP_AT": "2.0",
+        "TDT_FLEET_SCALE_DOWN_AT": "0.9",
+        "TDT_FLEET_SCALE_COOLDOWN_S": "0.2",
+        "TDT_FLEET_SCALE_ALPHA": "0.9",
+        "TDT_TENANT_WEIGHTS": "tier0=4,tier1=1",
+    }
+    prev = {k: os.environ.get(k) for k in knobs}
+    os.environ.update(knobs)
+    block = get_int_env("TDT_KV_BLOCK_SIZE", 16)
+    pa = [(5 * j + 3) % 256 for j in range(block)]
+    pb = [(11 * j + 7) % 256 for j in range(block)]
+    # One prefix family per tier: the index is tenant-scoped now, so each
+    # tier warms (and may only hit) its own trie.
+    warm = [(pa + [1], 8, "tier0", 0), (pb + [2], 8, "tier1", 1)]
+    burst = []
+    for i in range(4):
+        burst.append((pa + [i + 3], 14, "tier0", 0))
+        burst.append((pb + [i + 3], 14, "tier1", 1))
+    out = {
+        "serving_fleet_autoscale_requests": len(burst) + 2,
+        "serving_fleet_autoscale_max_replicas": 2,
+    }
+    states = []
+
+    def timed_submit(router, p, g, tenant, prio):
+        st = {"sub": time.perf_counter()}
+        states.append(st)
+
+        def cb(fr, tok, i, _s=st):
+            if "ttft" not in _s:
+                _s["ttft"] = time.perf_counter() - _s["sub"]
+
+        return router.submit(p, g, priority=prio, on_token=cb,
+                             tenant=tenant)
+
+    workdir = tempfile.mkdtemp(prefix="tdt_bench_fleet_autoscale_")
+    try:
+        with Router(1, workdir, env=env) as router:
+            router.start()
+            for p, g, tenant, prio in warm:
+                router.submit(p, g, priority=prio, tenant=tenant)
+            router.serve_all(timeout_s=180)
+            # Grow phase: 8 queued requests over 1 live replica pushes the
+            # demand EWMA past up_at=2.0 on the first pump — the second
+            # replica boots while replica 0 chews the burst.
+            tier_toks = {"tier0": 0, "tier1": 0}
+            t0 = time.perf_counter()
+            frs = [(tenant, timed_submit(router, p, g, tenant, prio))
+                   for p, g, tenant, prio in burst]
+            router.serve_all(timeout_s=240)
+            wall = time.perf_counter() - t0
+            for tenant, fr in frs:
+                tier_toks[tenant] += len(fr.tokens)
+            out["serving_fleet_autoscale_tier0_tokens_per_s"] = round(
+                tier_toks["tier0"] / wall, 1)
+            out["serving_fleet_autoscale_tier1_tokens_per_s"] = round(
+                tier_toks["tier1"] / wall, 1)
+            # Shrink phase: a trickle keeps streams flowing while demand
+            # decays below down_at — the drain/migrate/retire machine runs
+            # against live traffic, and its TTFTs pool into the p99.
+            trickle = [timed_submit(router, pa + [99], 8, "tier0", 0),
+                       timed_submit(router, pb + [98], 8, "tier1", 1)]
+            deadline = time.monotonic() + 120.0
+            while time.monotonic() < deadline:
+                router.pump()
+                a = router.autoscale()
+                if (all(fr.done for fr in trickle)
+                        and not a["booting"]
+                        and len(a["live"]) <= 1
+                        and a["scale_down"] is None):
+                    break
+                time.sleep(0.01)
+            a = router.autoscale()
+            out["serving_fleet_autoscale_scale_events"] = len(a["events"])
+            out["serving_fleet_autoscale_live_after"] = len(a["live"])
+            out["serving_fleet_autoscale_requests_done"] = (
+                sum(1 for _t, fr in frs if fr.done)
+                + sum(1 for fr in trickle if fr.done))
+            ttfts = [s["ttft"] for s in states if "ttft" in s]
+            if ttfts:
+                rank = max(0, math.ceil(0.99 * len(ttfts)) - 1)
+                out["serving_fleet_autoscale_ttft_p99_ms"] = round(
+                    sorted(ttfts)[rank] * 1000.0, 1)
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return out
+
+
 def bench_moe_decode(on_tpu):
     """MoE decode benchmark (the EP subsystem, models/moe.py): serves the
     ``test-moe`` EP model through the full continuous-batching loop on the
@@ -2301,6 +2433,17 @@ def main():
         emit()
     else:
         extra["serving_fleet_gray_skipped"] = "budget"
+    if remaining() > 240:
+        # The autoscale arc boots a second replica mid-burst and then
+        # drains it — same big slice as the other multi-process sections.
+        phase("serving_fleet_autoscale")
+        try:
+            absorb(bench_serving_fleet_autoscale(on_tpu))
+        except Exception as e:  # noqa: BLE001
+            extra["serving_fleet_autoscale_error"] = f"{type(e).__name__}"
+        emit()
+    else:
+        extra["serving_fleet_autoscale_skipped"] = "budget"
     if remaining() > 45:
         phase("moe_decode")
         try:

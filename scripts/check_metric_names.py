@@ -146,6 +146,17 @@ REQUIRED_NAMES = {
     "tdt_spec_accepted_total",
     "tdt_spec_accept_len",
     "tdt_spec_k",
+    # elasticity: load-adaptive autoscaler (fleet/router.py)
+    "tdt_fleet_scale_events_total",
+    "tdt_fleet_scale_demand",
+    "tdt_fleet_scale_target_replicas",
+    # multi-tenant QoS: per-tenant accounting, WFQ sheds, prefix-cache
+    # quotas (fleet/router.py, serving/scheduler.py)
+    "tdt_tenant_requests_total",
+    "tdt_tenant_pending_requests",
+    "tdt_tenant_shed_total",
+    "tdt_tenant_prefix_blocks",
+    "tdt_tenant_prefix_evictions_total",
     # span names
     "tdt_serving_probe",
     "tdt_serving_restore",

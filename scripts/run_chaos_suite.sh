@@ -38,6 +38,14 @@
 #                       exponential respawn backoff, then the crash-loop
 #                       breaker quarantines it; the survivor keeps serving;
 #                       plus the healthy supervised-respawn arc (NEW)
+#   fleet-scale-down-kill  SIGKILL the draining replica mid-scale-down ->
+#                       journal-file replay migrates its streams to the
+#                       survivor byte-identically and the slot retires
+#                       instead of respawning (NEW)
+#   fleet-tenant-burst  an aggressor tenant floods a bounded router queue ->
+#                       only aggressor requests shed (queue_full, lowest
+#                       tier first); the victim tenant's streams finish
+#                       byte-exact with warm within-tenant prefix hits (NEW)
 #   observability       chaos arcs stay visible in traces + telemetry
 #
 # The env pins below make the arcs quick and reproducible:
@@ -103,6 +111,10 @@ run_scenario fleet-flaky-wire \
 run_scenario fleet-crash-loop \
   tests/test_fleet.py::test_fleet_crash_loop_breaker_contains_respawn_storm \
   tests/test_fleet.py::test_fleet_supervised_respawn_brings_replica_back "$@"
+run_scenario fleet-scale-down-kill \
+  tests/test_fleet.py::test_fleet_scale_down_kill_mid_drain_zero_loss "$@"
+run_scenario fleet-tenant-burst \
+  tests/test_fleet.py::test_fleet_tenant_burst_sheds_only_aggressor "$@"
 run_scenario observability tests/test_telemetry.py tests/test_tracing.py "$@"
 
 echo

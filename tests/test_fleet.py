@@ -430,15 +430,21 @@ def test_fleet_affinity_parity_and_rolling_rebuild(engine, tmp_path):
 def test_fleet_kill_one_of_three_mid_burst(engine, tmp_path):
     """Acceptance: SIGKILL one of 3 replicas mid-burst. Every in-flight
     stream completes on a survivor byte-identical to the unkilled run —
-    zero dropped, zero duplicated tokens — via journal-replay migration."""
+    zero dropped, zero duplicated tokens — via journal-replay migration.
+    Requests alternate tenants with distinct QoS (priority + WFQ weight):
+    identity must survive the replay byte-identically — the survivor's
+    journal submit records and per-tenant accounting both carry it."""
     reqs = [([3 + i, 17, (42 & (i + 1)) + 1, 7, 9 * i + 1], 12)
             for i in range(9)]
+    qos = [("acme", 0, 2.5), ("beta", 1, 1.0)]
     refs = _references(engine, reqs)
     streams: dict[int, list[int]] = {}
     with Router(3, tmp_path / "fleet", env=REPLICA_ENV) as router:
         router.start()
-        frs = [router.submit(p, g, on_token=_collect(streams))
-               for p, g in reqs]
+        frs = [router.submit(p, g, on_token=_collect(streams),
+                             priority=qos[i % 2][1], tenant=qos[i % 2][0],
+                             weight=qos[i % 2][2])
+               for i, (p, g) in enumerate(reqs)]
         # Let the burst get genuinely mid-flight before the kill.
         deadline = time.monotonic() + 120
         while sum(len(s) for s in streams.values()) < 5:
@@ -488,6 +494,38 @@ def test_fleet_kill_one_of_three_mid_burst(engine, tmp_path):
         assert all(e["args"]["parent_id"] in placement_ids
                    for e in survivor_roots)
         assert all(e["pid"] != 1 + victim.idx for e in xs)
+
+        # QoS identity through migration: every survivor journal submit
+        # record carries the original tenant / weight / priority
+        # byte-identically (prompts are unique, so they key the match).
+        by_prompt = {tuple(fr.prompt): fr for fr in frs}
+        seen_prompts = set()
+        for h in router.replicas:
+            if not h.alive:
+                continue
+            for rec in router._http(h, "/fleet/journal")["records"]:
+                if rec.get("kind") != "submit":
+                    continue
+                fr = by_prompt.get(tuple(rec["prompt"]))
+                assert fr is not None
+                assert rec["tenant"] == fr.tenant
+                assert rec["weight"] == fr.weight
+                assert rec["priority"] == fr.priority
+                seen_prompts.add(tuple(rec["prompt"]))
+        migrated_prompts = {tuple(fr.prompt) for fr in migrated}
+        assert migrated_prompts <= seen_prompts  # resumed WITH identity
+
+        # ...and lands in the survivors' per-tenant accounting: the merged
+        # fleet scrape shows replica-side (not router-local) counts for
+        # both tenants.
+        merged = router.federated_metrics()
+        per_tenant: dict[str, float] = {}
+        for e in merged["counters"].get("tdt_tenant_requests_total", []):
+            if e["labels"].get("replica") not in (None, "router"):
+                t = e["labels"]["tenant"]
+                per_tenant[t] = per_tenant.get(t, 0.0) + e["value"]
+        assert per_tenant.get("acme", 0.0) >= 1.0
+        assert per_tenant.get("beta", 0.0) >= 1.0
 
 
 # ===================================== wire hardening + observability (fast)
@@ -1380,3 +1418,383 @@ def test_fleet_supervised_respawn_brings_replica_back(
         fr = router.submit([77, 78], 4)
         router.serve_all(timeout_s=120)
         assert fr.done and fr.finish_reason == "ok"
+
+
+# =========================== host tier: elasticity + multi-tenant QoS
+
+
+def test_scheduler_wfq_orders_pending_by_weight():
+    """Weighted-fair tags: tenant a (weight 2) advances its virtual time
+    half as fast as tenant b (weight 1), so the tag-order walk interleaves
+    2:1 in a's favor; within one tenant tags are monotone (FCFS)."""
+    from triton_dist_tpu.serving.scheduler import Scheduler
+
+    s = Scheduler(1, 32)
+    for i in range(4):
+        s.submit([1, i], 4, tenant="a", weight=2.0)
+    for i in range(4):
+        s.submit([2, i], 4, tenant="b", weight=1.0)
+    order = [r.tenant for r in sorted(s._pending, key=lambda r: r.wfq_tag)]
+    assert order == ["a", "a", "b", "a", "a", "b", "b", "b"]
+
+    # Single tenant: tags are monotone in submission order, so the WFQ
+    # walk degrades to exactly the old FCFS.
+    s2 = Scheduler(1, 32)
+    rs = [s2.submit([3, i], 4) for i in range(3)]
+    tags = [r.wfq_tag for r in rs]
+    assert tags == sorted(tags) and len(set(tags)) == 3
+
+
+def test_prefix_index_tenant_isolation_and_quota():
+    """Tenant-scoped tries: cross-tenant lookups/probes see nothing; a
+    tenant at its quota recycles its OWN LRU leaves, never a neighbor's."""
+    from triton_dist_tpu.models.kv_cache import BlockAllocator
+    from triton_dist_tpu.serving.scheduler import PrefixIndex
+
+    alloc = BlockAllocator(16)
+    idx = PrefixIndex(alloc, 4)
+    idx.tenant_quota = 2
+
+    def reg(prompt, n, tenant):
+        # The donor pattern: the request's chain registers, then the
+        # request finishes and frees its own refs — the index keeps one
+        # ref per node, so dropped leaves actually free their blocks.
+        blocks = alloc.alloc(n)
+        idx.register(prompt, blocks, tenant=tenant)
+        alloc.free(blocks)
+
+    pa = list(range(8))                      # 2 full blocks
+    reg(pa, 2, "a")
+    assert idx.tenant_blocks("a") == 2
+    # Tenant b can neither reuse nor OBSERVE a's warm prefix.
+    assert idx.match_blocks(pa, tenant="b") == 0
+    assert idx.match_blocks(pa, tenant="a") == 2
+    assert idx.lookup(pa, tenant="b") == []
+
+    pb = [100 + i for i in range(4)]
+    reg(pb, 1, "b")
+    # a registers past its quota: its own pa leaves recycle, b untouched.
+    pa2 = [50 + i for i in range(8)]
+    reg(pa2, 2, "a")
+    assert idx.tenant_blocks("a") <= 2
+    assert idx.match_blocks(pa2, tenant="a") == 2
+    assert idx.match_blocks(pa, tenant="a") == 0
+    assert idx.tenant_blocks("b") == 1 and idx.match_blocks(
+        pb, tenant="b") == 1
+    assert telemetry.counter_value(
+        "tdt_tenant_prefix_evictions_total", tenant="a", cause="self") >= 1.0
+
+    # Pool-pressure eviction prefers over-quota tenants before the global
+    # LRU: push b over quota, then evict on behalf of a fresh tenant.
+    idx.tenant_quota = 0                     # lift the cap to overfill b
+    for j in range(3):
+        reg([200 + 10 * j + i for i in range(4)], 1, "b")
+    idx.tenant_quota = 2
+    assert idx.tenant_blocks("b") > idx.tenant_quota
+    idx.evict(alloc.num_free + 1, tenant="c")
+    assert telemetry.counter_value(
+        "tdt_tenant_prefix_evictions_total",
+        tenant="b", cause="over_quota") >= 1.0
+    assert idx.match_blocks(pa2, tenant="a") == 2    # a stayed warm
+
+
+def test_router_wfq_tags_interleave_tenants(monkeypatch, tmp_path):
+    """Router-side WFQ mirrors the scheduler: TDT_TENANT_WEIGHTS supplies
+    default weights and the pending walk is tag-ordered."""
+    monkeypatch.setenv("TDT_TENANT_WEIGHTS", "gold=2.0")
+    router = Router(1, tmp_path)             # no replica alive: all park
+    for i in range(4):
+        router.submit([i], 4, tenant="gold")
+    for i in range(4):
+        router.submit([10 + i], 4, tenant="econ")
+    order = [fr.tenant
+             for fr in sorted(router._pending, key=lambda r: r.wfq_tag)]
+    assert order == ["gold", "gold", "econ",
+                     "gold", "gold", "econ", "econ", "econ"]
+    assert router.autoscale()["tenant_weights"] == {"gold": 2.0}
+    assert telemetry.gauge_value(
+        "tdt_tenant_pending_requests", tenant="gold") == 4.0
+
+
+def test_pending_queue_bound_sheds_lowest_tier_aggressor(
+        monkeypatch, tmp_path):
+    """TDT_FLEET_PENDING_MAX bounds the park queue priority-aware: the
+    victim is the least important parked request, ties broken toward the
+    tenant with the most parked work — the aggressor sheds itself while
+    the high-tier tenant's requests survive, and every gauge stays exact
+    through the mutation."""
+    monkeypatch.setenv("TDT_FLEET_PENDING_MAX", "2")
+    router = Router(1, tmp_path)
+    v1 = router.submit([1], 2, priority=0, tenant="vip")
+    a1 = router.submit([2], 2, priority=2, tenant="agg")
+    assert telemetry.gauge_value("tdt_fleet_pending_requests") == 2.0
+    a2 = router.submit([3], 2, priority=2, tenant="agg")
+    # Overflow: the aggressor's newest request sheds, never the vip.
+    assert a2.done and a2.finish_reason == "queue_full"
+    assert not v1.done and not a1.done
+    assert telemetry.counter_value(
+        "tdt_tenant_shed_total", tenant="agg", reason="queue_full") == 1.0
+    v2 = router.submit([4], 2, priority=0, tenant="vip")
+    # Overflow again: the remaining priority-2 request pays, not v2.
+    assert a1.done and a1.finish_reason == "queue_full"
+    assert not v1.done and not v2.done
+    assert telemetry.gauge_value("tdt_fleet_pending_requests") == 2.0
+    assert telemetry.gauge_value(
+        "tdt_tenant_pending_requests", tenant="vip") == 2.0
+    assert telemetry.gauge_value(
+        "tdt_tenant_pending_requests", tenant="agg") == 0.0
+
+
+def test_parked_ttft_deadline_expires_router_side(tmp_path):
+    """A parked request whose TTFT budget lapses while EVERY replica is
+    non-LIVE expires router-side with finish_reason="deadline" instead of
+    bouncing between park and placement forever."""
+    router = Router(1, tmp_path)             # the only replica never boots
+    fr = router.submit([1, 2], 4, ttft_deadline_s=5.0)
+    assert not fr.done and router._pending
+    fr.arrived_at -= 10.0                    # budget long gone
+    assert router.pump()
+    assert fr.done and fr.finish_reason == "deadline"
+    assert telemetry.gauge_value("tdt_fleet_pending_requests") == 0.0
+
+
+def test_autoscaler_policy_hysteresis_and_bounds(monkeypatch, tmp_path):
+    """The control loop's decision layer, wire-free: scale-up on EWMA
+    demand past up_at (bounded by SCALE_MAX, one event per cooldown, never
+    while a boot is in progress), scale-down on demand under down_at
+    (bounded by SCALE_MIN, never with parked work)."""
+    monkeypatch.setenv("TDT_FLEET_SCALE_MAX", "2")
+    monkeypatch.setenv("TDT_FLEET_SCALE_MIN", "1")
+    monkeypatch.setenv("TDT_FLEET_SCALE_UP_AT", "2.0")
+    monkeypatch.setenv("TDT_FLEET_SCALE_DOWN_AT", "1.0")
+    monkeypatch.setenv("TDT_FLEET_SCALE_COOLDOWN_S", "100.0")
+    monkeypatch.setenv("TDT_FLEET_SCALE_ALPHA", "1.0")
+    spawned = []
+    monkeypatch.setattr(Router, "_spawn",
+                        lambda self, h: spawned.append(h.idx))
+    down_calls = []
+    monkeypatch.setattr(Router, "scale_down",
+                        lambda self, idx: down_calls.append(idx))
+    router = Router(1, tmp_path)
+    h0 = router.replicas[0]
+    h0.alive = True
+    h0.health.state = "suspect"              # not eligible: submits park
+    for i in range(6):
+        router.submit([i], 4)
+    assert len(router._pending) == 6
+
+    now = time.monotonic()
+    assert router._autoscale(now)            # 6 demand / 1 live > 2.0
+    assert spawned == [1] and router.replicas[1].booting
+    assert router.autoscale()["events"][-1]["direction"] == "up"
+    assert telemetry.counter_value(
+        "tdt_fleet_scale_events_total", direction="up") == 1.0
+    assert telemetry.gauge_value("tdt_fleet_scale_demand") == 6.0
+
+    # A boot in progress gates further scale events.
+    assert not router._autoscale(now)
+    assert spawned == [1]
+
+    h1 = router.replicas[1]
+    h1.booting = False
+    h1.alive = True
+    h1.health.state = "suspect"
+    router._scale_last_event_at = 0.0        # bypass cooldown for bounds
+    # At SCALE_MAX: demand stays hot but no third replica appears.
+    assert not router._autoscale(now)
+    assert spawned == [1] and not down_calls
+
+    # Demand collapses: the least-loaded highest-idx live replica drains.
+    router._pending.clear()
+    router._pending_gauges()
+    assert router._autoscale(now)
+    assert down_calls == [1]
+
+    # Cooldown: the next tick may not start another event.
+    down_calls.clear()
+    assert not router._autoscale(now + 1.0)
+    assert not down_calls
+
+    # At SCALE_MIN: a one-replica fleet never scales below the floor.
+    h1.alive = False
+    h1.retired = True
+    router._scale_last_event_at = 0.0
+    assert not router._autoscale(now)
+    assert not down_calls
+
+
+def test_scale_down_state_clears_when_target_dies(tmp_path):
+    """The scale-down machine tolerates its target dying (or being retired
+    by the failure path) at any phase: the slot retires, the state clears,
+    and pump never blocks on a corpse. A scale_down() aimed at an
+    already-dead slot retires it immediately."""
+    router = Router(2, tmp_path)
+    h = router.replicas[1]                   # never alive
+    router._scale_down_state = {"idx": 1, "phase": "migrate",
+                                "deadline": time.monotonic() + 60.0}
+    assert router._pump_scale_down(time.monotonic())
+    assert h.retired and router._scale_down_state is None
+
+    router2 = Router(2, tmp_path / "b")
+    router2.scale_down(1)                    # dead target: retire in place
+    assert router2.replicas[1].retired
+    assert router2._scale_down_state is None
+    assert telemetry.counter_value(
+        "tdt_fleet_scale_events_total", direction="down") == 1.0
+    # Retired slots are tombstones: pump skips them, status names them.
+    router2.pump()
+    assert router2.status()["replicas"][1]["retired"]
+    assert not router2.replicas[1].respawning
+
+
+def test_journal_replays_tenant_and_weight():
+    """Tenant identity and QoS weight round-trip the write-ahead journal
+    byte-identically — and records written BEFORE the tenant fields
+    existed replay with the defaults."""
+    recs = [
+        {"kind": "submit", "req_id": 1, "prompt": [1, 2], "max_new": 4,
+         "priority": 0, "tenant": "acme", "weight": 2.5},
+        {"kind": "submit", "req_id": 2, "prompt": [3, 4], "max_new": 4},
+    ]
+    state = RequestJournal.replay(recs)
+    assert state[1].tenant == "acme" and state[1].weight == 2.5
+    assert state[1].priority == 0
+    assert state[2].tenant == "default" and state[2].weight == 1.0
+
+
+# ============================== chaos: elastic scale + tenant brown-out
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+@pytest.mark.timeout(600)
+def test_fleet_scale_down_kill_mid_drain_zero_loss(
+        engine, monkeypatch, tmp_path):
+    """Chaos acceptance: begin a scale-down (drain flipped, state machine
+    armed), then SIGKILL the draining replica before the router can
+    migrate a single request. The death path replays the journal FILE onto
+    survivors — every stream byte-identical, zero drop/dup — and the slot
+    RETIRES instead of respawning, even with supervision enabled."""
+    monkeypatch.setenv("TDT_FLEET_RESPAWN_S", "0.1")  # retire must win
+    reqs = [([3 + i, 17, (42 & (i + 1)) + 1, 7, 9 * i + 1], 12)
+            for i in range(6)]
+    refs = _references(engine, reqs)
+    streams: dict[int, list[int]] = {}
+    with Router(3, tmp_path / "fleet", env=REPLICA_ENV) as router:
+        router.start()
+        frs = [router.submit(p, g, on_token=_collect(streams))
+               for p, g in reqs]
+        deadline = time.monotonic() + 120
+        while sum(len(s) for s in streams.values()) < 5:
+            assert time.monotonic() < deadline, "burst never started"
+            if not router.pump():
+                time.sleep(0.01)
+        victim = max(router.replicas, key=lambda h: len(h.inflight))
+        assert victim.inflight                # the drain has live work
+        router.scale_down(victim.idx)
+        sd = router._scale_down_state
+        assert sd is not None and sd["idx"] == victim.idx
+        assert sd["phase"] == "migrate"       # nothing migrated yet
+        router.kill(victim.idx)               # kill -9 MID-drain
+
+        router.serve_all(timeout_s=300)
+        for fr, ref in zip(frs, refs):
+            assert fr.done
+            assert fr.tokens == ref, f"fleet_id={fr.fleet_id} diverged"
+            assert streams[fr.fleet_id] == ref   # zero drop / zero dup
+        assert victim.retired and not victim.alive
+        assert router._scale_down_state is None
+        assert telemetry.counter_total("tdt_fleet_migrations_total") >= 1.0
+        assert telemetry.counter_value(
+            "tdt_fleet_scale_events_total", direction="down") == 1.0
+
+        # Supervision never resurrects a retired slot: pump well past the
+        # respawn backoff and the tombstone holds.
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline:
+            router.pump()
+            time.sleep(0.05)
+        assert victim.retired and not victim.alive and not victim.respawning
+        st = router.status()
+        assert st["replicas"][victim.idx]["retired"]
+        # The retired slot is out of the placement set but the fleet still
+        # takes work.
+        fr = router.submit([91, 92], 4)
+        router.serve_all(timeout_s=120)
+        assert fr.done and fr.finish_reason == "ok"
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+@pytest.mark.timeout(600)
+def test_fleet_tenant_burst_sheds_only_aggressor(
+        engine, monkeypatch, tmp_path):
+    """Chaos acceptance (brown-out isolation): an aggressor tenant floods
+    a bounded router queue during a placement stall (every replica
+    momentarily ineligible — the overload moment a bounded queue exists
+    for). Only aggressor requests shed (``queue_full``, newest-first
+    within the aggressor's own backlog); every victim stream completes
+    byte-identical with warm WITHIN-tenant prefix hits, and the
+    aggressor's probes never see the victim's warm prefix."""
+    monkeypatch.setenv("TDT_FLEET_PENDING_MAX", "3")
+    pv = [11] * BLOCK                        # victim's shared prefix family
+    vip_reqs = [(pv + [i + 1], 4) for i in range(4)]
+    vip_refs = _references(engine, vip_reqs)
+    with Router(2, tmp_path / "fleet", env=REPLICA_ENV) as router:
+        router.start()
+        warm = router.submit(pv + [99], 4, priority=0, tenant="vip")
+        router.serve_all(timeout_s=180)
+        assert warm.done and warm.finish_reason == "ok"
+
+        # Placement stall: both replicas drop out of the eligible set
+        # (router-side view only — the processes keep serving), so the
+        # flood lands in the bounded pending queue.
+        for h in router.replicas:
+            h.draining = True
+        agg = [router.submit([50 + i, 7, i], 12, priority=2, tenant="agg",
+                             weight=0.5)
+               for i in range(12)]
+        # Deterministic brown-out: the bound (3) holds the aggressor's 3
+        # OLDEST requests; the other 9 shed newest-first — the aggressor
+        # pays for its own burst while it is the only tenant queued.
+        shed = [fr for fr in agg if fr.done]
+        assert len(shed) == 9
+        assert all(fr.finish_reason == "queue_full" for fr in shed)
+        assert telemetry.counter_value(
+            "tdt_tenant_shed_total",
+            tenant="agg", reason="queue_full") == 9.0
+        for h in router.replicas:
+            h.draining = False
+
+        vip = [router.submit(p, g, priority=0, tenant="vip", weight=4.0)
+               for p, g in vip_reqs]
+        router.serve_all(timeout_s=300)
+
+        # Brown-out isolation: every shed landed on the aggressor; the
+        # victim tier saw none and its streams are byte-exact.
+        assert all(fr.done for fr in agg + vip)
+        assert all(fr.tenant == "agg" for fr in agg + vip
+                   if fr.finish_reason == "queue_full")
+        for fr, ref in zip(vip, vip_refs):
+            assert fr.finish_reason == "ok"
+            assert fr.tokens == ref, f"fleet_id={fr.fleet_id} diverged"
+        assert telemetry.counter_value(
+            "tdt_tenant_shed_total",
+            tenant="vip", reason="queue_full") == 0.0
+
+        # Warm within-tenant affinity did its job for the victim...
+        assert router.status()["prefix_hits"] >= 1
+        # ...and the warm prefix is invisible across the tenant boundary:
+        # the same prompt probes warm for vip, cold for agg, fleet-wide.
+        warm_vip = warm_agg = 0
+        for h in router.replicas:
+            if not h.alive:
+                continue
+            body = {"prompt": pv + [0]}
+            warm_vip += router._http(
+                h, "/fleet/placement",
+                dict(body, tenant="vip"))["warm_blocks"]
+            warm_agg += router._http(
+                h, "/fleet/placement",
+                dict(body, tenant="agg"))["warm_blocks"]
+        assert warm_vip >= 1 and warm_agg == 0

@@ -152,6 +152,8 @@ class ReplicaService:
                 body["prompt"], int(body["max_new"]),
                 on_token=self._on_token, on_finish=self._on_finish,
                 priority=int(body.get("priority", 1)),
+                tenant=str(body.get("tenant", "default")),
+                weight=float(body.get("weight", 1.0)),
                 ttft_deadline_s=body.get("ttft_deadline_s"),
                 deadline_s=body.get("deadline_s"),
                 trace_ctx=tracing.extract(body.get("trace")),
@@ -169,6 +171,8 @@ class ReplicaService:
                 body["prompt"], int(body["max_new"]), body.get("tokens", []),
                 on_token=self._on_token, on_finish=self._on_finish,
                 priority=int(body.get("priority", 1)),
+                tenant=str(body.get("tenant", "default")),
+                weight=float(body.get("weight", 1.0)),
                 ttft_deadline_s=body.get("ttft_deadline_s"),
                 deadline_s=body.get("deadline_s"),
                 trace_ctx=tracing.extract(body.get("trace")),
@@ -209,7 +213,10 @@ class ReplicaService:
         if err:
             return 400, {"error": err}
         try:
-            return 200, self.server.placement_info(body.get("prompt", []))
+            return 200, self.server.placement_info(
+                body.get("prompt", []),
+                tenant=str(body.get("tenant", "default")),
+            )
         except (TypeError, ValueError) as e:
             return 400, {"error": f"bad field value: {e}"}
 

@@ -86,6 +86,26 @@ WireChaosSchedule` program: ``delay@/fleet/stream:50ms``, ``reset@…``,
 ``hang@…``, ``drop@…``) injects deterministic wire faults inside
 :meth:`Router._http` so every arc above replays on one CPU host.
 
+**Elasticity & multi-tenant QoS** (:meth:`Router._autoscale` + the
+tenant fields on :class:`FleetRequest`). The fleet tracks offered load
+(pending + in-flight, EWMA-smoothed by ``TDT_FLEET_SCALE_ALPHA``) and —
+when ``TDT_FLEET_SCALE_MAX`` > 0 — grows itself through the supervised
+respawn path (a scale-up replica boots non-blockingly and joins
+placement warm) and shrinks through a pump-driven, NON-blocking
+scale-down state machine: drain → journal handoff to survivors →
+SIGTERM → retire, with hysteresis (``TDT_FLEET_SCALE_UP_AT`` /
+``TDT_FLEET_SCALE_DOWN_AT`` demand-per-replica thresholds) and a
+cooldown between events. A kill -9 of the draining replica mid-scale-
+down falls back to the exact same journal-file migration as any death —
+zero tokens lost, the slot just retires instead of respawning. Every
+request carries a tenant id and QoS weight end-to-end (wire bodies,
+journal records, telemetry labels); the router's pending queue and each
+replica's scheduler both run weighted-fair queueing over virtual finish
+tags, the pending queue is bounded (``TDT_FLEET_PENDING_MAX``) with a
+priority-aware ``queue_full`` shed (lowest tier, most-parked tenant
+first), and placement affinity probes are tenant-scoped — see
+``docs/fleet.md`` ("Elasticity & multi-tenant QoS").
+
 Control plane is stdlib-only: ``subprocess`` + ``urllib`` + JSON over
 each replica's loopback introspection endpoint. The router itself is
 single-threaded — drive it with :meth:`pump` (one poll sweep) or
@@ -106,7 +126,12 @@ Telemetry (router-process ``tdt_fleet_*`` family):
 ``tdt_fleet_health_state{replica}`` (gauge),
 ``tdt_fleet_wire_retries_total{path,code}``,
 ``tdt_fleet_stall_migrations_total``, ``tdt_fleet_respawns_total{outcome}``,
-``tdt_fleet_migration_seconds`` (histogram).
+``tdt_fleet_migration_seconds`` (histogram),
+``tdt_fleet_scale_events_total{direction}``, ``tdt_fleet_scale_demand``
+(gauge), ``tdt_fleet_scale_target_replicas`` (gauge), and the tenant
+family ``tdt_tenant_requests_total{tenant}``,
+``tdt_tenant_pending_requests{tenant}`` (gauge),
+``tdt_tenant_shed_total{tenant,reason}``.
 """
 
 from __future__ import annotations
@@ -317,7 +342,8 @@ class FleetRequest:
     token, ``on_finish(fr)`` once."""
 
     __slots__ = (
-        "fleet_id", "prompt", "max_new", "priority", "on_token", "on_finish",
+        "fleet_id", "prompt", "max_new", "priority", "tenant", "weight",
+        "wfq_tag", "on_token", "on_finish",
         "tokens", "done", "finish_reason", "replica", "remote_id",
         "migrations", "placed_reason", "trace", "_seed",
         "ttft_deadline_s", "deadline_s", "arrived_at",
@@ -326,11 +352,18 @@ class FleetRequest:
     def __init__(self, fleet_id: int, prompt, max_new: int, priority: int,
                  on_token=None, on_finish=None,
                  ttft_deadline_s: float | None = None,
-                 deadline_s: float | None = None):
+                 deadline_s: float | None = None,
+                 tenant: str = "default", weight: float = 1.0):
         self.fleet_id = fleet_id
         self.prompt = [int(t) for t in prompt]
         self.max_new = int(max_new)
         self.priority = int(priority)
+        #: Tenant identity + WFQ weight: carried on every wire body so the
+        #: replica scheduler and journal see the same QoS the router does.
+        self.tenant = str(tenant)
+        self.weight = float(weight)
+        #: WFQ virtual finish tag (router-side queue order).
+        self.wfq_tag = 0.0
         self.on_token = on_token
         self.on_finish = on_finish
         #: Wall-clock budgets measured from ``arrived_at`` (router admit).
@@ -377,6 +410,9 @@ class ReplicaHandle:
         self._log_f = None
         self.alive = False
         self.draining = False
+        #: Scaled-down slot: permanently out of the pump loop (never
+        #: respawned, never placed) — the autoscaler's tombstone.
+        self.retired = False
         self.inflight: dict[int, FleetRequest] = {}
         #: Health state machine (the router overwrites this with its
         #: env-configured policy; the default keeps bare handles usable
@@ -470,7 +506,52 @@ class Router:
         self._requests: list[FleetRequest] = []
         #: Requests with no eligible/accepting replica right now; retried
         #: every pump — the zero-reject guarantee during rebuild windows.
+        #: Bounded by TDT_FLEET_PENDING_MAX (0 = unbounded): over the
+        #: bound, the lowest-priority request of the most-parked tenant is
+        #: shed with finish_reason="queue_full".
         self._pending: list[FleetRequest] = []
+        self._pending_max = max(get_int_env("TDT_FLEET_PENDING_MAX", 0), 0)
+        #: Tenant QoS weights (``TDT_TENANT_WEIGHTS="acme=4,beta=1"``);
+        #: unlisted tenants weigh 1.0. Router-side WFQ virtual time mirrors
+        #: the replica scheduler's (finish-tag fair queueing).
+        self._tenant_weights: dict[str, float] = {}
+        for part in os.environ.get("TDT_TENANT_WEIGHTS", "").split(","):
+            name, sep, val = part.strip().partition("=")
+            if not sep:
+                continue
+            try:
+                self._tenant_weights[name.strip()] = max(float(val), 1e-6)
+            except ValueError:
+                tdt_log(f"[fleet] ignoring bad TDT_TENANT_WEIGHTS entry "
+                        f"{part!r}", level="warn")
+        self._wfq_clock = 0.0
+        self._wfq_last: dict[str, float] = {}
+        #: Tenants ever parked — keeps tdt_tenant_pending_requests gauges
+        #: accurate (dropping to 0) once a tenant's queue empties.
+        self._pending_tenants: set[str] = set()
+        #: Autoscaler (TDT_FLEET_SCALE_*): disabled unless
+        #: TDT_FLEET_SCALE_MAX > 0, so fixed-fleet behavior is untouched
+        #: by default. Thresholds are EWMA demand (pending + in-flight)
+        #: PER LIVE REPLICA; cooldown is the hysteresis gap between scale
+        #: events. ``scale_up()``/``scale_down()`` stay callable (tests,
+        #: operators) even with the control loop disabled.
+        self._scale_min = max(
+            get_int_env("TDT_FLEET_SCALE_MIN", num_replicas), 1
+        )
+        self._scale_max = get_int_env("TDT_FLEET_SCALE_MAX", 0)
+        self._scale_up_at = get_float_env("TDT_FLEET_SCALE_UP_AT", 4.0)
+        self._scale_down_at = get_float_env("TDT_FLEET_SCALE_DOWN_AT", 1.0)
+        self._scale_cooldown_s = get_float_env(
+            "TDT_FLEET_SCALE_COOLDOWN_S", 10.0
+        )
+        self._scale_alpha = min(max(
+            get_float_env("TDT_FLEET_SCALE_ALPHA", 0.3), 0.01), 1.0)
+        self._demand_ewma = 0.0
+        self._scale_last_event_at = 0.0
+        #: Non-blocking scale-down state machine (one at a time):
+        #: {"idx", "phase": "migrate"|"await_drained", "deadline"}.
+        self._scale_down_state: dict | None = None
+        self._scale_events: collections.deque = collections.deque(maxlen=64)
         #: first-block hash -> replica idx (cold-start co-location).
         self._prefix_home: dict[str, int] = {}
         self._next_id = 0
@@ -700,11 +781,19 @@ class Router:
     def submit(self, prompt, max_new: int, priority: int = 1,
                on_token=None, on_finish=None,
                ttft_deadline_s: float | None = None,
-               deadline_s: float | None = None) -> FleetRequest:
-        """Place one request on the fleet. Never rejects: with no eligible
-        or accepting replica it parks in the router queue and places at a
-        later :meth:`pump`. Opens the request's fleet-wide trace — every
-        process that touches the request parents its spans under it.
+               deadline_s: float | None = None,
+               tenant: str = "default",
+               weight: float | None = None) -> FleetRequest:
+        """Place one request on the fleet. Never rejects outright: with no
+        eligible or accepting replica it parks in the router queue and
+        places at a later :meth:`pump` (a FULL bounded queue sheds its
+        lowest-priority request with ``finish_reason="queue_full"``).
+        Opens the request's fleet-wide trace — every process that touches
+        the request parents its spans under it.
+
+        ``tenant`` scopes QoS (weighted-fair queueing, prefix-cache
+        isolation, per-tenant shed accounting); ``weight`` defaults to the
+        ``TDT_TENANT_WEIGHTS`` entry for the tenant (1.0 when unlisted).
 
         ``ttft_deadline_s``/``deadline_s`` are wall-clock budgets measured
         from THIS call: each placement stamps the *remaining* budget into
@@ -712,13 +801,20 @@ class Router:
         re-stamp the shrunken residual, and a request whose total budget
         runs out while parked or mid-migration finishes router-side with
         ``finish_reason="deadline"``."""
+        w = self._tenant_weights.get(tenant, 1.0) if weight is None \
+            else float(weight)
         fr = FleetRequest(self._next_id, prompt, max_new, priority,
                           on_token=on_token, on_finish=on_finish,
                           ttft_deadline_s=ttft_deadline_s,
-                          deadline_s=deadline_s)
+                          deadline_s=deadline_s,
+                          tenant=tenant, weight=w)
         self._next_id += 1
         self._requests.append(fr)
+        start = max(self._wfq_clock, self._wfq_last.get(fr.tenant, 0.0))
+        fr.wfq_tag = start + fr.max_new / max(fr.weight, 1e-6)
+        self._wfq_last[fr.tenant] = fr.wfq_tag
         telemetry.inc("tdt_fleet_requests_total")
+        telemetry.inc("tdt_tenant_requests_total", tenant=fr.tenant)
         fr.trace = tracing.start_remote_trace(
             "tdt_fleet_request", fleet_id=fr.fleet_id,
             prompt_len=len(fr.prompt), max_new=fr.max_new,
@@ -738,10 +834,46 @@ class Router:
         return body
 
     def _park(self, fr: FleetRequest) -> None:
+        """Queue ``fr`` for a later pump. Over ``TDT_FLEET_PENDING_MAX``
+        the queue sheds priority-aware: the victim is the LEAST important
+        parked request (highest priority number), breaking ties toward the
+        tenant with the most parked work (the aggressor pays for its own
+        burst) and then toward the newest arrival — which may be ``fr``
+        itself."""
         self._pending.append(fr)
+        if self._pending_max > 0 and len(self._pending) > self._pending_max:
+            counts: dict[str, int] = {}
+            for r in self._pending:
+                counts[r.tenant] = counts.get(r.tenant, 0) + 1
+            victim = max(
+                self._pending,
+                key=lambda r: (r.priority, counts[r.tenant], r.fleet_id),
+            )
+            self._pending.remove(victim)
+            telemetry.inc("tdt_tenant_shed_total",
+                          tenant=victim.tenant, reason="queue_full")
+            tdt_log(f"[fleet] pending queue full "
+                    f"(TDT_FLEET_PENDING_MAX={self._pending_max}); shedding "
+                    f"request {victim.fleet_id} (tenant={victim.tenant}, "
+                    f"priority={victim.priority})", level="warn")
+            self._finish(victim, "queue_full")
+        self._pending_gauges()
+
+    def _pending_gauges(self) -> None:
+        """Refresh the pending gauges — call after EVERY mutation of
+        ``_pending`` so the fleet gauge and the per-tenant breakdown never
+        go stale (tenants whose queue emptied report 0, not a stuck
+        last-seen value)."""
         telemetry.set_gauge(
             "tdt_fleet_pending_requests", float(len(self._pending))
         )
+        counts: dict[str, int] = {}
+        for r in self._pending:
+            counts[r.tenant] = counts.get(r.tenant, 0) + 1
+        self._pending_tenants |= set(counts)
+        for t in self._pending_tenants:
+            telemetry.set_gauge("tdt_tenant_pending_requests",
+                                float(counts.get(t, 0)), tenant=t)
 
     def _eligible(self) -> list[ReplicaHandle]:
         """Replicas placement may use: alive, not draining, health LIVE —
@@ -753,16 +885,28 @@ class Router:
 
     def _expire_if_due(self, fr: FleetRequest) -> bool:
         """Finish ``fr`` router-side with ``finish_reason="deadline"`` when
-        its total wall-clock budget (measured from submit) has run out —
-        the parked / mid-migration expiry path the replica scheduler never
-        sees. True when the request is done (now or already)."""
+        a wall-clock budget (measured from submit) has run out — the
+        parked / mid-migration expiry path the replica scheduler never
+        sees. Both budgets bind here: the total deadline always, the TTFT
+        deadline only while the stream has produced nothing anywhere
+        (no delivered tokens, no migration seed) — so a request parked
+        because EVERY replica is non-LIVE still expires on time instead of
+        bouncing forever between park and a replica-side shed. True when
+        the request is done (now or already)."""
         if fr.done:
             return True
-        if fr.deadline_s is None:
-            return False
-        if time.monotonic() - fr.arrived_at >= fr.deadline_s:
+        elapsed = time.monotonic() - fr.arrived_at
+        if fr.deadline_s is not None and elapsed >= fr.deadline_s:
             tdt_log(f"[fleet] request {fr.fleet_id} total deadline "
                     f"({fr.deadline_s}s) expired before placement",
+                    level="warn")
+            self._finish(fr, "deadline")
+            return True
+        if (fr.ttft_deadline_s is not None and not fr.tokens
+                and not fr._seed and fr.replica is None
+                and elapsed >= fr.ttft_deadline_s):
+            tdt_log(f"[fleet] request {fr.fleet_id} TTFT deadline "
+                    f"({fr.ttft_deadline_s}s) expired before first token",
                     level="warn")
             self._finish(fr, "deadline")
             return True
@@ -802,7 +946,8 @@ class Router:
                 try:
                     infos.append((h, self._http(
                         h, "/fleet/placement",
-                        self._stamp(fr, psp, {"prompt": fr.prompt}),
+                        self._stamp(fr, psp, {"prompt": fr.prompt,
+                                              "tenant": fr.tenant}),
                     )))
                 except OSError:
                     continue
@@ -920,6 +1065,7 @@ class Router:
         body = self._stamp(fr, pspan, {
             "prompt": fr.prompt, "max_new": fr.max_new,
             "priority": fr.priority,
+            "tenant": fr.tenant, "weight": fr.weight,
         })
         elapsed = time.monotonic() - fr.arrived_at
         if fr.deadline_s is not None:
@@ -937,6 +1083,7 @@ class Router:
         fr.remote_id = int(resp["req_id"])
         h.inflight[fr.remote_id] = fr
         h.health.note_progress(time.monotonic())
+        self._wfq_clock = max(self._wfq_clock, fr.wfq_tag)
         return True
 
     # ------------------------------------------------------------- delivery
@@ -964,11 +1111,15 @@ class Router:
         threads). Per replica: finish a boot in progress, respawn a
         supervised dead slot when its backoff is due, migrate off a dead
         process / a wire-DEAD peer / a stalled (wedged) one, heartbeat
-        idle peers, then poll streams. Finally retry (or expire) the
-        pending queue. Returns True when anything progressed."""
+        idle peers, then poll streams. Then drive the autoscaler (scale
+        events + the non-blocking scale-down state machine) and finally
+        retry (or expire) the pending queue in WFQ order. Returns True
+        when anything progressed."""
         worked = False
         now = time.monotonic()
         for h in self._replicas:
+            if h.retired:
+                continue
             if h.booting:
                 worked = self._pump_boot(h, now) or worked
                 continue
@@ -995,9 +1146,12 @@ class Router:
                 continue
             self._heartbeat(h, now)
             worked = self._poll_replica(h) or worked
+        worked = self._autoscale(now) or worked
         if self._pending:
             still = []
-            for fr in self._pending:
+            # WFQ order: lowest virtual finish tag places first — the
+            # under-served tenant's request jumps the aggressor's backlog.
+            for fr in sorted(self._pending, key=lambda r: r.wfq_tag):
                 if self._expire_if_due(fr):
                     worked = True
                 elif self._try_place(fr):
@@ -1005,9 +1159,7 @@ class Router:
                 else:
                     still.append(fr)
             self._pending = still
-            telemetry.set_gauge(
-                "tdt_fleet_pending_requests", float(len(self._pending))
-            )
+            self._pending_gauges()
         return worked
 
     def _heartbeat(self, h: ReplicaHandle, now: float) -> None:
@@ -1104,6 +1256,182 @@ class Router:
             return True
         return False
 
+    # ------------------------------------------------------------ autoscaler
+    def _autoscale(self, now: float) -> bool:
+        """One control-loop tick (called from :meth:`pump`): drive any
+        in-progress scale-down, then — when ``TDT_FLEET_SCALE_MAX`` enables
+        the loop — compare the EWMA demand per live replica against the
+        hysteresis thresholds and start at most one scale event per
+        cooldown window. Scale-up reuses the supervised-respawn boot path
+        (non-blocking); scale-down is the pump-driven state machine in
+        :meth:`_pump_scale_down`."""
+        worked = False
+        if self._scale_down_state is not None:
+            worked = self._pump_scale_down(now) or worked
+        if self._scale_max <= 0:
+            return worked
+        live = [h for h in self._replicas if h.alive and not h.retired]
+        demand = len(self._pending) + sum(len(h.inflight) for h in live)
+        a = self._scale_alpha
+        self._demand_ewma = a * demand + (1.0 - a) * self._demand_ewma
+        telemetry.set_gauge("tdt_fleet_scale_demand", self._demand_ewma)
+        telemetry.set_gauge(
+            "tdt_fleet_scale_target_replicas", float(len(live))
+        )
+        if (self._scale_down_state is not None or not live
+                or any(h.booting for h in self._replicas)
+                or now - self._scale_last_event_at < self._scale_cooldown_s):
+            return worked
+        per_replica = self._demand_ewma / len(live)
+        active = sum(
+            1 for h in self._replicas
+            if not h.retired and (h.alive or h.booting or h.respawning)
+        )
+        if per_replica > self._scale_up_at and active < self._scale_max:
+            self.scale_up()
+            self._scale_last_event_at = now
+            return True
+        if (per_replica < self._scale_down_at and len(live) > self._scale_min
+                and not self._pending):
+            victim = max(live, key=lambda h: (-len(h.inflight), h.idx))
+            self.scale_down(victim.idx)
+            self._scale_last_event_at = now
+            return True
+        return worked
+
+    def scale_up(self) -> ReplicaHandle:
+        """Append and spawn one new replica slot. Non-blocking: the boot is
+        polled by :meth:`pump` (``_pump_boot``), and on ready the newcomer
+        enters placement with fresh health — the warm-start target for the
+        next wave of work. Callable directly (operator/test path) even
+        with the control loop disabled."""
+        idx = len(self._replicas)
+        h = ReplicaHandle(idx, os.path.join(self.workdir, f"r{idx}"))
+        h.health = ReplicaHealth(now=time.monotonic(), **self._health_kw)
+        self._replicas.append(h)
+        self._spawn(h)
+        h.booting = True
+        h.boot_deadline = time.monotonic() + 240.0
+        telemetry.inc("tdt_fleet_scale_events_total", direction="up")
+        self._scale_events.append({
+            "direction": "up", "replica": idx, "at": time.monotonic(),
+            "demand_ewma": round(self._demand_ewma, 4),
+        })
+        tdt_log(f"[fleet] scale-up: spawning replica {idx} "
+                f"(demand_ewma={self._demand_ewma:.2f})")
+        return h
+
+    def scale_down(self, idx: int) -> None:
+        """Begin a crash-safe, NON-blocking scale-down of replica ``idx``:
+        flip it to drain mode now; :meth:`pump` then migrates its
+        in-flight work to survivors via journal handoff, waits for
+        ``drained``, SIGTERMs, and retires the slot. A kill -9 (or any
+        death) mid-drain falls back to the standard journal-FILE
+        replay migration — zero tokens lost — and the slot retires
+        instead of respawning. One scale-down runs at a time."""
+        h = self._replicas[idx]
+        if h.retired or self._scale_down_state is not None:
+            return
+        telemetry.inc("tdt_fleet_scale_events_total", direction="down")
+        self._scale_events.append({
+            "direction": "down", "replica": idx, "at": time.monotonic(),
+            "demand_ewma": round(self._demand_ewma, 4),
+        })
+        tdt_log(f"[fleet] scale-down: draining replica {idx} "
+                f"(demand_ewma={self._demand_ewma:.2f})")
+        if not h.alive:
+            h.retired = True
+            h.respawning = False
+            h.booting = False
+            return
+        try:
+            self._http(h, "/fleet/drain")
+        except OSError:
+            # Died before the drain landed: the standard failure path
+            # migrates from the journal file; retire instead of respawn.
+            self._on_replica_failure(h, "scale_down")
+            h.retired = True
+            h.respawning = False
+            return
+        h.draining = True
+        self._scale_down_state = {
+            "idx": idx, "phase": "migrate",
+            "deadline": time.monotonic() + 120.0,
+        }
+
+    def _pump_scale_down(self, now: float) -> bool:
+        """Advance the scale-down state machine one step. ``migrate``:
+        catch up the drainee's buffered tokens, snapshot its journal over
+        the wire, and hand its in-flight requests to survivors (the same
+        ``_migrate_inflight`` every failure path uses). ``await_drained``:
+        poll until the replica holds nothing, then SIGTERM + retire. A
+        death at ANY point (kill -9 chaos included) is caught by pump's
+        death detection first — ``_on_replica_failure`` replays the
+        journal file and retires the slot — so this machine only ever sees
+        a clean drain or an already-cleared state."""
+        st = self._scale_down_state
+        if st is None:
+            return False
+        h = self._replicas[st["idx"]]
+        if h.retired or not h.alive:
+            # Failure path already migrated + retired (or the slot was
+            # never alive): nothing left to drain.
+            h.retired = True
+            h.respawning = False
+            self._scale_down_state = None
+            return True
+        if st["phase"] == "migrate":
+            self._poll_replica(h)
+            if h.inflight:
+                try:
+                    records = self._http(h, "/fleet/journal")["records"]
+                except OSError:
+                    return True  # health accounted; death path next pump
+                self._migrate_inflight(h, records, reason="scale_down",
+                                       cancel_donor=True)
+            st["phase"] = "await_drained"
+            return True
+        try:
+            status = self._http(h, "/fleet/status")
+        except OSError:
+            return True
+        if status.get("drained") or now > st["deadline"]:
+            if not status.get("drained"):
+                tdt_log(f"[fleet] replica {h.idx} scale-down drain timed "
+                        f"out; terminating anyway", level="warn")
+            self._terminate(h)
+            h.retired = True
+            self._scale_down_state = None
+            self._pending_gauges()
+            tdt_log(f"[fleet] replica {h.idx} scaled down (retired)")
+            return True
+        return False
+
+    def autoscale(self) -> dict:
+        """JSON-safe autoscaler view (the ``/fleet/autoscale`` route):
+        config, EWMA demand, live/booting/retired sets, the in-progress
+        scale-down (if any), and the bounded event history."""
+        return {
+            "enabled": self._scale_max > 0,
+            "min_replicas": self._scale_min,
+            "max_replicas": self._scale_max,
+            "scale_up_at": self._scale_up_at,
+            "scale_down_at": self._scale_down_at,
+            "cooldown_s": self._scale_cooldown_s,
+            "alpha": self._scale_alpha,
+            "demand_ewma": round(self._demand_ewma, 4),
+            "live": [h.idx for h in self._replicas
+                     if h.alive and not h.retired],
+            "booting": [h.idx for h in self._replicas if h.booting],
+            "retired": [h.idx for h in self._replicas if h.retired],
+            "scale_down": None if self._scale_down_state is None
+            else dict(self._scale_down_state),
+            "pending": len(self._pending),
+            "pending_max": self._pending_max,
+            "tenant_weights": dict(self._tenant_weights),
+            "events": list(self._scale_events),
+        }
+
     def _poll_replica(self, h: ReplicaHandle) -> bool:
         if not h.inflight:
             return False
@@ -1173,7 +1501,17 @@ class Router:
         if had_inflight:
             telemetry.observe("tdt_fleet_migration_seconds",
                               time.monotonic() - t0)
-        if self._respawn_s > 0 and not h.health.breaker_tripped:
+        sd = self._scale_down_state
+        if sd is not None and sd["idx"] == h.idx:
+            # The scale-down target died mid-drain (kill -9 chaos): the
+            # journal-file replay above already saved its work — the slot
+            # retires instead of respawning, and the state machine clears.
+            h.retired = True
+            self._scale_down_state = None
+            self._pending_gauges()
+            tdt_log(f"[fleet] replica {h.idx} died mid-scale-down; "
+                    f"retired after journal-replay migration")
+        elif self._respawn_s > 0 and not h.health.breaker_tripped:
             h.respawning = True
             h.health.schedule_respawn(t0)
 
@@ -1357,6 +1695,7 @@ class Router:
             "replicas": [
                 {
                     "idx": h.idx, "alive": h.alive, "draining": h.draining,
+                    "retired": h.retired,
                     "gen": h.gen, "port": h.port,
                     "inflight": len(h.inflight),
                     "pid": None if h.proc is None else h.proc.pid,
@@ -1372,6 +1711,7 @@ class Router:
             "affinity": self.affinity,
             "postmortems": sorted(self._postmortems),
             "placement_ring": len(self._placement_ring),
+            "scale_events": len(self._scale_events),
         }
 
     # ------------------------------------------------------------- federation
@@ -1379,7 +1719,7 @@ class Router:
     #: route registry (trailing "/" = prefix route).
     FEDERATION_ROUTES = (
         "/fleet/metrics", "/fleet/topology", "/fleet/placements",
-        "/fleet/postmortem/", "/fleet/trace/",
+        "/fleet/autoscale", "/fleet/postmortem/", "/fleet/trace/",
     )
 
     def mount_routes(self) -> None:
@@ -1395,6 +1735,8 @@ class Router:
             "/fleet/topology", self._r_topology, methods=("GET",))
         introspect.register_json_route(
             "/fleet/placements", self._r_placements, methods=("GET",))
+        introspect.register_json_route(
+            "/fleet/autoscale", self._r_autoscale, methods=("GET",))
         introspect.register_json_route(
             "/fleet/postmortem/", self._r_postmortem, methods=("GET",))
         introspect.register_json_route(
@@ -1427,7 +1769,9 @@ class Router:
         local = telemetry.snapshot()
         for sec in ("counters", "gauges"):
             for name, entries in local.get(sec, {}).items():
-                if not name.startswith(("tdt_fleet_", "tdt_flight_")):
+                if not name.startswith(
+                    ("tdt_fleet_", "tdt_flight_", "tdt_tenant_")
+                ):
                     continue
                 merged[sec].setdefault(name, []).extend(
                     {"labels": {**e["labels"], "replica": "router"},
@@ -1435,7 +1779,9 @@ class Router:
                     for e in entries
                 )
         for name, entries in local.get("histograms", {}).items():
-            if not name.startswith(("tdt_fleet_", "tdt_flight_")):
+            if not name.startswith(
+                ("tdt_fleet_", "tdt_flight_", "tdt_tenant_")
+            ):
                 continue
             merged["histograms"].setdefault(name, []).extend(
                 {**e, "labels": {**e["labels"], "replica": "router"}}
@@ -1518,6 +1864,7 @@ class Router:
             entry = {
                 "idx": h.idx, "gen": h.gen, "port": h.port,
                 "alive": h.alive, "draining": h.draining,
+                "retired": h.retired,
                 "pid": None if h.proc is None else h.proc.pid,
                 "inflight": len(h.inflight),
                 "placements": h.placements,
@@ -1609,6 +1956,9 @@ class Router:
 
     def _r_placements(self, method, query, body) -> tuple[int, dict]:
         return 200, {"placements": self.placements()}
+
+    def _r_autoscale(self, method, query, body) -> tuple[int, dict]:
+        return 200, self.autoscale()
 
     def _r_postmortem(self, method, query, body, rest="") -> tuple[int, dict]:
         try:

@@ -5,7 +5,8 @@ so a server crash loses every in-flight request. The journal makes the
 request lifecycle durable with an append-only JSONL file the server writes
 as it goes and ``InferenceServer.recover`` replays on startup:
 
-``submit``   request admitted: id, prompt, max_new, priority, deadlines
+``submit``   request admitted: id, prompt, max_new, priority, tenant,
+             QoS weight, deadlines
 ``prefill``  first sampled token streamed (position 0)
 ``chunk``    a decode chunk's streamed tokens, with their start position
 ``cancel``   client cancel observed
@@ -59,6 +60,8 @@ class ReplayedRequest:
     max_new: int
     arrival_time_s: float | None = None
     priority: int = 0
+    tenant: str = "default"
+    weight: float = 1.0
     ttft_deadline_s: float | None = None
     deadline_s: float | None = None
     tokens: list[int] = dataclasses.field(default_factory=list)
@@ -241,6 +244,8 @@ class RequestJournal:
                     max_new=int(rec.get("max_new", 0)),
                     arrival_time_s=rec.get("arrival_time_s"),
                     priority=int(rec.get("priority", 0)),
+                    tenant=str(rec.get("tenant", "default")),
+                    weight=float(rec.get("weight", 1.0)),
                     ttft_deadline_s=rec.get("ttft_deadline_s"),
                     deadline_s=rec.get("deadline_s"),
                 )

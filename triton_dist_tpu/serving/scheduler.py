@@ -14,11 +14,15 @@ State machines::
     slot     FREE → PREFILL → DECODE → DONE → FREE       (join/evict cycle)
     request  QUEUED → RUNNING → DONE   |   REJECTED | CANCELLED
 
-Scheduling policy: FCFS by arrival. The pending queue keeps submission
-order; :meth:`Scheduler.join_free_slots` walks it in order and admits every
-request whose arrival time has passed into the lowest-indexed free slot —
-a request whose (synthetic) arrival lies in the future never blocks one
-behind it that has already arrived.
+Scheduling policy: weighted-fair across tenants, FCFS within a tenant.
+Every request carries a tenant id and a QoS weight; ``submit`` stamps a
+virtual finish tag (start-time = max(queue virtual clock, tenant's last
+tag); finish = start + ``max_new / weight``) and
+:meth:`Scheduler.join_free_slots` walks the pending queue in tag order —
+with a single tenant the tags are monotone in submission order, so the
+walk degrades to exactly the old FCFS. A request whose (synthetic)
+arrival lies in the future never blocks one behind it that has already
+arrived.
 
 Admission contract (KV-budget aware). Without a :class:`KVLedger` (legacy
 slot mode), a request is admitted only when ``len(prompt) + max_new <=
@@ -121,6 +125,15 @@ class Request:
     on_finish: Callable[["Request"], None] | None = None
     #: Shedding class: lower is MORE important (0 = never shed by default).
     priority: int = 1
+    #: Tenant identity (multi-tenant QoS): scopes prefix-cache reuse and
+    #: weighted-fair queueing; carried end-to-end through wire bodies and
+    #: journal records so it survives migration byte-identically.
+    tenant: str = "default"
+    #: Weighted-fair-queueing weight (higher = larger share of admissions).
+    weight: float = 1.0
+    #: WFQ virtual finish tag, assigned at submit/restore — the join walk
+    #: admits pending requests in tag order (pure FCFS with one tenant).
+    wfq_tag: float = 0.0
     #: SLO budgets, seconds from effective arrival (None = no bound).
     ttft_deadline_s: float | None = None
     deadline_s: float | None = None
@@ -200,30 +213,64 @@ class _PrefixNode:
 
 class PrefixIndex:
     """Radix trie over full prompt-token blocks (RadixAttention-style,
-    Zheng et al.). Each indexed node pins its block with one allocator ref
-    of its own, so a donor finishing (and freeing its chain) cannot recycle
-    a block that a later prompt may still match. Eviction drops
-    least-recently-used LEAVES only — an interior node's block backs every
-    chain below it. LRU uses a logical clock (ticked per lookup/register),
-    not wall time, so behavior is deterministic under test."""
+    Zheng et al.), one trie PER TENANT. Each indexed node pins its block
+    with one allocator ref of its own, so a donor finishing (and freeing
+    its chain) cannot recycle a block that a later prompt may still match.
+    Eviction drops least-recently-used LEAVES only — an interior node's
+    block backs every chain below it. LRU uses a logical clock (ticked per
+    lookup/register), not wall time, so behavior is deterministic under
+    test.
+
+    Tenant isolation: lookups and placement probes (:meth:`match_blocks`)
+    only ever walk the requesting tenant's trie — tenant A can neither
+    reuse nor *observe* (via placement timing) tenant B's warm prefixes.
+    ``TDT_TENANT_PREFIX_QUOTA`` caps each tenant's indexed blocks; under
+    pool pressure eviction prefers (1) the requester's own leaves, then
+    (2) leaves of tenants over their quota, then (3) the global LRU leaf.
+    The isolation invariant is therefore: a tenant at or under its quota
+    never loses a warm prefix to another tenant's demand unless the pool
+    cannot otherwise satisfy an admission (liveness beats strict isolation
+    — a request must never deadlock on blocks the index is hoarding)."""
 
     def __init__(self, allocator: BlockAllocator, block_size: int):
         self.allocator = allocator
         self.block_size = int(block_size)
-        self._root = _PrefixNode(-1)
+        self._roots: dict[str, _PrefixNode] = {}
         self._clock = 0
         self.num_blocks_indexed = 0
+        #: Indexed-block count per tenant (drives quota + gauges).
+        self._tenant_blocks: dict[str, int] = {}
+        #: Max indexed blocks per tenant (0 = unlimited).
+        self.tenant_quota = get_int_env("TDT_TENANT_PREFIX_QUOTA", 0)
 
     def _tick(self) -> int:
         self._clock += 1
         return self._clock
 
-    def lookup(self, prompt: list[int]) -> list[int]:
-        """Longest indexed chain of full prompt blocks, root-down. Touches
-        LRU stamps; takes NO refs — the caller pins before any eviction."""
+    def _root_for(self, tenant: str) -> _PrefixNode:
+        node = self._roots.get(tenant)
+        if node is None:
+            node = self._roots[tenant] = _PrefixNode(-1)
+        return node
+
+    def _note_blocks(self, tenant: str, delta: int) -> None:
+        n = self._tenant_blocks.get(tenant, 0) + delta
+        self._tenant_blocks[tenant] = n
+        telemetry.set_gauge("tdt_tenant_prefix_blocks", float(n), tenant=tenant)
+
+    def tenant_blocks(self, tenant: str) -> int:
+        """Blocks currently indexed for ``tenant``."""
+        return self._tenant_blocks.get(tenant, 0)
+
+    def lookup(self, prompt: list[int], tenant: str = "default") -> list[int]:
+        """Longest indexed chain of full prompt blocks, root-down, WITHIN
+        ``tenant``'s trie only. Touches LRU stamps; takes NO refs — the
+        caller pins before any eviction."""
         bs = self.block_size
-        node = self._root
+        node = self._roots.get(tenant)
         chain: list[int] = []
+        if node is None:
+            return chain
         t = self._tick()
         for i in range(len(prompt) // bs):
             child = node.children.get(tuple(prompt[i * bs:(i + 1) * bs]))
@@ -234,15 +281,19 @@ class PrefixIndex:
             node = child
         return chain
 
-    def match_blocks(self, prompt: list[int]) -> int:
-        """Longest indexed full-block prefix of ``prompt``, WITHOUT touching
-        LRU stamps or taking refs — the fleet placement-hint probe. Safe to
-        call from an endpoint thread: the walk only does dict lookups on
-        the trie (concurrent registration may make the answer one block
-        stale, which a *hint* can tolerate)."""
+    def match_blocks(self, prompt: list[int], tenant: str = "default") -> int:
+        """Longest indexed full-block prefix of ``prompt`` within
+        ``tenant``'s trie, WITHOUT touching LRU stamps or taking refs — the
+        fleet placement-hint probe. Tenant-scoped so placement affinity can
+        never leak one tenant's cached prompts to another through routing
+        timing. Safe to call from an endpoint thread: the walk only does
+        dict lookups on the trie (concurrent registration may make the
+        answer one block stale, which a *hint* can tolerate)."""
         bs = self.block_size
-        node = self._root
+        node = self._roots.get(tenant)
         n = 0
+        if node is None:
+            return n
         for i in range(len(prompt) // bs):
             child = node.children.get(tuple(prompt[i * bs:(i + 1) * bs]))
             if child is None:
@@ -251,14 +302,18 @@ class PrefixIndex:
             node = child
         return n
 
-    def register(self, prompt: list[int], blocks: list[int]) -> int:
+    def register(self, prompt: list[int], blocks: list[int],
+                 tenant: str = "default") -> int:
         """Index a finished prefill's FULL prompt blocks (``len(prompt) //
         block_size`` of them — decode writes only ever land past that
-        boundary, so indexed content is immutable). Existing nodes win on
-        collision (their content is equivalent); each new node takes one
-        allocator ref. Returns the number of newly indexed blocks."""
+        boundary, so indexed content is immutable) under ``tenant``'s trie.
+        Existing nodes win on collision (their content is equivalent); each
+        new node takes one allocator ref. A tenant at its quota recycles
+        its own LRU leaves to make room; if none predate this registration,
+        indexing stops (never detach the chain being registered). Returns
+        the number of newly indexed blocks."""
         bs = self.block_size
-        node = self._root
+        node = self._root_for(tenant)
         t = self._tick()
         added = 0
         for i in range(min(len(prompt) // bs, len(blocks))):
@@ -268,53 +323,113 @@ class PrefixIndex:
                 blk = int(blocks[i])
                 if blk == NULL_BLOCK:
                     break
+                if self.tenant_quota > 0 and not self._make_quota_room(
+                    tenant, exclude_tick=t
+                ):
+                    break
                 self.allocator.incref([blk])
                 child = _PrefixNode(blk)
                 node.children[key] = child
                 self.num_blocks_indexed += 1
+                self._note_blocks(tenant, +1)
                 added += 1
             child.last_used = t
             node = child
         return added
 
-    def evict(self, need_free: int) -> int:
+    def _make_quota_room(self, tenant: str, exclude_tick: int) -> bool:
+        """Recycle ``tenant``'s own LRU leaves until one more block fits
+        its quota. Leaves stamped at ``exclude_tick`` (the in-progress
+        registration's own path) are never victims."""
+        while self._tenant_blocks.get(tenant, 0) >= self.tenant_quota:
+            if not self._drop_leaf(
+                [tenant], cause="self", exclude_tick=exclude_tick
+            ):
+                return False
+        return True
+
+    def evict(self, need_free: int, tenant: str | None = None) -> int:
         """Drop LRU leaves until the allocator has ``need_free`` free blocks
-        or the index is empty. Dropping a leaf only frees its block when no
-        running slot still holds a ref — the loop keeps going either way.
-        Returns the number of index entries dropped."""
+        or the index is empty, in isolation-preserving preference order:
+        the requesting ``tenant``'s own leaves first, then leaves of
+        tenants over their quota, then the global LRU leaf (pool liveness
+        trumps isolation as the last resort). Dropping a leaf only frees
+        its block when no running slot still holds a ref — the loop keeps
+        going either way. Returns the number of index entries dropped."""
         dropped = 0
+        if tenant is not None:
+            while self.allocator.num_free < need_free:
+                if not self._drop_leaf([tenant], cause="self"):
+                    break
+                dropped += 1
+        if self.tenant_quota > 0:
+            while self.allocator.num_free < need_free:
+                over = [
+                    t for t, n in self._tenant_blocks.items()
+                    if n > self.tenant_quota
+                ]
+                if not over or not self._drop_leaf(over, cause="over_quota"):
+                    break
+                dropped += 1
         while self.allocator.num_free < need_free:
-            lru = self._lru_leaf()
-            if lru is None:
+            if not self._drop_leaf(None, cause="pressure"):
                 break
-            parent, key, node = lru
-            del parent.children[key]
-            self.num_blocks_indexed -= 1
-            self.allocator.free([node.block])
             dropped += 1
         return dropped
 
-    def _lru_leaf(self) -> tuple["_PrefixNode", tuple, "_PrefixNode"] | None:
+    def _drop_leaf(self, tenants: list[str] | None, cause: str,
+                   exclude_tick: int | None = None) -> bool:
+        """Remove the LRU leaf among ``tenants`` (None = all). Returns
+        False when no eligible leaf exists."""
+        lru = self._lru_leaf(tenants, exclude_tick=exclude_tick)
+        if lru is None:
+            return False
+        tname, parent, key, node = lru
+        del parent.children[key]
+        self.num_blocks_indexed -= 1
+        self._note_blocks(tname, -1)
+        self.allocator.free([node.block])
+        telemetry.inc(
+            "tdt_tenant_prefix_evictions_total", tenant=tname, cause=cause
+        )
+        return True
+
+    def _lru_leaf(
+        self, tenants: list[str] | None = None,
+        exclude_tick: int | None = None,
+    ) -> tuple[str, "_PrefixNode", tuple, "_PrefixNode"] | None:
         best = None
-        stack = [self._root]
-        while stack:
-            node = stack.pop()
-            for key, child in node.children.items():
-                if child.children:
-                    stack.append(child)
-                elif best is None or child.last_used < best[2].last_used:
-                    best = (node, key, child)
+        roots = (
+            self._roots.items() if tenants is None
+            else [(t, self._roots[t]) for t in tenants if t in self._roots]
+        )
+        for tname, root in roots:
+            stack = [root]
+            while stack:
+                node = stack.pop()
+                for key, child in node.children.items():
+                    if child.children:
+                        stack.append(child)
+                    elif exclude_tick is not None and (
+                        child.last_used >= exclude_tick
+                    ):
+                        continue
+                    elif best is None or child.last_used < best[3].last_used:
+                        best = (tname, node, key, child)
         return best
 
     def clear(self) -> None:
         """Drop every index entry (and its ref). Recovery-path reset."""
-        stack = [self._root]
-        while stack:
-            node = stack.pop()
-            for child in node.children.values():
-                stack.append(child)
-                self.allocator.free([child.block])
-            node.children.clear()
+        for tenant, root in self._roots.items():
+            stack = [root]
+            while stack:
+                node = stack.pop()
+                for child in node.children.values():
+                    stack.append(child)
+                    self.allocator.free([child.block])
+                node.children.clear()
+            if self._tenant_blocks.get(tenant):
+                self._note_blocks(tenant, -self._tenant_blocks[tenant])
         self.num_blocks_indexed = 0
 
 
@@ -355,7 +470,7 @@ class KVLedger:
         need_total = self.blocks_needed(len(req.prompt), req.max_new)
         shared: list[int] = []
         if self.prefix_reuse:
-            chain = self.prefix.lookup(req.prompt)
+            chain = self.prefix.lookup(req.prompt, req.tenant)
             shared = chain[: (len(req.prompt) - 1) // bs]
         if shared:
             # Pin BEFORE eviction so evicting a leaf on our own chain
@@ -363,7 +478,7 @@ class KVLedger:
             self.allocator.incref(shared)
         fresh_need = need_total - len(shared)
         if self.allocator.num_free < fresh_need:
-            dropped = self.prefix.evict(fresh_need)
+            dropped = self.prefix.evict(fresh_need, tenant=req.tenant)
             if dropped:
                 telemetry.inc("tdt_kv_evictions_total", float(dropped))
         fresh = self.allocator.alloc(fresh_need) if fresh_need > 0 else []
@@ -394,7 +509,7 @@ class KVLedger:
         freshly prefilled tail)."""
         if not self.prefix_reuse:
             return 0
-        return self.prefix.register(req.prompt, req.kv_blocks)
+        return self.prefix.register(req.prompt, req.kv_blocks, req.tenant)
 
     def make_writable(self, req: Request, block_idx: int) -> tuple[int, bool]:
         """Copy-on-write guard: ensure chain position ``block_idx`` is
@@ -463,6 +578,12 @@ class Scheduler:
         self._pending: collections.deque[Request] = collections.deque()
         self._next_id = 0
         self._lock = threading.Lock()
+        #: WFQ virtual time: the queue clock advances to each admitted
+        #: request's tag; per-tenant last-finish tags serialize one
+        #: tenant's requests while letting weights split the clock across
+        #: tenants (classic virtual-finish-time fair queueing).
+        self._wfq_clock = 0.0
+        self._wfq_last: dict[str, float] = {}
         self._ewma_tps = 0.0
         self._last_shed_now_s: float | None = None
         #: Set by ``InferenceServer.shutdown``: every subsequent submit is
@@ -481,7 +602,8 @@ class Scheduler:
                priority: int = 1, ttft_deadline_s: float | None = None,
                deadline_s: float | None = None,
                tokens=None,
-               trace_ctx: "tracing.SpanContext | None" = None) -> Request:
+               trace_ctx: "tracing.SpanContext | None" = None,
+               tenant: str = "default", weight: float = 1.0) -> Request:
         """Admission-check and enqueue one request (FCFS). Returns the
         request handle; a rejected request comes back with
         ``state=REJECTED`` and ``reject_reason`` set — it is NOT queued.
@@ -501,6 +623,7 @@ class Scheduler:
             arrival_time_s=float(arrival_time_s),
             on_token=on_token, on_finish=on_finish,
             priority=int(priority),
+            tenant=str(tenant), weight=float(weight),
             tokens=[int(t) for t in tokens] if tokens else [],
             ttft_deadline_s=(
                 _env_deadline("TDT_DEADLINE_TTFT_S")
@@ -518,6 +641,7 @@ class Scheduler:
             prompt_len=len(prompt), max_new=req.max_new,
         )
         telemetry.inc("tdt_serving_requests_total")
+        telemetry.inc("tdt_tenant_requests_total", tenant=req.tenant)
         if self.shutting_down:
             # Graceful shutdown: admitted work drains, new joins bounce with
             # a distinct reason so clients can retry against another server.
@@ -548,13 +672,20 @@ class Scheduler:
                 b for b in (req.ttft_deadline_s, self.shed_wait_s or None)
                 if b is not None
             ]
-            if est is not None and budgets and est > min(budgets):
+            if est is not None and budgets and est > min(budgets) and (
+                not self._tenant_under_share(req)
+            ):
                 # The EWMA capacity projection says this request would blow
                 # its TTFT budget (or the global shed budget) just queueing.
+                # A tenant holding less than its weighted fair share of the
+                # backlog is exempt: the wait it would blow is other
+                # tenants' work, and the WFQ walk will lift it past them —
+                # overload sheds the aggressor's tail, not the victim's.
                 return self._shed(req, "shed_overload", now)
         with self._lock:
             if self.queue_limit and len(self._pending) >= self.queue_limit:
                 return self._reject(req, "queue_full")
+            self._assign_wfq_tag_locked(req)
             self._pending.append(req)
             depth = len(self._pending)
         telemetry.set_gauge("tdt_serving_queue_depth", float(depth))
@@ -570,10 +701,45 @@ class Scheduler:
         req.state = RequestState.QUEUED
         with self._lock:
             self._next_id = max(self._next_id, req.req_id + 1)
+            self._assign_wfq_tag_locked(req)
             self._pending.append(req)
             depth = len(self._pending)
         telemetry.set_gauge("tdt_serving_queue_depth", float(depth))
         return req
+
+    def _assign_wfq_tag_locked(self, req: Request) -> None:
+        """Stamp ``req``'s WFQ virtual finish tag: start at the later of
+        the queue clock and the tenant's previous tag (serializing a
+        tenant's own requests), finish ``max_new / weight`` later — heavier
+        weights advance a tenant's virtual time more slowly, earning it a
+        proportionally larger admission share."""
+        start = max(self._wfq_clock, self._wfq_last.get(req.tenant, 0.0))
+        tag = start + req.max_new / max(req.weight, 1e-6)
+        self._wfq_last[req.tenant] = tag
+        req.wfq_tag = tag
+
+    def _tenant_under_share(self, req: Request) -> bool:
+        """True when ``req``'s tenant holds strictly less than its
+        weight-proportional share of the pending queue. Single-tenant
+        queues (and empty queues) return False, so the overload-shed path
+        is byte-identical to the pre-tenant scheduler until a second
+        tenant shows up."""
+        with self._lock:
+            if not self._pending:
+                return False
+            counts: dict[str, int] = {}
+            weights: dict[str, float] = {}
+            for r in self._pending:
+                counts[r.tenant] = counts.get(r.tenant, 0) + 1
+                weights[r.tenant] = max(
+                    weights.get(r.tenant, 0.0), r.weight
+                )
+            weights.setdefault(req.tenant, max(req.weight, 1e-6))
+            if len(weights) < 2:
+                return False
+            total_w = sum(weights.values()) or 1.0
+            share = len(self._pending) * weights[req.tenant] / total_w
+            return counts.get(req.tenant, 0) < share
 
     def _reject(self, req: Request, reason: str) -> Request:
         req.state = RequestState.REJECTED
@@ -587,6 +753,9 @@ class Scheduler:
         self._last_shed_now_s = now_s
         telemetry.inc(
             "tdt_serving_shed_total", reason=reason, priority=req.priority
+        )
+        telemetry.inc(
+            "tdt_tenant_shed_total", tenant=req.tenant, reason=reason
         )
         return self._reject(req, reason)
 
@@ -673,8 +842,11 @@ class Scheduler:
 
     # ------------------------------------------------------------------ joins
     def join_free_slots(self, now_s: float) -> list[Slot]:
-        """Admit arrived requests (FCFS) into free slots; each admitted
-        request's slot moves FREE→PREFILL. Returns the slots to prefill.
+        """Admit arrived requests into free slots in WFQ-tag order
+        (weighted-fair across tenants, FCFS within one — a single-tenant
+        queue's tags are monotone in submission order, so the walk is
+        exactly the old FCFS); each admitted request's slot moves
+        FREE→PREFILL. Returns the slots to prefill.
 
         The walk doubles as the queue-time expiry sweep: requests whose
         TTFT/total budget lapsed while queued are rejected here (with
@@ -686,8 +858,13 @@ class Scheduler:
         free = [s for s in self.slots if s.state is SlotState.FREE]
         with self._lock:
             deferred: collections.deque[Request] = collections.deque()
-            while self._pending:
-                req = self._pending.popleft()
+            # Stable sort: ties (same tag — impossible within a tenant,
+            # rare across) keep submission order.
+            queue = collections.deque(
+                sorted(self._pending, key=lambda r: r.wfq_tag)
+            )
+            while queue:
+                req = queue.popleft()
                 if req.state is RequestState.CANCELLED:
                     continue  # finalized by cancel() racing this sweep
                 if self._queue_expired(req, now_s):
@@ -713,6 +890,7 @@ class Scheduler:
                 req.arrived_at = max(req.submitted_at, req.arrival_time_s)
                 slot.state = SlotState.PREFILL
                 slot.request = req
+                self._wfq_clock = max(self._wfq_clock, req.wfq_tag)
                 joined.append(slot)
             self._pending = deferred
             depth = len(self._pending)
@@ -829,6 +1007,7 @@ class Scheduler:
                 ),
                 "n_tokens": len(r.tokens),
                 "priority": r.priority,
+                "tenant": r.tenant,
                 "kv_wait": r.kv_wait,
             }
             for r in head

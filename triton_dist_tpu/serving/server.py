@@ -424,10 +424,13 @@ class InferenceServer:
                on_token=None, on_finish=None, priority: int = 1,
                ttft_deadline_s: float | None = None,
                deadline_s: float | None = None,
-               trace_ctx=None) -> Request:
+               trace_ctx=None, tenant: str = "default",
+               weight: float = 1.0) -> Request:
         """Admission-check and enqueue one request; returns its handle
         (``state=REJECTED`` + ``reject_reason`` when not admitted). Admitted
-        requests are journaled (write-ahead) when a journal is attached.
+        requests are journaled (write-ahead) when a journal is attached —
+        including tenant identity and QoS weight, so migration replays
+        land in the survivor's per-tenant accounting byte-identically.
         ``trace_ctx`` (an extracted ``tracing.SpanContext``) makes the
         request trace continue a remote caller's trace — the fleet replica
         passes the router's propagated context through here."""
@@ -436,13 +439,15 @@ class InferenceServer:
             on_token=on_token, on_finish=on_finish, now_s=self._now(),
             priority=priority, ttft_deadline_s=ttft_deadline_s,
             deadline_s=deadline_s, trace_ctx=trace_ctx,
+            tenant=tenant, weight=weight,
         )
         if self._journal is not None and req.state is RequestState.QUEUED:
             # Rejections are never journaled: there is nothing to resume.
             self._journal.append(
                 "submit", req_id=req.req_id, prompt=req.prompt,
                 max_new=req.max_new, arrival_time_s=req.arrival_time_s,
-                priority=req.priority, ttft_deadline_s=req.ttft_deadline_s,
+                priority=req.priority, tenant=req.tenant,
+                weight=req.weight, ttft_deadline_s=req.ttft_deadline_s,
                 deadline_s=req.deadline_s,
             )
         return req
@@ -459,7 +464,8 @@ class InferenceServer:
                on_finish=None, priority: int = 1,
                ttft_deadline_s: float | None = None,
                deadline_s: float | None = None,
-               trace_ctx=None) -> Request:
+               trace_ctx=None, tenant: str = "default",
+               weight: float = 1.0) -> Request:
         """Admit a request MID-STREAM: ``tokens`` is the history another
         server already streamed for it (journal-replay migration — the
         fleet router moving an in-flight request off a dead or draining
@@ -477,6 +483,7 @@ class InferenceServer:
             now_s=self._now(), priority=priority,
             ttft_deadline_s=ttft_deadline_s, deadline_s=deadline_s,
             tokens=toks, trace_ctx=trace_ctx,
+            tenant=tenant, weight=weight,
         )
         if req.state is not RequestState.QUEUED:
             return req
@@ -485,7 +492,8 @@ class InferenceServer:
             self._journal.append(
                 "submit", req_id=req.req_id, prompt=req.prompt,
                 max_new=req.max_new, arrival_time_s=req.arrival_time_s,
-                priority=req.priority, ttft_deadline_s=req.ttft_deadline_s,
+                priority=req.priority, tenant=req.tenant,
+                weight=req.weight, ttft_deadline_s=req.ttft_deadline_s,
                 deadline_s=req.deadline_s,
             )
             if toks:
@@ -495,16 +503,18 @@ class InferenceServer:
         return req
 
     # ------------------------------------------------------------ fleet hooks
-    def placement_info(self, prompt) -> dict:
+    def placement_info(self, prompt, tenant: str = "default") -> dict:
         """Placement hint for a fleet router: how warm is this replica for
-        ``prompt`` (longest indexed full-block prefix) and how loaded is it
+        ``prompt`` (longest indexed full-block prefix, WITHIN ``tenant``'s
+        trie only — affinity can never leak another tenant's cached
+        prompts through routing timing) and how loaded is it
         (EWMA-projected wait + backlog). Read-only and thread-safe — the
         prefix probe never touches LRU stamps — so the introspect endpoint
         can serve it off the loop thread."""
         prompt = [int(t) for t in prompt]
         warm = 0
         if self.kv_ledger is not None and self.kv_ledger.prefix_reuse:
-            warm = self.kv_ledger.prefix.match_blocks(prompt)
+            warm = self.kv_ledger.prefix.match_blocks(prompt, tenant)
         est = self.scheduler.est_wait_s()
         return {
             "warm_blocks": warm,
@@ -1406,6 +1416,7 @@ class InferenceServer:
                 req_id=rid, prompt=list(rr.prompt), max_new=rr.max_new,
                 arrival_time_s=0.0, on_token=on_token, on_finish=on_finish,
                 priority=rr.priority,
+                tenant=rr.tenant, weight=rr.weight,
                 ttft_deadline_s=rr.ttft_deadline_s,
                 deadline_s=rr.deadline_s,
                 tokens=list(rr.tokens),

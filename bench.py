@@ -33,6 +33,25 @@ import jax
 import jax.numpy as jnp
 
 
+def _pctl(xs, *qs):
+    """The ONE quantile path in this file: every serving section used to
+    hand-roll ``sorted(xs)[...]`` with a slightly different rank
+    convention. They now all read quantiles off the same mergeable sketch
+    the live SLO engine serves (``runtime/telemetry.py`` ``Digest``, rank
+    ``int(q*(n-1))``, relative error ≤ ``DIGEST_ALPHA``), so a bench TTFT
+    p99 and a ``/fleet/slo`` p99 are the same estimator — validated
+    against the sorted-list oracle by ``bench_digest_oracle``. Returns one
+    float for a single q, a tuple for several; ``None`` entries for empty
+    input."""
+    from triton_dist_tpu.runtime import telemetry
+
+    d = telemetry.Digest()
+    for x in xs:
+        d.add(x)
+    vals = tuple(d.quantile(q) for q in qs)
+    return vals[0] if len(vals) == 1 else vals
+
+
 def bench_gemm(on_tpu):
     from triton_dist_tpu.kernels.gemm import GemmConfig, gemm, gemm_config_for
     from triton_dist_tpu.tools.timing import bench_device_time
@@ -770,6 +789,53 @@ def bench_prefill_overlap(on_tpu):
     return out
 
 
+def bench_digest_oracle(on_tpu):
+    """Digest-vs-oracle validation for the SLO engine's quantile sketch
+    (``runtime/telemetry.py`` ``Digest``, the estimator behind ``_pctl``
+    and ``/fleet/slo``): a deterministic heavy-tailed latency sample (20k
+    lognormal draws, the shape queueing gives TTFT) is answered three
+    ways — one digest, four per-"replica" digests merged (simulating
+    federation), and the sorted-list oracle at the same rank convention.
+    Gated: ``digest_oracle_within_bound_frac`` and
+    ``digest_oracle_merge_exact_frac`` (both must hold 1.0 — every
+    quantile inside the documented α relative-error bound, merged answer
+    bit-equal to the single-digest answer) and ``digest_oracle_p999_ms``
+    (deterministic sample, so any drift means the estimator itself
+    changed). Worst relative error is informational."""
+    import numpy as np
+
+    from triton_dist_tpu.runtime import telemetry
+
+    rng = np.random.default_rng(0)
+    xs = rng.lognormal(mean=-3.5, sigma=0.8, size=20_000)
+    single = telemetry.Digest()
+    shards = [telemetry.Digest() for _ in range(4)]
+    for i, v in enumerate(xs):
+        single.add(float(v))
+        shards[i % 4].add(float(v))
+    merged = telemetry.Digest()
+    for sh in shards:
+        merged.merge(sh)
+
+    s = sorted(float(v) for v in xs)
+    worst, within, exact = 0.0, 0, 0
+    qs = telemetry.DIGEST_QUANTILES
+    for q in qs:
+        oracle = s[int(q * (len(s) - 1))]
+        est = single.quantile(q)
+        rel = abs(est - oracle) / oracle
+        worst = max(worst, rel)
+        within += rel <= telemetry.DIGEST_ALPHA
+        exact += merged.quantile(q) == est
+    return {
+        "digest_oracle_samples": len(xs),
+        "digest_oracle_worst_rel_err": round(worst, 5),
+        "digest_oracle_within_bound_frac": round(within / len(qs), 3),
+        "digest_oracle_merge_exact_frac": round(exact / len(qs), 3),
+        "digest_oracle_p999_ms": round(1e3 * merged.quantile(0.999), 3),
+    }
+
+
 def bench_serving(on_tpu):
     """Continuous-batching offered-load sweep (the serving/ subsystem):
     drives an ``InferenceServer`` over 16 mixed prompt/gen requests at two
@@ -783,6 +849,7 @@ def bench_serving(on_tpu):
     import time
 
     from triton_dist_tpu.models import PRESETS, DenseLLM, Engine
+    from triton_dist_tpu.runtime import telemetry
     from triton_dist_tpu.runtime.mesh import initialize_distributed
     from triton_dist_tpu.serving import InferenceServer
 
@@ -812,6 +879,7 @@ def bench_serving(on_tpu):
     warm.run()
 
     for label, gap in (("burst", 0.0), ("steady", 0.02)):
+        good0 = telemetry.counter_total("tdt_slo_goodput_total")
         srv = InferenceServer(eng, num_slots=slots, chunk=chunk)
         handles = [
             srv.submit(p, g, arrival_time_s=i * gap)
@@ -821,12 +889,17 @@ def bench_serving(on_tpu):
         srv.run()
         wall = time.perf_counter() - t0
         toks = sum(len(h.tokens) for h in handles)
-        ttfts = sorted(h.ttft_s for h in handles if h.ttft_s is not None)
+        ttfts = [h.ttft_s for h in handles if h.ttft_s is not None]
+        p50, p99 = _pctl(ttfts, 0.5, 0.99)
         out[f"serving_{label}_tokens_per_s"] = round(toks / wall, 1)
-        out[f"serving_{label}_ttft_p50_ms"] = round(1e3 * ttfts[len(ttfts) // 2], 2)
-        out[f"serving_{label}_ttft_p99_ms"] = round(
-            1e3 * ttfts[min(len(ttfts) - 1, int(len(ttfts) * 0.99))], 2
-        )
+        out[f"serving_{label}_ttft_p50_ms"] = round(1e3 * p50, 2)
+        out[f"serving_{label}_ttft_p99_ms"] = round(1e3 * p99, 2)
+        if telemetry.enabled():
+            # Deadline-free requests: every clean finish is goodput, so a
+            # healthy run gates at 1.0 (any drop = requests dying in the
+            # serve loop, not an SLO tuning question).
+            good = telemetry.counter_total("tdt_slo_goodput_total") - good0
+            out[f"serving_{label}_goodput_frac"] = round(good / len(reqs), 3)
     return out
 
 
@@ -907,13 +980,12 @@ def bench_serving_paged(on_tpu):
             os.environ["TDT_PREFILL_CHUNK"] = prev_chunk
 
     toks = sum(len(h.tokens) for h in handles)
-    ttfts = sorted(h.ttft_s for h in handles if h.ttft_s is not None)
+    ttfts = [h.ttft_s for h in handles if h.ttft_s is not None]
     hits = telemetry.counter_total("tdt_kv_prefix_hits_total") - hits0
+    p50, p99 = _pctl(ttfts, 0.5, 0.99)
     out["serving_paged_tokens_per_s"] = round(toks / wall, 1)
-    out["serving_paged_ttft_p50_ms"] = round(1e3 * ttfts[len(ttfts) // 2], 2)
-    out["serving_paged_ttft_p99_ms"] = round(
-        1e3 * ttfts[min(len(ttfts) - 1, int(len(ttfts) * 0.99))], 2
-    )
+    out["serving_paged_ttft_p50_ms"] = round(1e3 * p50, 2)
+    out["serving_paged_ttft_p99_ms"] = round(1e3 * p99, 2)
     out["serving_paged_prefix_hit_rate"] = round(hits / len(reqs), 3)
     if srv.kv_ledger is not None:
         bs = srv.kv_ledger.block_size
@@ -1233,7 +1305,6 @@ def bench_serving_fleet_gray(on_tpu):
     check_bench_regression.py; the chaos suite's ``fleet-hang`` /
     ``fleet-flaky-wire`` / ``fleet-crash-loop`` rows assert the correctness
     side of the same arcs."""
-    import math
     import os
     import shutil
     import tempfile
@@ -1313,9 +1384,8 @@ def bench_serving_fleet_gray(on_tpu):
                 else:
                     out["serving_fleet_gray_tokens_per_s"] = round(best, 1)
                     if ttfts:
-                        rank = max(0, math.ceil(0.99 * len(ttfts)) - 1)
                         out["serving_fleet_gray_ttft_p99_ms"] = round(
-                            sorted(ttfts)[rank] * 1000.0, 1)
+                            _pctl(ttfts, 0.99) * 1000.0, 1)
         finally:
             shutil.rmtree(workdir, ignore_errors=True)
             if slow_ms is not None:
@@ -1374,7 +1444,6 @@ def bench_serving_fleet_autoscale(on_tpu):
     correctness bar (``serve_all`` raises on anything left behind); the
     chaos suite's ``fleet-scale-down-kill`` / ``fleet-tenant-burst`` rows
     assert the byte-parity side of the same arcs."""
-    import math
     import os
     import shutil
     import tempfile
@@ -1474,9 +1543,8 @@ def bench_serving_fleet_autoscale(on_tpu):
                 + sum(1 for fr in trickle if fr.done))
             ttfts = [s["ttft"] for s in states if "ttft" in s]
             if ttfts:
-                rank = max(0, math.ceil(0.99 * len(ttfts)) - 1)
                 out["serving_fleet_autoscale_ttft_p99_ms"] = round(
-                    sorted(ttfts)[rank] * 1000.0, 1)
+                    _pctl(ttfts, 0.99) * 1000.0, 1)
     finally:
         shutil.rmtree(workdir, ignore_errors=True)
         for k, v in prev.items():
@@ -1552,12 +1620,12 @@ def bench_moe_decode(on_tpu):
         srv.run()
         wall = time.perf_counter() - t0
         toks = sum(len(h.tokens) for h in handles)
-        ttfts = sorted(h.ttft_s for h in handles if h.ttft_s is not None)
-        tp = sorted(h.tpot_s for h in handles if h.tpot_s is not None)
-        tpots[label] = tp[len(tp) // 2]
+        ttfts = [h.ttft_s for h in handles if h.ttft_s is not None]
+        tp = [h.tpot_s for h in handles if h.tpot_s is not None]
+        tpots[label] = _pctl(tp, 0.5)
         out[f"moe_decode_{label}tokens_per_s"] = round(toks / wall, 1)
         out[f"moe_decode_{label}ttft_p50_ms"] = round(
-            1e3 * ttfts[len(ttfts) // 2], 2)
+            1e3 * _pctl(ttfts, 0.5), 2)
         out[f"moe_decode_{label}tpot_p50_ms"] = round(1e3 * tpots[label], 3)
     # The a2a latency split: AUTO(low-latency) vs forced-XLA tpot on the
     # SAME model. Signed percentage, informational (at world=1 both routes
@@ -1664,10 +1732,10 @@ def bench_mega_serving(on_tpu):
         srv.run()
         wall = time.perf_counter() - t0
         toks = sum(len(h.tokens) for h in handles)
-        ttfts = sorted(h.ttft_s for h in handles if h.ttft_s is not None)
-        tpots = sorted(h.tpot_s for h in handles if h.tpot_s is not None)
+        ttfts = [h.ttft_s for h in handles if h.ttft_s is not None]
+        tpots = [h.tpot_s for h in handles if h.tpot_s is not None]
         return ([list(h.tokens) for h in handles], round(toks / wall, 1),
-                ttfts[len(ttfts) // 2], tpots[len(tpots) // 2])
+                _pctl(ttfts, 0.5), _pctl(tpots, 0.5))
 
     for label, model in (("", dense), ("moe_", moe)):
         refs, xla_tps, _, _ = serve_all(model, "xla")
@@ -2375,6 +2443,17 @@ def main():
         emit()
     else:
         extra["prefill_overlap_skipped"] = "budget"
+    if remaining() > 10:
+        # Pure-CPU digest math, sub-second: validates the quantile
+        # estimator every serving percentile below reads through.
+        phase("digest_oracle")
+        try:
+            absorb(bench_digest_oracle(on_tpu))
+        except Exception as e:  # noqa: BLE001
+            extra["digest_oracle_error"] = f"{type(e).__name__}"
+        emit()
+    else:
+        extra["digest_oracle_skipped"] = "budget"
     if remaining() > 45:
         phase("serving")
         try:

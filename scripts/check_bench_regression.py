@@ -22,8 +22,8 @@ Accepted input shapes (auto-detected, mixable):
 Direction rules (by metric-name suffix/infix; anything else is
 *informational* — reported, never gated)::
 
-    higher is better   _tflops  _tokens_per_s  _speedup*  _vs_xla  _frac
-    lower is better    _ms  _us  _seconds  *_ttft_*
+    higher is better   _tflops  _tokens_per_s  _speedup*  _vs_xla  _frac  *_goodput*
+    lower is better    _ms  _us  _seconds  *_ttft_*  *_p999_*
 
 Zero/missing baselines are skipped (a 0.0 baseline is a dead-tunnel
 artifact, not a number to regress from — see BENCH_r01-r05). Exit codes:
@@ -39,9 +39,13 @@ import sys
 DEFAULT_TOL = 0.10
 
 HIGHER_SUFFIXES = ("_tflops", "_tokens_per_s", "_vs_xla", "_frac")
-HIGHER_INFIXES = ("_speedup",)
+# _goodput covers both the counter form (..._goodput_total) and the
+# fraction form (..._goodput_frac) of the SLO engine's headline metric.
+HIGHER_INFIXES = ("_speedup", "_goodput")
 LOWER_SUFFIXES = ("_ms", "_us", "_seconds")
-LOWER_INFIXES = ("_ttft_",)
+# _p999_ gates tail latencies from the digest sketch (e.g.
+# digest_oracle_p999_ms) the same way _ttft_ gates first-token latency.
+LOWER_INFIXES = ("_ttft_", "_p999_")
 
 
 def direction(name: str) -> str:

@@ -47,7 +47,8 @@ DEFAULT_ROOTS = (REPO / "triton_dist_tpu", REPO / "bench.py", REPO / "scripts")
 WAIVER = "# metric-name-ok:"
 
 #: Registry entry points whose first argument is a METRIC name.
-METRIC_FNS = {"inc", "observe", "set_gauge", "counter_value", "counter_total"}
+METRIC_FNS = {"inc", "observe", "set_gauge", "counter_value", "counter_total",
+              "observe_digest", "digest_quantile", "digest_merged"}
 #: Entry point whose first argument is an event KIND.
 EVENT_FNS = {"emit", "events"}
 #: Tracing entry points whose first argument is a SPAN name, recognized on
@@ -157,6 +158,18 @@ REQUIRED_NAMES = {
     "tdt_tenant_shed_total",
     "tdt_tenant_prefix_blocks",
     "tdt_tenant_prefix_evictions_total",
+    # live SLO engine: per-tenant TTFT/TPOT/e2e digests, goodput vs
+    # violation counters, burn-rate alerting, and step-phase profiling
+    # (runtime/slo.py, fleet/router.py, models/engine.py) — see
+    # docs/observability.md "SLO engine"
+    "tdt_slo_ttft_seconds",
+    "tdt_slo_tpot_seconds",
+    "tdt_slo_e2e_seconds",
+    "tdt_slo_goodput_total",
+    "tdt_slo_violations_total",
+    "tdt_slo_burn_rate",
+    "tdt_slo_alerts_total",
+    "tdt_engine_phase_seconds",
     # span names
     "tdt_serving_probe",
     "tdt_serving_restore",

@@ -46,6 +46,11 @@
 #                       only aggressor requests shed (queue_full, lowest
 #                       tier first); the victim tenant's streams finish
 #                       byte-exact with warm within-tenant prefix hits (NEW)
+#   slo-burn-alert      tenant burst burns its error budget -> the router
+#                       pump's multi-window burn-rate monitor fires exactly
+#                       one slo_alert event, holds under hysteresis, and
+#                       clears exactly once after recovery — asserted via
+#                       the telemetry event ring (NEW)
 #   observability       chaos arcs stay visible in traces + telemetry
 #
 # The env pins below make the arcs quick and reproducible:
@@ -115,6 +120,8 @@ run_scenario fleet-scale-down-kill \
   tests/test_fleet.py::test_fleet_scale_down_kill_mid_drain_zero_loss "$@"
 run_scenario fleet-tenant-burst \
   tests/test_fleet.py::test_fleet_tenant_burst_sheds_only_aggressor "$@"
+run_scenario slo-burn-alert \
+  tests/test_fleet.py::test_slo_burn_alert_fires_and_clears_once "$@"
 run_scenario observability tests/test_telemetry.py tests/test_tracing.py "$@"
 
 echo

@@ -11,7 +11,12 @@ takes either: a path, or an ``http://host:port`` base URL (the CLI fetches
 Usage::
 
     python scripts/tdt_metrics.py show SRC          # human-readable summary
+    python scripts/tdt_metrics.py show SRC --quantiles
+                                                    # + full digest quantile
+                                                    # table (p50..p999)
     python scripts/tdt_metrics.py prom SRC          # Prometheus exposition
+                                                    # (digests render as
+                                                    # summary-quantile lines)
     python scripts/tdt_metrics.py trace <id|last> SRC   # span tree of one
                                                         # request trace
     python scripts/tdt_metrics.py watch SRC [-n SECS] [-c COUNT]
@@ -61,7 +66,7 @@ def _fmt_labels(labels: dict) -> str:
     return "{" + ",".join(f"{k}={v}" for k, v in labels.items()) + "}"
 
 
-def cmd_show(path: str) -> int:
+def cmd_show(path: str, quantiles: bool = False) -> int:
     snap = _load(path)
     print(f"telemetry snapshot: {path} (enabled={snap.get('enabled')})")
     counters = snap.get("counters", {})
@@ -95,6 +100,41 @@ def cmd_show(path: str) -> int:
                     f"  {name}{_fmt_labels(e['labels'])}: count={n} "
                     f"mean={mean:.6g}s p50<={q50} p95<={q95}"
                 )
+    digests = snap.get("digests", {})
+    if digests:
+        print("\ndigests (mergeable quantile sketches, "
+              f"rel. error {_digest_alpha(digests):g}):")
+        for name, entries in digests.items():
+            for e in entries:
+                qs = e.get("quantiles") or {}
+                n = e["count"]
+                mean = e["sum"] / n if n else 0.0
+                if quantiles:
+                    # Recompute any quantile from the serialized sketch —
+                    # the full table, not just the pre-attached ones.
+                    from triton_dist_tpu.runtime import telemetry
+
+                    d = telemetry.Digest.from_dict(e)
+                    row = " ".join(
+                        f"p{q * 100:g}={d.quantile(q):.6g}"
+                        for q in (0.5, 0.9, 0.95, 0.99, 0.999)
+                        if d.quantile(q) is not None
+                    )
+                    mn, mx = e.get("min"), e.get("max")
+                    print(
+                        f"  {name}{_fmt_labels(e['labels'])}: count={n} "
+                        f"mean={mean:.6g} "
+                        f"min={'-' if mn is None else f'{mn:.6g}'} "
+                        f"max={'-' if mx is None else f'{mx:.6g}'}\n    {row}"
+                    )
+                else:
+                    p50, p99 = qs.get("p50"), qs.get("p99")
+                    print(
+                        f"  {name}{_fmt_labels(e['labels'])}: count={n} "
+                        f"mean={mean:.6g} "
+                        f"p50={'-' if p50 is None else f'{p50:.6g}'} "
+                        f"p99={'-' if p99 is None else f'{p99:.6g}'}"
+                    )
     evs = snap.get("events", [])
     if evs:
         print(f"\nevents ({len(evs)} in ring, newest last):")
@@ -121,6 +161,14 @@ def cmd_show(path: str) -> int:
             print(f"  trace {t['trace_id']}: "
                   f"{root['name'] if root else '?'}, {len(t['spans'])} span(s)")
     return 0
+
+
+def _digest_alpha(digests: dict) -> float:
+    for entries in digests.values():
+        for e in entries:
+            if "alpha" in e:
+                return float(e["alpha"])
+    return 0.0
 
 
 def cmd_prom(path: str) -> int:
@@ -332,7 +380,12 @@ def cmd_demo(out: str | None) -> int:
 
 def main(argv: list[str]) -> int:
     if len(argv) >= 2 and argv[0] == "show":
-        return cmd_show(argv[1])
+        quantiles = "--quantiles" in argv[1:]
+        rest = [a for a in argv[1:] if a != "--quantiles"]
+        if len(rest) != 1:
+            print("usage: show SRC [--quantiles]", file=sys.stderr)
+            return 2
+        return cmd_show(rest[0], quantiles=quantiles)
     if len(argv) >= 2 and argv[0] == "prom":
         return cmd_prom(argv[1])
     if len(argv) >= 3 and argv[0] == "trace":

@@ -791,6 +791,103 @@ def test_merge_scrapes_sums_counters_and_histograms():
     assert 'tdt_serving_tokens_total{replica="0"} 10' in text
 
 
+def test_merge_scrapes_digest_federation_is_exact():
+    """ISSUE 18 acceptance: the fleet-wide quantiles from merged
+    per-replica digests EQUAL the single-digest answer over the union
+    stream (merge invariance — log-γ bucket counts sum per key), and
+    both stay within DIGEST_ALPHA of the sorted-list oracle."""
+    rng = np.random.default_rng(3)
+    samples = [float(v) for v in rng.lognormal(-3.0, 0.9, size=6_000)]
+    single = telemetry.Digest()
+    shards = [telemetry.Digest() for _ in range(3)]
+    for i, v in enumerate(samples):
+        single.add(v)
+        shards[i % 3].add(v)
+    scrapes = [
+        (idx, {"digests": {"tdt_slo_ttft_seconds": [
+            telemetry.digest_entry({"tenant": "vip", "tier": "0"}, d)]}})
+        for idx, d in enumerate(shards)
+    ]
+    m = Router._merge_scrapes(scrapes)
+    entries = m["digests"]["tdt_slo_ttft_seconds"]
+    fleet = entries[0]                       # the merged (fleet-wide) series
+    assert "replica" not in fleet["labels"] and fleet["count"] == len(samples)
+    merged_d = telemetry.Digest.from_dict(fleet)
+    s = sorted(samples)
+    for q in telemetry.DIGEST_QUANTILES:
+        assert merged_d.quantile(q) == single.quantile(q)    # bit-exact
+        oracle = s[int(q * (len(s) - 1))]
+        assert (abs(merged_d.quantile(q) - oracle) / oracle
+                <= telemetry.DIGEST_ALPHA)
+    # digest_entry precomputed the same quantiles into the payload...
+    assert fleet["quantiles"]["p99"] == single.quantile(0.99)
+    # ...the per-replica series ride alongside, replica-labeled...
+    assert sum("replica" in e["labels"] for e in entries) == 3
+    # ...and the merged dict renders as Prometheus summary text.
+    text = telemetry.to_prometheus(m)
+    assert "# TYPE tdt_slo_ttft_seconds summary" in text
+    assert 'quantile="0.99"' in text
+
+
+@pytest.mark.chaos
+def test_slo_burn_alert_fires_and_clears_once(monkeypatch, tmp_path):
+    """Chaos acceptance (the ``slo-burn-alert`` suite row): an aggressor
+    tenant's burst into a bounded router queue burns its error budget —
+    the pump's burn-rate monitor fires EXACTLY one ``slo_alert``, holds
+    while the fast window is hot (hysteresis), and clears EXACTLY once
+    after recovery. Deterministic: no replica processes (every replica
+    retired, so the burst parks then sheds ``queue_full``), pinned tiny
+    windows, pump driven by hand."""
+    monkeypatch.setenv("TDT_FLEET_PENDING_MAX", "2")
+    monkeypatch.setenv("TDT_SLO_FAST_WINDOW_S", "0.4")
+    monkeypatch.setenv("TDT_SLO_SLOW_WINDOW_S", "0.8")
+    monkeypatch.setenv("TDT_SLO_MIN_EVENTS", "5")
+    router = Router(1, tmp_path)
+    try:
+        for h in router.replicas:
+            h.retired = True                 # no eligible replica: park
+        burst = [router.submit([40 + i, 7], 2, tenant="agg")
+                 for i in range(10)]
+        shed = [fr for fr in burst if fr.done]
+        assert len(shed) == 8
+        assert all(fr.finish_reason == "queue_full" for fr in shed)
+
+        # The burst's sheds are in the monitor; the NEXT pump tick fires.
+        router.pump()
+        alerts = telemetry.events("slo_alert")
+        assert len(alerts) == 1
+        assert alerts[0]["tenant"] == "agg" and alerts[0]["state"] == "fire"
+        assert telemetry.counter_value(
+            "tdt_slo_alerts_total", tenant="agg", state="fire") == 1.0
+        # Hysteresis: pumping while the fast window is hot re-fires NOTHING.
+        router.pump()
+        router.pump()
+        assert len(telemetry.events("slo_alert")) == 1
+        slo_view = router.fleet_slo()
+        assert slo_view["burn"]["agg"]["firing"] is True
+        assert slo_view["burn"]["agg"]["fast_burn"] >= 14.0
+
+        # Recovery: the fast window drains past the burst -> one clear.
+        time.sleep(0.45)
+        router.pump()
+        alerts = telemetry.events("slo_alert")
+        assert [a["state"] for a in alerts] == ["fire", "clear"]
+        assert telemetry.counter_value(
+            "tdt_slo_alerts_total", tenant="agg", state="clear") == 1.0
+        router.pump()                        # quiet: no flapping
+        assert len(telemetry.events("slo_alert")) == 2
+        slo_view = router.fleet_slo()
+        agg = slo_view["burn"]["agg"]
+        # Fast window drained (burn 0); the slow window may still hold the
+        # burst — clearing is the FAST window's call, by design.
+        assert agg["firing"] is False
+        assert (agg["fires"], agg["clears"]) == (1, 1)
+        assert agg["fast_burn"] == 0.0
+        assert [a["state"] for a in slo_view["alerts"]] == ["fire", "clear"]
+    finally:
+        router.shutdown()
+
+
 def test_placement_audit_ring_records_why_and_is_bounded(monkeypatch,
                                                          tmp_path):
     monkeypatch.setenv("TDT_FLEET_PLACEMENT_RING", "4")

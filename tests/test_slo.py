@@ -349,3 +349,198 @@ def test_mid_decode_deadline_truncates_with_distinct_reason(model1):
     assert telemetry.counter_value("tdt_serving_requests_completed_total") == 1.0
     snap = telemetry.snapshot()["histograms"]
     assert snap["tdt_serving_deadline_overrun_seconds"][0]["count"] == 1
+
+
+# ================================================== live SLO engine (PR 18)
+
+
+def test_record_finish_classifies_against_own_deadlines():
+    """Pure-host outcome accounting: each request is judged by ITS OWN
+    deadline fields; outcomes land in goodput/violation counters and
+    per-(tenant, tier) latency digests."""
+    from triton_dist_tpu.runtime import slo
+
+    class R:
+        def __init__(self, **kw):
+            self.tenant = kw.get("tenant", "default")
+            self.priority = kw.get("priority", 1)
+            self.ttft_deadline_s = kw.get("ttft_deadline_s")
+            self.deadline_s = kw.get("deadline_s")
+            self.arrived_at = kw.get("arrived_at", 0.0)
+            self.finished_at = kw.get("finished_at", 1.0)
+            self.ttft_s = kw.get("ttft_s", 0.1)
+            self.tpot_s = kw.get("tpot_s", 0.01)
+
+    # No deadline = the SLO is trivially met.
+    assert slo.record_finish(R(tenant="a"), "ok") == "met"
+    # Met its explicit budgets.
+    assert slo.record_finish(
+        R(tenant="a", ttft_deadline_s=0.5, deadline_s=2.0), "ok") == "met"
+    # Blew the TTFT budget (checked before the e2e one).
+    assert slo.record_finish(
+        R(tenant="a", ttft_s=0.9, ttft_deadline_s=0.5, deadline_s=0.5),
+        "ok") == "ttft_deadline"
+    # Blew the total budget.
+    assert slo.record_finish(
+        R(tenant="a", finished_at=3.0, deadline_s=2.0), "ok") == "deadline"
+    # A non-ok finish IS the violation reason (mid-decode truncation).
+    assert slo.record_finish(R(tenant="b"), "deadline") == "deadline"
+    # Cancels spend no error budget in either direction.
+    assert slo.record_finish(R(tenant="b"), "cancelled") is None
+
+    assert telemetry.counter_value(
+        "tdt_slo_goodput_total", tenant="a", tier="1") == 2.0
+    assert telemetry.counter_value(
+        "tdt_slo_violations_total", tenant="a", tier="1",
+        reason="ttft_deadline") == 1.0
+    assert telemetry.counter_value(
+        "tdt_slo_violations_total", tenant="b", tier="1",
+        reason="deadline") == 1.0
+    # Latency digests are per-(tenant, tier); cancels recorded nothing
+    # (tenant b saw one non-cancel finish).
+    assert telemetry.digest_merged("tdt_slo_ttft_seconds").n == 5
+    s = slo.slo_summary()
+    assert s["tenants"]["a"]["goodput_frac"] == pytest.approx(0.5)
+    assert "1" in s["tenants"]["a"]["tiers"]
+    assert s["tenants"]["a"]["tiers"]["1"]["ttft"]["count"] == 4
+
+
+def test_record_reject_counts_only_capacity_violations():
+    from triton_dist_tpu.runtime import slo
+
+    class R:
+        tenant, priority = "agg", 2
+
+    assert slo.record_reject(R(), "queue_full") == "queue_full"
+    assert slo.record_reject(R(), "shed_overload") == "shed_overload"
+    # Client-fixable rejects are neither goodput nor violations.
+    assert slo.record_reject(R(), "empty") is None
+    assert slo.record_reject(R(), "kv_budget") is None
+    assert telemetry.counter_total("tdt_slo_violations_total") == 2.0
+
+
+def test_burn_rate_monitor_fire_clear_hysteresis():
+    """The multi-window state machine under a pinned clock: a burst fires
+    exactly once (both windows hot, min_events met), stays firing while
+    the fast window is hot, and clears exactly once when it drains —
+    sustained healthy traffic never fires."""
+    from triton_dist_tpu.runtime import slo
+
+    mon = slo.BurnRateMonitor(
+        "agg", objective=0.99, fast_window_s=10.0, slow_window_s=60.0,
+        fast_burn=14.0, slow_burn=6.0, clear_burn=1.0, min_events=5,
+    )
+    # Healthy traffic: burn 0, never fires.
+    for i in range(20):
+        mon.record(True, float(i) * 0.1)
+    assert mon.tick(2.0) is None and not mon.firing
+
+    # Burst: 10 violations inside the fast window.
+    for i in range(10):
+        mon.record(False, 3.0 + i * 0.1)
+    assert mon.tick(4.0) == "fire"
+    fast, slow = mon.burn_rates(4.0)
+    assert fast >= 14.0 and slow >= 6.0
+    # Still hot: no second fire (hysteresis — one burst, one alert).
+    assert mon.tick(5.0) is None and mon.firing
+
+    # The fast window drains past the burst: exactly one clear.
+    assert mon.tick(15.0) == "clear"
+    assert mon.tick(16.0) is None and not mon.firing
+    assert (mon.fires, mon.clears) == (1, 1)
+
+    # Sub-threshold background errors (1% at a 99% objective = burn 1.0)
+    # never fire: that is the budget, not an incident.
+    mon2 = slo.BurnRateMonitor(
+        "bg", objective=0.9, fast_window_s=10.0, slow_window_s=10.0,
+        fast_burn=14.0, slow_burn=6.0, min_events=5,
+    )
+    for i in range(100):
+        mon2.record(i % 10 != 0, 5.0)   # 10% bad = burn 1.0 exactly
+    assert mon2.tick(5.0) is None and not mon2.firing
+
+
+def test_server_finish_feeds_slo_engine_and_slo_route(model1):
+    """End-to-end on a live server: finishes land in per-tenant digests
+    and goodput counters (tiered by priority), a mid-decode deadline
+    truncation lands as that tenant's violation, and the /slo introspect
+    route serves the rollup plus the engine's step-phase digests."""
+    eng = make_engine(model1)
+    srv = InferenceServer(eng, num_slots=2, chunk=2)
+    warm = srv.submit([3, 17, 42], max_new=2)
+    srv.run()
+    assert warm.done
+
+    a = srv.submit([1, 2, 3], max_new=4, tenant="vip", priority=0,
+                   deadline_s=60.0)
+    b = srv.submit([4, 5], max_new=3, tenant="batch", priority=2)
+    srv.run()
+    assert a.finish_reason == "ok" and b.finish_reason == "ok"
+    assert telemetry.counter_value(
+        "tdt_slo_goodput_total", tenant="vip", tier="0") == 1.0
+    assert telemetry.counter_value(
+        "tdt_slo_goodput_total", tenant="batch", tier="2") == 1.0
+    assert telemetry.digest_quantile(
+        "tdt_slo_ttft_seconds", 0.5, tenant="vip", tier="0") is not None
+
+    # Blow a budget mid-decode: the truncation is vip's violation.
+    r = srv.submit([3, 17, 42], max_new=20, deadline_s=0.3, tenant="vip",
+                   priority=0)
+    srv.step()
+    time.sleep(0.35)
+    srv.step()
+    assert r.finish_reason == "deadline"
+    assert telemetry.counter_value(
+        "tdt_slo_violations_total", tenant="vip", tier="0",
+        reason="deadline") == 1.0
+
+    code, payload = srv._r_slo("GET", "", None)
+    assert code == 200
+    vip = payload["tenants"]["vip"]
+    assert vip["goodput"] == 1.0 and vip["violations"] == 1.0
+    assert vip["goodput_frac"] == pytest.approx(0.5)
+    assert vip["tiers"]["0"]["ttft"]["count"] >= 1
+    assert "p99" in vip["tiers"]["0"]["ttft"]
+    # Step-phase digests: the serve loop stamped admission/dispatch/
+    # host_sync for this (xla) backend.
+    phases = payload["phases"]["xla"]
+    for phase in ("admission", "dispatch", "host_sync"):
+        assert phases[phase]["count"] > 0, phases.keys()
+    assert payload["alpha"] == telemetry.DIGEST_ALPHA
+
+    # The route is live on the introspection registry and unmounts at
+    # shutdown.
+    entry, _ = introspect._resolve_route("/slo")
+    assert entry is not None
+    srv.shutdown()
+    entry, _ = introspect._resolve_route("/slo")
+    assert entry is None
+
+
+def test_slo_sites_are_noops_when_telemetry_disabled(model1):
+    """TDT_TELEMETRY=0 contract: every SLO instrumentation site reduces to
+    the cached-bool early return — zero registry writes, no burn-rate
+    events, and the engine's phase fences never run."""
+    from triton_dist_tpu.runtime import slo
+
+    telemetry.reset(enabled_override=False)
+    try:
+        eng = make_engine(model1)
+        srv = InferenceServer(eng, num_slots=1, chunk=2)
+        r = srv.submit([1, 2, 3], max_new=3, tenant="vip", deadline_s=60.0)
+        srv.run()
+        assert r.done
+
+        class R:
+            tenant, priority = "x", 1
+            ttft_deadline_s = deadline_s = None
+            arrived_at, finished_at = 0.0, 1.0
+            ttft_s, tpot_s = 0.1, 0.01
+
+        assert slo.record_finish(R(), "ok") is None
+        assert slo.record_reject(R(), "queue_full") is None
+        snap = telemetry.snapshot()
+        assert snap["counters"] == {} and snap["digests"] == {}
+        srv.shutdown()
+    finally:
+        telemetry.reset()

@@ -100,11 +100,14 @@ def test_disabled_is_noop():
     telemetry.inc("tdt_test_ops_total")
     telemetry.observe("tdt_test_lat_seconds", 1.0)
     telemetry.set_gauge("tdt_test_level", 3.0)
+    telemetry.observe_digest("tdt_test_lat2_seconds", 1.0)
     telemetry.emit("tick")
     assert telemetry.counter_value("tdt_test_ops_total") == 0.0
+    assert telemetry.digest_quantile("tdt_test_lat2_seconds", 0.5) is None
     snap = telemetry.snapshot()
     assert snap["counters"] == {} and snap["histograms"] == {}
     assert snap["gauges"] == {} and snap["events"] == []
+    assert snap["digests"] == {}
     assert telemetry.summary()["counters"] == {}
 
 
@@ -149,6 +152,112 @@ def test_dump_and_cli_show(tmp_path):
     assert r.returncode == 0, r.stdout + r.stderr
     assert "tdt_test_ops_total{backend=xla} = 1" in r.stdout
     assert "tdt_test_lat_seconds" in r.stdout and "tick" in r.stdout
+
+
+# ------------------------------------------------------------------- digests
+
+
+def _oracle(samples, q):
+    """The sorted-list oracle at the digest's rank convention."""
+    s = sorted(samples)
+    return s[int(q * (len(s) - 1))]
+
+
+def test_digest_relative_error_bound_vs_oracle():
+    """Acceptance: every documented quantile of a 10k+ heavy-tailed sample
+    is within DIGEST_ALPHA relative error of the sorted-list oracle."""
+    rng = np.random.default_rng(7)
+    samples = [float(v) for v in rng.lognormal(-3.0, 1.0, size=12_000)]
+    d = telemetry.Digest()
+    for v in samples:
+        d.add(v)
+    assert d.n == len(samples)
+    for q in telemetry.DIGEST_QUANTILES:
+        oracle = _oracle(samples, q)
+        est = d.quantile(q)
+        assert abs(est - oracle) / oracle <= telemetry.DIGEST_ALPHA, (
+            q, est, oracle)
+    # Estimates are clamped into the observed range.
+    assert min(samples) <= d.quantile(0.999) <= max(samples)
+
+
+def test_digest_merge_associative_commutative():
+    """Merging per-replica digests is order- and grouping-independent and
+    equals the single-observer digest EXACTLY (bucket-for-bucket), so
+    fleet-wide percentiles from /fleet/metrics equal the single-digest
+    answer bit-for-bit."""
+    rng = np.random.default_rng(11)
+    samples = [float(v) for v in rng.lognormal(-3.5, 0.8, size=4_000)]
+    single = telemetry.Digest()
+    shards = [telemetry.Digest() for _ in range(4)]
+    for i, v in enumerate(samples):
+        single.add(v)
+        shards[i % 4].add(v)
+
+    def merged(order):
+        out = telemetry.Digest()
+        for k in order:
+            out.merge(shards[k])
+        return out
+
+    a = merged([0, 1, 2, 3])                      # left fold
+    b = merged([3, 1, 0, 2])                      # permuted: commutativity
+    ab = telemetry.Digest()                       # pairwise: associativity
+    ab.merge(shards[0]); ab.merge(shards[1])
+    cd = telemetry.Digest()
+    cd.merge(shards[2]); cd.merge(shards[3])
+    ab.merge(cd)
+    for m in (a, b, ab):
+        assert m.buckets == single.buckets and m.zero == single.zero
+        assert (m.n, m.min, m.max) == (single.n, single.min, single.max)
+        for q in telemetry.DIGEST_QUANTILES:
+            assert m.quantile(q) == single.quantile(q)
+    # Mixed-alpha merges are refused: they would silently break the bound.
+    with pytest.raises(ValueError):
+        telemetry.Digest(alpha=0.05).merge(single)
+
+
+def test_digest_registry_snapshot_and_prometheus():
+    """observe_digest lands in the registry; digests ride snapshot() (JSON
+    round-trip exact), render as Prometheus summary lines, and merge
+    across label sets via digest_merged."""
+    for v in (0.010, 0.020, 0.030, 0.040):
+        telemetry.observe_digest("tdt_test_lat2_seconds", v, tenant="a")
+    telemetry.observe_digest("tdt_test_lat2_seconds", 0.050, tenant="b")
+    assert telemetry.digest_quantile(
+        "tdt_test_lat2_seconds", 0.5, tenant="a") == pytest.approx(
+            0.020, rel=telemetry.DIGEST_ALPHA)
+    merged = telemetry.digest_merged("tdt_test_lat2_seconds")
+    assert merged.n == 5
+
+    snap = json.loads(json.dumps(telemetry.snapshot()))
+    entries = snap["digests"]["tdt_test_lat2_seconds"]
+    assert {e["labels"]["tenant"] for e in entries} == {"a", "b"}
+    e_a = next(e for e in entries if e["labels"]["tenant"] == "a")
+    d_a = telemetry.Digest.from_dict(e_a)
+    assert d_a.quantile(0.5) == telemetry.digest_quantile(
+        "tdt_test_lat2_seconds", 0.5, tenant="a")
+    assert e_a["quantiles"]["p50"] == d_a.quantile(0.5)
+
+    text = telemetry.to_prometheus()
+    assert "# TYPE tdt_test_lat2_seconds summary" in text
+    assert 'tdt_test_lat2_seconds{tenant="a",quantile="0.5"}' in text
+    assert 'tdt_test_lat2_seconds_count{tenant="a"} 4' in text
+    # Foreign (dumped) snapshots render identically — the CLI path.
+    assert telemetry.to_prometheus(snap) == text
+
+
+def test_digest_edge_values():
+    d = telemetry.Digest()
+    assert d.quantile(0.5) is None                 # empty: no answer
+    d.add(0.0)                                     # zero bucket
+    d.add(-1.0)                                    # clamped negative
+    d.add(0.25)
+    assert d.n == 3 and d.zero == 2
+    # Ranks 0-1 land in the zero bucket (2 of 3 values), rank 2 in the
+    # positive range — and estimates clamp into [min, max].
+    assert d.quantile(0.0) <= 0.0 and d.quantile(0.5) <= 0.0
+    assert d.quantile(1.0) == pytest.approx(0.25, rel=telemetry.DIGEST_ALPHA)
 
 
 # ------------------------------------------------------------ wired-in sites
@@ -421,6 +530,10 @@ def test_snapshot_paths_survive_concurrent_writes():
                 telemetry.inc("tdt_test_stress_total", worker=tag)
                 telemetry.set_gauge("tdt_test_stress_depth", float(i % 5))
                 telemetry.observe("tdt_test_stress_seconds", 1e-3 * (i % 7 + 1))
+                telemetry.observe_digest(
+                    "tdt_test_stress_lat_seconds", 1e-3 * (i % 7 + 1),
+                    worker=tag,
+                )
                 telemetry.emit("stress_tick", worker=tag, i=i)
                 t = tracing.start_trace("tdt_test_stress_trace", worker=tag)
                 with t.span("tdt_test_stress_child"):
@@ -439,6 +552,9 @@ def test_snapshot_paths_survive_concurrent_writes():
                 telemetry.summary()
                 telemetry.events("stress_tick")
                 telemetry.counter_total("tdt_test_stress_total")
+                telemetry.digest_quantile(
+                    "tdt_test_stress_lat_seconds", 0.99, worker="w0")
+                telemetry.digest_merged("tdt_test_stress_lat_seconds")
                 json.dumps(tracing.snapshot_traces())
                 tracing.to_chrome()
         except BaseException as e:  # noqa: BLE001

@@ -922,6 +922,30 @@ def test_bench_regression_gate_directions_and_skips(tmp_path):
                    for l in out_lines)
 
 
+def test_bench_regression_gate_slo_direction_rules(tmp_path):
+    """The SLO engine's metric families are gated, not informational:
+    *_goodput* gates on drops (higher is better), *_p999_* gates on
+    increases (lower is better)."""
+    # _write_bench pops the primary key from its dict — build each fresh.
+    slo = {"serving_burst_goodput_frac": 1.0, "digest_oracle_p999_ms": 100.0}
+    base = _write_bench(tmp_path, "base.json", "snapshot",
+                        {**BASE_METRICS, **slo})
+
+    worse = {**BASE_METRICS, **slo}
+    worse["serving_burst_goodput_frac"] = 0.5    # goodput halved -> bad
+    worse["digest_oracle_p999_ms"] = 200.0       # tail doubled -> bad
+    r = _run_gate(base, _write_bench(tmp_path, "w.json", "snapshot", worse))
+    assert r.returncode == 1
+    assert "serving_burst_goodput_frac" in r.stdout
+    assert "digest_oracle_p999_ms" in r.stdout
+
+    better = {**BASE_METRICS, **slo}
+    better["serving_burst_goodput_frac"] = 2.0   # improvements never gate
+    better["digest_oracle_p999_ms"] = 50.0
+    r = _run_gate(base, _write_bench(tmp_path, "b.json", "snapshot", better))
+    assert r.returncode == 0, r.stdout
+
+
 def test_bench_regression_gate_tolerance_flags(tmp_path):
     base = _write_bench(tmp_path, "base.json", "snapshot", dict(BASE_METRICS))
     cand_metrics = dict(BASE_METRICS)

@@ -147,7 +147,7 @@ import time
 import urllib.error
 import urllib.request
 
-from triton_dist_tpu.runtime import introspect, telemetry, tracing
+from triton_dist_tpu.runtime import introspect, slo, telemetry, tracing
 from triton_dist_tpu.runtime.resilience import WireChaosSchedule
 from triton_dist_tpu.runtime.utils import get_float_env, get_int_env, tdt_log
 from triton_dist_tpu.serving.journal import RequestJournal
@@ -566,6 +566,9 @@ class Router:
         #: Harvested flight recordings of dead replicas, by idx
         #: (/fleet/postmortem/<idx>); newest failure wins per replica.
         self._postmortems: dict[int, dict] = {}
+        #: Per-tenant burn-rate monitors (lazily created at the first
+        #: finished request; ticked every pump). See runtime/slo.py.
+        self._slo_monitors: dict[str, slo.BurnRateMonitor] = {}
         self._routes_mounted = False
 
     # ---------------------------------------------------------------- spawn
@@ -1102,6 +1105,16 @@ class Router:
             reason=fr.finish_reason, tokens=len(fr.tokens),
             migrations=fr.migrations,
         )
+        # Feed the tenant's burn-rate monitor: "ok" spends no error budget,
+        # everything else (queue_full shed, deadline, failure) does. The
+        # state machine itself only transitions in the pump's _slo_tick.
+        if telemetry.enabled():
+            mon = self._slo_monitors.get(fr.tenant)
+            if mon is None:
+                mon = self._slo_monitors[fr.tenant] = slo.BurnRateMonitor(
+                    fr.tenant
+                )
+            mon.record(fr.finish_reason == "ok", time.monotonic())
         if fr.on_finish is not None:
             fr.on_finish(fr)
 
@@ -1147,6 +1160,7 @@ class Router:
             self._heartbeat(h, now)
             worked = self._poll_replica(h) or worked
         worked = self._autoscale(now) or worked
+        self._slo_tick(now)
         if self._pending:
             still = []
             # WFQ order: lowest virtual finish tag places first — the
@@ -1161,6 +1175,36 @@ class Router:
             self._pending = still
             self._pending_gauges()
         return worked
+
+    def _slo_tick(self, now: float) -> None:
+        """Drive every tenant's burn-rate state machine one step (pump
+        cadence). A fire/clear transition emits one structured
+        ``slo_alert`` event into the telemetry ring (mirrored into the
+        flight recorder when active) — hysteresis in the monitor
+        guarantees one burst is one fire/clear pair, not one per shed."""
+        if not telemetry.enabled():
+            return
+        for tenant, mon in self._slo_monitors.items():
+            ev = mon.tick(now)
+            fast, slow = mon.burn_rates(now)
+            telemetry.set_gauge(
+                "tdt_slo_burn_rate", fast, tenant=tenant, window="fast"
+            )
+            telemetry.set_gauge(
+                "tdt_slo_burn_rate", slow, tenant=tenant, window="slow"
+            )
+            if ev is not None:
+                telemetry.inc("tdt_slo_alerts_total", tenant=tenant, state=ev)
+                telemetry.emit(
+                    "slo_alert", tenant=tenant, state=ev,
+                    fast_burn=round(fast, 4), slow_burn=round(slow, 4),
+                    objective=mon.objective,
+                )
+                tdt_log(
+                    f"[fleet] slo_alert {ev} tenant={tenant} "
+                    f"fast_burn={fast:.2f} slow_burn={slow:.2f}",
+                    level="warn" if ev == "fire" else "info",
+                )
 
     def _heartbeat(self, h: ReplicaHandle, now: float) -> None:
         """Keep an idle replica's health current: probe ``/fleet/status``
@@ -1719,7 +1763,8 @@ class Router:
     #: route registry (trailing "/" = prefix route).
     FEDERATION_ROUTES = (
         "/fleet/metrics", "/fleet/topology", "/fleet/placements",
-        "/fleet/autoscale", "/fleet/postmortem/", "/fleet/trace/",
+        "/fleet/autoscale", "/fleet/slo", "/fleet/postmortem/",
+        "/fleet/trace/",
     )
 
     def mount_routes(self) -> None:
@@ -1737,6 +1782,8 @@ class Router:
             "/fleet/placements", self._r_placements, methods=("GET",))
         introspect.register_json_route(
             "/fleet/autoscale", self._r_autoscale, methods=("GET",))
+        introspect.register_json_route(
+            "/fleet/slo", self._r_slo, methods=("GET",))
         introspect.register_json_route(
             "/fleet/postmortem/", self._r_postmortem, methods=("GET",))
         introspect.register_json_route(
@@ -1767,26 +1814,26 @@ class Router:
                 continue
         merged = self._merge_scrapes(scrapes)
         local = telemetry.snapshot()
+        local_prefixes = (
+            "tdt_fleet_", "tdt_flight_", "tdt_tenant_", "tdt_slo_"
+        )
         for sec in ("counters", "gauges"):
             for name, entries in local.get(sec, {}).items():
-                if not name.startswith(
-                    ("tdt_fleet_", "tdt_flight_", "tdt_tenant_")
-                ):
+                if not name.startswith(local_prefixes):
                     continue
                 merged[sec].setdefault(name, []).extend(
                     {"labels": {**e["labels"], "replica": "router"},
                      "value": e["value"]}
                     for e in entries
                 )
-        for name, entries in local.get("histograms", {}).items():
-            if not name.startswith(
-                ("tdt_fleet_", "tdt_flight_", "tdt_tenant_")
-            ):
-                continue
-            merged["histograms"].setdefault(name, []).extend(
-                {**e, "labels": {**e["labels"], "replica": "router"}}
-                for e in entries
-            )
+        for sec in ("histograms", "digests"):
+            for name, entries in local.get(sec, {}).items():
+                if not name.startswith(local_prefixes):
+                    continue
+                merged[sec].setdefault(name, []).extend(
+                    {**e, "labels": {**e["labels"], "replica": "router"}}
+                    for e in entries
+                )
         return merged
 
     @staticmethod
@@ -1801,7 +1848,7 @@ class Router:
         out: dict = {
             "federated": True,
             "replicas": [idx for idx, _ in scrapes],
-            "counters": {}, "gauges": {}, "histograms": {},
+            "counters": {}, "gauges": {}, "histograms": {}, "digests": {},
         }
         csum: dict[str, dict[tuple, float]] = {}
         cper: dict[str, list[dict]] = {}
@@ -1852,6 +1899,26 @@ class Router:
             out["histograms"][name] = [
                 hsum[name][key] for key in sorted(hsum[name])
             ] + hper[name]
+        # Digests merge by construction (log-γ bucket counts sum per key),
+        # so the fleet-wide p99 here EQUALS the quantile one digest
+        # observing the union stream would report — not an approximation
+        # of an approximation. Same layout as histograms: one merged
+        # series per label set, then the per-replica series.
+        dacc: dict[str, dict[tuple, list]] = {}
+        dper: dict[str, list[dict]] = {}
+        for idx, snap in scrapes:
+            for name, entries in snap.get("digests", {}).items():
+                for e in entries:
+                    key = tuple(sorted(e["labels"].items()))
+                    dacc.setdefault(name, {}).setdefault(key, []).append(e)
+                    dper.setdefault(name, []).append({
+                        **e, "labels": {**e["labels"], "replica": str(idx)},
+                    })
+        for name in sorted(dacc):
+            out["digests"][name] = [
+                telemetry.merge_digest_entries(dacc[name][key])
+                for key in sorted(dacc[name])
+            ] + dper[name]
         return out
 
     def topology(self) -> dict:
@@ -1959,6 +2026,31 @@ class Router:
 
     def _r_autoscale(self, method, query, body) -> tuple[int, dict]:
         return 200, self.autoscale()
+
+    def _r_slo(self, method, query, body) -> tuple[int, dict]:
+        return 200, self.fleet_slo()
+
+    def fleet_slo(self) -> dict:
+        """Fleet-wide SLO rollup: per-tenant goodput + latency quantiles
+        from the MERGED per-replica digests (exact — see
+        :meth:`_merge_scrapes`), the router's live burn rates and alert
+        states, and the recent ``slo_alert`` events."""
+        now = time.monotonic()
+        burn = {}
+        for tenant, mon in self._slo_monitors.items():
+            fast, slow = mon.burn_rates(now)
+            burn[tenant] = {
+                "firing": mon.firing,
+                "fires": mon.fires, "clears": mon.clears,
+                "fast_burn": round(fast, 4), "slow_burn": round(slow, 4),
+                "objective": mon.objective,
+            }
+        return {
+            **slo.slo_summary(self.federated_metrics()),
+            "burn": burn,
+            "alerts": telemetry.events("slo_alert"),
+            "alpha": telemetry.DIGEST_ALPHA,
+        }
 
     def _r_postmortem(self, method, query, body, rest="") -> tuple[int, dict]:
         try:

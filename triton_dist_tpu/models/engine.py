@@ -605,6 +605,23 @@ class Engine:
         return KVCache(k=ks, v=vs, lengths=lengths)
 
     # ------------------------------------------------- serving (slot-granular)
+    def _phase(self, name: str, t0: float, *arrays) -> float:
+        """Stamp one step-phase digest (``tdt_engine_phase_seconds``) and
+        return a fresh timestamp for the next phase. When ``arrays`` are
+        given they are fenced first, so the stamp covers device completion
+        (host-sync phases); without them it covers host-side wall only
+        (async dispatch issue). Callers gate on ``telemetry.enabled()`` —
+        with ``TDT_TELEMETRY=0`` neither the stamps nor the extra fences
+        exist and the serve path keeps its fully-async dispatch."""
+        if arrays:
+            jax.block_until_ready(arrays)
+        now = time.perf_counter()
+        telemetry.observe_digest(
+            "tdt_engine_phase_seconds", now - t0,
+            phase=name, backend=self.backend,
+        )
+        return now
+
     def alloc_slots(self, num_slots: int) -> KVCache:
         """Fresh zeroed KV for a fixed batch of ``num_slots`` serving slots
         (each slot owns a full max_len row — the scheduler's KV budget)."""
@@ -630,6 +647,8 @@ class Engine:
         assert seq <= self.max_len
         if key is None:
             key = jax.random.PRNGKey(0)
+        timed = telemetry.enabled()
+        t = time.perf_counter() if timed else 0.0
         logits, ks, vs = self._prefill(self.model.params, input_ids)
         if seq < self.max_len:
             ks, vs = self._pad_to_max(ks, vs)
@@ -639,6 +658,10 @@ class Engine:
         )
         key, sub = jax.random.split(key)
         token0 = sample_token(logits, sub, self.sample_method, self.temperature, self.top_p)
+        if timed:
+            # Admission: prefill + slot scatter + token-0 sample — the full
+            # cost of joining one request into the running batch.
+            self._phase("admission", t, token0)
         return token0[0], KVCache(k=k2, v=v2, lengths=lengths)
 
     # ------------------------------------------------ serving (paged blocks)
@@ -683,10 +706,17 @@ class Engine:
         logits matter (the prompt's last token, on the final chunk). One
         compiled program per (C, P) shape pair; kbuf/vbuf are donated.
         Returns (logits (1, V), kbuf', vbuf')."""
-        return self._prefill_chunk_prog(
+        timed = telemetry.enabled()
+        t = time.perf_counter() if timed else 0.0
+        logits, kb, vb = self._prefill_chunk_prog(
             self.model.params, chunk_ids, kbuf, vbuf,
             jnp.int32(off), jnp.int32(last_idx),
         )
+        if timed:
+            # Admission (paged): each prefill chunk's compute — the chunked
+            # analog of prefill_into_slot's join cost.
+            self._phase("admission", t, logits)
+        return logits, kb, vb
 
     def complete_paged_prefill(self, paged: PagedKVCache, kbuf, vbuf, table_row,
                                start_block: int) -> PagedKVCache:
@@ -694,10 +724,14 @@ class Engine:
         the slot's block chain (blocks below ``start_block`` are shared and
         skipped). Pool buffers are donated; tables/lengths are the host's to
         update (they travel as data with the next dispatch)."""
+        timed = telemetry.enabled()
+        t = time.perf_counter() if timed else 0.0
         pk, pv = self._paged_scatter_prefill(
             paged.k, paged.v, kbuf, vbuf,
             jnp.asarray(table_row, jnp.int32), jnp.int32(start_block),
         )
+        if timed:
+            self._phase("cache_scatter", t, pk)
         return dataclasses.replace(paged, k=pk, v=pv)
 
     def decode_steps_paged(self, paged: PagedKVCache, tokens: jax.Array,
@@ -714,6 +748,8 @@ class Engine:
         Returns ``(out, last_tokens, paged', remaining')``."""
         if key is None:
             key = jax.random.PRNGKey(0)
+        timed = telemetry.enabled()
+        t = time.perf_counter() if timed else 0.0
         if self.backend == "mega":
             out, tok, pk, pv, lengths, rem = self._decode_chunk_paged(
                 self.model.params, self._decode_extra, tokens, paged.k,
@@ -723,6 +759,12 @@ class Engine:
             telemetry.set_gauge(
                 "tdt_mega_steps_per_launch", float(chunk), path="paged"
             )
+            if timed:
+                # dispatch = host wall to ISSUE the chunk program (async);
+                # host_sync = the wait for the device to finish it. The
+                # mega path scatters in place — no cache_scatter phase.
+                t = self._phase("dispatch", t)
+                self._phase("host_sync", t, tok)
             return out, tok, dataclasses.replace(
                 paged, k=pk, v=pv, lengths=lengths
             ), rem
@@ -731,10 +773,17 @@ class Engine:
             self.model.params, self._decode_extra, tokens, kc, vc,
             paged.lengths, remaining, int(chunk), key,
         )
+        if timed:
+            t = self._phase("dispatch", t)
+            t = self._phase("host_sync", t, tok)
         pk, pv = self._paged_scatter_decode(
             paged.k, paged.v, k2, v2, paged.tables, paged.lengths, remaining,
             int(chunk),
         )
+        if timed:
+            # The gather/scatter bounce around the contiguous chunk program
+            # — exactly the cost the mega in-place path deletes.
+            self._phase("cache_scatter", t, pk)
         return out, tok, dataclasses.replace(
             paged, k=pk, v=pv, lengths=lengths
         ), rem
@@ -870,6 +919,8 @@ class Engine:
         for call-site symmetry and unused — spec decode is greedy-only."""
         del key
         assert self._drafter is not None, "attach_drafter first"
+        timed = telemetry.enabled()
+        t = time.perf_counter() if timed else 0.0
         out, tok, k2, v2, lengths, rem, dstate, stats = self._spec_chunk(
             self.model.params, self._decode_extra, self._drafter.params,
             tokens, cache.k, cache.v, cache.lengths, remaining, kcap,
@@ -879,6 +930,10 @@ class Engine:
             telemetry.set_gauge(
                 "tdt_mega_steps_per_launch", float(chunk * k), path="spec"
             )
+        if timed:
+            # The fused propose+verify rounds; contiguous layout commits
+            # in place, so there is no spec_commit phase here.
+            self._phase("spec_propose", t, tok)
         return out, tok, KVCache(k=k2, v=v2, lengths=lengths), rem, dstate, stats
 
     def spec_decode_steps_paged(self, paged: PagedKVCache, dstate,
@@ -893,6 +948,8 @@ class Engine:
         holds a rejected draft's KV."""
         del key
         assert self._drafter is not None, "attach_drafter first"
+        timed = telemetry.enabled()
+        t = time.perf_counter() if timed else 0.0
         if self.backend == "mega":
             out, tok, pk, pv, lengths, rem, dstate, stats = self._spec_chunk_paged(
                 self.model.params, self._decode_extra, self._drafter.params,
@@ -902,6 +959,8 @@ class Engine:
             telemetry.set_gauge(
                 "tdt_mega_steps_per_launch", float(chunk * k), path="spec_paged"
             )
+            if timed:
+                self._phase("spec_propose", t, tok)
             return out, tok, dataclasses.replace(
                 paged, k=pk, v=pv, lengths=lengths
             ), rem, dstate, stats
@@ -911,11 +970,16 @@ class Engine:
             tokens, kc, vc, paged.lengths, remaining, kcap,
             int(chunk), int(k), dstate,
         )
+        if timed:
+            t = self._phase("spec_propose", t, tok)
         nv = lengths - paged.lengths
         pk, pv = self._paged_scatter_rows(
             paged.k, paged.v, k2, v2, paged.tables, paged.lengths, nv,
             int(chunk) * int(k),
         )
+        if timed:
+            # Commit: only the ACCEPTED rows scatter back into the pool.
+            self._phase("spec_commit", t, pk)
         return out, tok, dataclasses.replace(
             paged, k=pk, v=pv, lengths=lengths
         ), rem, dstate, stats
@@ -939,10 +1003,15 @@ class Engine:
             telemetry.set_gauge(
                 "tdt_mega_steps_per_launch", float(chunk), path="contiguous"
             )
+        timed = telemetry.enabled()
+        t = time.perf_counter() if timed else 0.0
         out, tok, k2, v2, lengths, rem = self._decode_chunk(
             self.model.params, self._decode_extra, tokens, cache.k, cache.v,
             cache.lengths, remaining, int(chunk), key,
         )
+        if timed:
+            t = self._phase("dispatch", t)
+            self._phase("host_sync", t, tok)
         return out, tok, KVCache(k=k2, v=v2, lengths=lengths), rem
 
     # ----------------------------------------------------------------- serve

@@ -1,0 +1,284 @@
+"""Live SLO engine: per-tenant goodput accounting + burn-rate alerting.
+
+DistServe (arXiv:2401.09670) frames serving capacity as *goodput* — requests
+per second completed WITHIN their latency SLO — rather than raw throughput,
+and that is the number the PR 17 autoscaler and WFQ shed policy implicitly
+optimize. This module makes it a first-class live signal:
+
+* **Outcome accounting** (:func:`record_finish` / :func:`record_reject`):
+  every request that leaves the serving tier — completed, truncated, or
+  shed — is classified against its OWN deadline fields (``ttft_deadline_s``
+  / ``deadline_s``, the PR 7 SLO definition; a request with no deadline
+  always meets its SLO) and lands in ``tdt_slo_goodput_total`` /
+  ``tdt_slo_violations_total`` counters plus per-(tenant, priority-tier)
+  TTFT/TPOT/e2e quantile digests (``telemetry.Digest`` — mergeable, so
+  per-replica digests federate into exact fleet-wide percentiles). A
+  migrated stream keeps its tenant/deadline fields through the journal, so
+  its outcome lands in the same tenant's ledger on the survivor.
+* **Burn-rate alerting** (:class:`BurnRateMonitor`): the SRE-workbook
+  multi-window scheme. With error budget ``1 - objective``, the burn rate
+  over a window is ``bad_fraction / budget``; an alert FIRES when both the
+  fast and the slow window burn above their thresholds (fast alone is
+  noise, slow alone is lag), and CLEARS only when the fast window burns
+  below ``clear_burn`` — a wide hysteresis band, so one burst produces
+  exactly one fire/clear pair instead of flapping per event. The fleet
+  router ticks one monitor per tenant from its pump and emits structured
+  ``slo_alert`` events into the telemetry ring (mirrored into the flight
+  recorder when active).
+
+Zero-overhead contract: every entry point is behind the single cached
+``telemetry.enabled()`` bool — ``TDT_TELEMETRY=0`` reduces each call to one
+check and an early return.
+
+Env knobs (read per monitor construction, so tests pin tiny windows)::
+
+    TDT_SLO_OBJECTIVE      success-fraction objective (default 0.99)
+    TDT_SLO_FAST_WINDOW_S  fast burn window, seconds (default 60)
+    TDT_SLO_SLOW_WINDOW_S  slow burn window, seconds (default 600)
+    TDT_SLO_FAST_BURN      fast-window fire threshold (default 14.0)
+    TDT_SLO_SLOW_BURN      slow-window fire threshold (default 6.0)
+    TDT_SLO_CLEAR_BURN     fast-window clear threshold (default 1.0)
+    TDT_SLO_MIN_EVENTS     min fast-window events before firing (default 10)
+
+See ``docs/observability.md`` ("SLO engine") for the full wiring.
+"""
+
+from __future__ import annotations
+
+import collections
+
+from triton_dist_tpu.runtime import telemetry
+from triton_dist_tpu.runtime.utils import get_float_env, get_int_env
+
+#: Reject/shed reasons that count against the tenant's SLO. Capacity-policy
+#: rejects a client can fix (empty prompt, over-budget request, shutdown)
+#: are neither goodput nor violations.
+VIOLATION_REJECTS = frozenset({"queue_full", "shed_deadline", "shed_overload"})
+
+
+def tier(priority: int) -> str:
+    """Priority-tier label value (one digest per (tenant, tier))."""
+    return str(int(priority))
+
+
+def record_finish(req, reason: str) -> str | None:
+    """Classify one finished request against its own SLO and record it.
+
+    ``req`` is a ``serving.scheduler.Request`` (or anything with its
+    timing/QoS fields); ``reason`` is the server's finish reason. Returns
+    the recorded outcome — "met", a violation reason, or None when nothing
+    was recorded (telemetry off, or a client cancel, which spends no
+    error budget either way)."""
+    if not telemetry.enabled():
+        return None
+    if reason == "cancelled":
+        return None
+    t, tr = str(req.tenant), tier(req.priority)
+    ttft = req.ttft_s
+    e2e = (
+        None if req.finished_at is None
+        else max(req.finished_at - req.arrived_at, 0.0)
+    )
+    if ttft is not None:
+        telemetry.observe_digest(
+            "tdt_slo_ttft_seconds", ttft, tenant=t, tier=tr
+        )
+    if e2e is not None:
+        telemetry.observe_digest(
+            "tdt_slo_e2e_seconds", e2e, tenant=t, tier=tr
+        )
+    if reason == "ok":
+        tpot = req.tpot_s
+        if tpot is not None:
+            telemetry.observe_digest(
+                "tdt_slo_tpot_seconds", tpot, tenant=t, tier=tr
+            )
+    if reason != "ok":
+        outcome = reason
+    elif (
+        req.ttft_deadline_s is not None
+        and (ttft is None or ttft > req.ttft_deadline_s)
+    ):
+        outcome = "ttft_deadline"
+    elif (
+        req.deadline_s is not None
+        and (e2e is None or e2e > req.deadline_s)
+    ):
+        outcome = "deadline"
+    else:
+        outcome = "met"
+    if outcome == "met":
+        telemetry.inc("tdt_slo_goodput_total", tenant=t, tier=tr)
+    else:
+        telemetry.inc(
+            "tdt_slo_violations_total", tenant=t, tier=tr, reason=outcome
+        )
+    return outcome
+
+
+def record_reject(req, reason: str) -> str | None:
+    """Record an admission-time shed against the tenant's SLO (a shed
+    request by definition got no tokens — a violation). Non-SLO rejects
+    (see ``VIOLATION_REJECTS``) are ignored."""
+    if not telemetry.enabled():
+        return None
+    if reason not in VIOLATION_REJECTS:
+        return None
+    telemetry.inc(
+        "tdt_slo_violations_total",
+        tenant=str(req.tenant), tier=tier(req.priority), reason=reason,
+    )
+    return reason
+
+
+class BurnRateMonitor:
+    """Multi-window error-budget burn-rate alerting for ONE tenant.
+
+    Pure time-fed state machine: callers pass ``now`` into both
+    :meth:`record` and :meth:`tick` (the router uses its pump clock), so
+    the fire/clear arc is deterministic under a pinned clock. Not
+    thread-safe — owned and ticked by the router's single pump thread."""
+
+    def __init__(self, tenant: str = "default", *,
+                 objective: float | None = None,
+                 fast_window_s: float | None = None,
+                 slow_window_s: float | None = None,
+                 fast_burn: float | None = None,
+                 slow_burn: float | None = None,
+                 clear_burn: float | None = None,
+                 min_events: int | None = None):
+        self.tenant = str(tenant)
+        self.objective = (
+            get_float_env("TDT_SLO_OBJECTIVE", 0.99)
+            if objective is None else float(objective)
+        )
+        self.fast_window_s = (
+            get_float_env("TDT_SLO_FAST_WINDOW_S", 60.0)
+            if fast_window_s is None else float(fast_window_s)
+        )
+        self.slow_window_s = max(
+            get_float_env("TDT_SLO_SLOW_WINDOW_S", 600.0)
+            if slow_window_s is None else float(slow_window_s),
+            self.fast_window_s,
+        )
+        self.fast_burn = (
+            get_float_env("TDT_SLO_FAST_BURN", 14.0)
+            if fast_burn is None else float(fast_burn)
+        )
+        self.slow_burn = (
+            get_float_env("TDT_SLO_SLOW_BURN", 6.0)
+            if slow_burn is None else float(slow_burn)
+        )
+        self.clear_burn = (
+            get_float_env("TDT_SLO_CLEAR_BURN", 1.0)
+            if clear_burn is None else float(clear_burn)
+        )
+        self.min_events = max(
+            get_int_env("TDT_SLO_MIN_EVENTS", 10)
+            if min_events is None else int(min_events),
+            1,
+        )
+        self._budget = max(1.0 - self.objective, 1e-9)
+        #: (t, ok) outcome stream, pruned to the slow window.
+        self._events: collections.deque[tuple[float, bool]] = (
+            collections.deque()
+        )
+        self.firing = False
+        self.fires = 0
+        self.clears = 0
+
+    def record(self, ok: bool, now: float) -> None:
+        self._events.append((float(now), bool(ok)))
+        self._prune(now)
+
+    def _prune(self, now: float) -> None:
+        horizon = now - self.slow_window_s
+        ev = self._events
+        while ev and ev[0][0] <= horizon:
+            ev.popleft()
+
+    def _window(self, now: float, span: float) -> tuple[int, int]:
+        """(events, bad events) inside ``(now - span, now]``."""
+        lo = now - span
+        n = bad = 0
+        for t, ok in self._events:
+            if t > lo:
+                n += 1
+                if not ok:
+                    bad += 1
+        return n, bad
+
+    def burn_rates(self, now: float) -> tuple[float, float]:
+        """(fast, slow) burn rates: bad-fraction over error budget. An
+        empty window burns 0 — no traffic spends no budget."""
+        out = []
+        for span in (self.fast_window_s, self.slow_window_s):
+            n, bad = self._window(now, span)
+            out.append((bad / n) / self._budget if n else 0.0)
+        return out[0], out[1]
+
+    def tick(self, now: float) -> str | None:
+        """Evaluate the alert state machine; returns "fire" / "clear" on a
+        transition, None otherwise."""
+        self._prune(now)
+        fast, slow = self.burn_rates(now)
+        if not self.firing:
+            n_fast, _ = self._window(now, self.fast_window_s)
+            if (n_fast >= self.min_events
+                    and fast >= self.fast_burn and slow >= self.slow_burn):
+                self.firing = True
+                self.fires += 1
+                return "fire"
+        elif fast <= self.clear_burn:
+            self.firing = False
+            self.clears += 1
+            return "clear"
+        return None
+
+
+def slo_summary(snap: dict | None = None) -> dict:
+    """Per-tenant SLO rollup from a telemetry snapshot (default: the live
+    one; the router passes its federated snapshot so the rollup spans the
+    fleet). Goodput/violation tallies plus TTFT/e2e quantiles per
+    (tenant, tier) — the ``/slo`` and ``/fleet/slo`` payload core."""
+    snap = telemetry.snapshot() if snap is None else snap
+    tenants: dict[str, dict] = {}
+
+    def bucket(labels: dict) -> dict | None:
+        t = labels.get("tenant")
+        if t is None or "replica" in labels:
+            return None  # per-replica series: the summed one already counted
+        return tenants.setdefault(
+            t, {"goodput": 0.0, "violations": 0.0, "violation_reasons": {},
+                "tiers": {}}
+        )
+
+    for e in snap.get("counters", {}).get("tdt_slo_goodput_total", []):
+        b = bucket(e["labels"])
+        if b is not None:
+            b["goodput"] += e["value"]
+    for e in snap.get("counters", {}).get("tdt_slo_violations_total", []):
+        b = bucket(e["labels"])
+        if b is not None:
+            b["violations"] += e["value"]
+            reason = e["labels"].get("reason", "?")
+            b["violation_reasons"][reason] = (
+                b["violation_reasons"].get(reason, 0.0) + e["value"]
+            )
+    for metric, short in (
+        ("tdt_slo_ttft_seconds", "ttft"),
+        ("tdt_slo_tpot_seconds", "tpot"),
+        ("tdt_slo_e2e_seconds", "e2e"),
+    ):
+        for e in snap.get("digests", {}).get(metric, []):
+            b = bucket(e["labels"])
+            if b is None:
+                continue
+            tr = e["labels"].get("tier", "?")
+            b["tiers"].setdefault(tr, {})[short] = {
+                "count": e["count"], **(e.get("quantiles") or {})
+            }
+    for b in tenants.values():
+        total = b["goodput"] + b["violations"]
+        b["goodput_frac"] = b["goodput"] / total if total else None
+    return {"tenants": tenants}

@@ -6,12 +6,15 @@ reference repo's intra-kernel profiler answers "what did kernel X do" but
 nothing answers "what is this *process* doing right now". This module is
 that answer, and every later perf/robustness layer reports through it:
 
-* **Metrics registry** — counters, gauges, and histograms with fixed
-  log-scale buckets, all labeled (``telemetry.inc("tdt_engine_serve_total",
-  backend="dist_ar")``). Metric names follow ``tdt_<subsystem>_<name>``
-  (enforced by ``scripts/check_metric_names.py``); label VALUES may be
-  dynamic but must stay low-cardinality (rank ids, phase names — never
-  shapes or pointers).
+* **Metrics registry** — counters, gauges, histograms with fixed
+  log-scale buckets, and mergeable quantile :class:`Digest` sketches
+  (DDSketch-style log-γ buckets, relative error ``DIGEST_ALPHA``; see
+  ``observe_digest``), all labeled
+  (``telemetry.inc("tdt_engine_serve_total", backend="dist_ar")``).
+  Metric names follow ``tdt_<subsystem>_<name>`` (enforced by
+  ``scripts/check_metric_names.py``); label VALUES may be dynamic but
+  must stay low-cardinality (rank ids, phase names — never shapes or
+  pointers).
 * **Structured event ring** — ``emit(kind, **fields)`` appends one dict to
   a bounded ring (``TDT_EVENT_RING`` entries, default 1024): the
   machine-readable replacement for resilience's ad-hoc ``_log`` lines.
@@ -74,6 +77,7 @@ from __future__ import annotations
 
 import collections
 import json
+import math
 import mmap
 import os
 import struct
@@ -119,6 +123,7 @@ _COUNTERS: dict[tuple[str, tuple], float] = {}
 _GAUGES: dict[tuple[str, tuple], float] = {}
 # histogram value: [counts per bucket + overflow, total_sum, n]
 _HISTS: dict[tuple[str, tuple], list] = {}
+_DIGESTS: dict[tuple[str, tuple], "Digest"] = {}
 _EVENT_SEQ = 0
 _EVENTS: collections.deque | None = None
 _KTRACES: collections.deque = collections.deque(maxlen=64)
@@ -144,6 +149,7 @@ def reset(enabled_override: bool | None = None) -> None:
         _COUNTERS.clear()
         _GAUGES.clear()
         _HISTS.clear()
+        _DIGESTS.clear()
         _KTRACES.clear()
         _EVENT_SEQ = 0
         _EVENTS = None
@@ -156,6 +162,163 @@ def reset(enabled_override: bool | None = None) -> None:
         _FLIGHT_RESOLVED = False  # re-resolve TDT_FLIGHT_RECORDER next use
     if fr is not None:
         fr.close()
+
+
+# ------------------------------------------------------------ quantile digests
+
+#: Relative-accuracy bound of every :class:`Digest` in the registry. A
+#: quantile estimate ``est`` for true value ``x`` satisfies
+#: ``|est - x| <= DIGEST_ALPHA * x`` — the documented SLO-engine error bound
+#: (pinned by ``tests/test_telemetry.py`` against a sorted-list oracle).
+DIGEST_ALPHA = 0.01
+
+#: Convenience quantiles exporters attach to every digest entry.
+DIGEST_QUANTILES: tuple[float, ...] = (0.5, 0.9, 0.99, 0.999)
+_QUANTILE_NAMES = {0.5: "p50", 0.9: "p90", 0.99: "p99", 0.999: "p999"}
+
+
+class Digest:
+    """Mergeable bounded-relative-error quantile sketch (DDSketch-style).
+
+    A strict upgrade of the fixed log2 histograms for latency SLOs: values
+    land in sparse log-γ buckets (``γ = (1+α)/(1-α)``, bucket ``i`` covers
+    ``(γ^(i-1), γ^i]``), so any quantile is answerable to relative error α
+    instead of "somewhere inside a 2× bucket". Buckets are keyed by integer
+    index, which makes :meth:`merge` a plain per-key count sum — two
+    digests built on the same α merge into *exactly* the digest a single
+    observer of the union stream would hold (merge invariance), so
+    per-replica digests federate into fleet-wide p50/p99/p999 that equal
+    the single-digest answer. Values ``<= 0`` go to a dedicated zero
+    bucket (latencies only hit it via clock skew clamps).
+
+    Not thread-safe on its own: the module registry serializes access
+    under ``_LOCK``; standalone users (bench.py's percentile helper) are
+    single-threaded."""
+
+    __slots__ = ("alpha", "gamma", "_ln_gamma", "buckets", "zero",
+                 "sum", "n", "min", "max")
+
+    def __init__(self, alpha: float = DIGEST_ALPHA):
+        self.alpha = float(alpha)
+        self.gamma = (1.0 + self.alpha) / (1.0 - self.alpha)
+        self._ln_gamma = math.log(self.gamma)
+        self.buckets: dict[int, int] = {}
+        self.zero = 0
+        self.sum = 0.0
+        self.n = 0
+        self.min = math.inf
+        self.max = -math.inf
+
+    def add(self, value: float, count: int = 1) -> None:
+        v = float(value)
+        self.sum += v * count
+        self.n += count
+        if v < self.min:
+            self.min = v
+        if v > self.max:
+            self.max = v
+        if v <= 0.0:
+            self.zero += count
+        else:
+            i = math.ceil(math.log(v) / self._ln_gamma)
+            self.buckets[i] = self.buckets.get(i, 0) + count
+
+    def merge(self, other: "Digest") -> "Digest":
+        """Fold ``other`` into this digest (same α required); returns self.
+        Commutative and associative: bucket counts are plain sums."""
+        if abs(other.gamma - self.gamma) > 1e-12:
+            raise ValueError(
+                f"cannot merge digests with different accuracy: "
+                f"alpha {self.alpha} vs {other.alpha}"
+            )
+        for i, c in other.buckets.items():
+            self.buckets[i] = self.buckets.get(i, 0) + c
+        self.zero += other.zero
+        self.sum += other.sum
+        self.n += other.n
+        if other.min < self.min:
+            self.min = other.min
+        if other.max > self.max:
+            self.max = other.max
+        return self
+
+    def quantile(self, q: float) -> float | None:
+        """Value at quantile ``q`` (rank ``int(q * (n-1))`` of the sorted
+        stream, the same convention as a sorted-list oracle), within
+        relative error α. None when empty."""
+        if self.n <= 0:
+            return None
+        q = min(max(float(q), 0.0), 1.0)
+        rank = int(q * (self.n - 1))
+        if rank < self.zero:
+            est = min(self.min, 0.0)
+        else:
+            cum = self.zero
+            est = self.max
+            for i in sorted(self.buckets):
+                cum += self.buckets[i]
+                if cum > rank:
+                    # Geometric bucket midpoint: ≤ α relative error for any
+                    # value inside (γ^(i-1), γ^i].
+                    est = 2.0 * self.gamma**i / (self.gamma + 1.0)
+                    break
+        # Clamping to the observed range only tightens the estimate (the
+        # true value lies inside it) and pins p0/p100 exactly.
+        return min(max(est, self.min), self.max)
+
+    def to_dict(self) -> dict:
+        """JSON-safe serialization; ``from_dict`` round-trips it exactly,
+        which is what lets digests ride the ``/fleet/metrics`` wire."""
+        return {
+            "alpha": self.alpha,
+            "n": self.n,
+            "sum": self.sum,
+            "zero": self.zero,
+            "min": self.min if self.n else None,
+            "max": self.max if self.n else None,
+            "buckets": {str(i): c for i, c in sorted(self.buckets.items())},
+        }
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any]) -> "Digest":
+        dg = cls(alpha=float(d.get("alpha", DIGEST_ALPHA)))
+        dg.n = int(d.get("n", 0))
+        dg.sum = float(d.get("sum", 0.0))
+        dg.zero = int(d.get("zero", 0))
+        mn, mx = d.get("min"), d.get("max")
+        dg.min = math.inf if mn is None else float(mn)
+        dg.max = -math.inf if mx is None else float(mx)
+        for i, c in (d.get("buckets") or {}).items():
+            dg.buckets[int(i)] = int(c)
+        return dg
+
+
+def digest_entry(labels: Mapping[str, str], d: Digest) -> dict:
+    """One exporter-facing digest entry: serialized state + convenience
+    quantiles. Shared by :func:`snapshot` and the fleet federation merge so
+    a merged entry is indistinguishable from a locally-built one."""
+    return {
+        "labels": dict(labels),
+        "count": d.n,
+        "quantiles": {
+            _QUANTILE_NAMES[q]: d.quantile(q) for q in DIGEST_QUANTILES
+        },
+        **d.to_dict(),
+    }
+
+
+def merge_digest_entries(entries: Iterable[Mapping[str, Any]]) -> dict | None:
+    """Merge serialized digest entries (one label set, e.g. the same metric
+    scraped from every replica) into one entry. None when empty."""
+    merged: Digest | None = None
+    labels: dict = {}
+    for e in entries:
+        d = Digest.from_dict(e)
+        if merged is None:
+            merged, labels = d, dict(e.get("labels") or {})
+        else:
+            merged.merge(d)
+    return None if merged is None else digest_entry(labels, merged)
 
 
 # ---------------------------------------------------------------- instruments
@@ -197,6 +360,41 @@ def observe(name: str, value: float, /, **labels) -> None:
             counts[-1] += 1  # +Inf bucket
         h[1] += float(value)
         h[2] += 1
+
+
+def observe_digest(name: str, value: float, /, **labels) -> None:
+    """Record ``value`` into the quantile digest ``name`` (log-γ buckets,
+    relative error ``DIGEST_ALPHA``). The digest sibling of :func:`observe`
+    — use it wherever a tail quantile (p99/p999) must be answerable live."""
+    if not enabled():
+        return
+    k = _key(name, labels)
+    with _LOCK:
+        d = _DIGESTS.get(k)
+        if d is None:
+            d = _DIGESTS[k] = Digest()
+        d.add(value)
+
+
+def digest_quantile(name: str, q: float, /, **labels) -> float | None:
+    """Quantile ``q`` of one labeled digest (None when never observed)."""
+    with _LOCK:
+        d = _DIGESTS.get(_key(name, labels))
+        return None if d is None else d.quantile(q)
+
+
+def digest_merged(name: str) -> Digest | None:
+    """One digest merging ALL label sets of ``name`` — the
+    across-tenants / across-phases view (None when never observed)."""
+    merged: Digest | None = None
+    with _LOCK:
+        for (n, _), d in _DIGESTS.items():
+            if n != name:
+                continue
+            if merged is None:
+                merged = Digest(alpha=d.alpha)
+            merged.merge(d)
+    return merged
 
 
 def emit(kind: str, /, **fields) -> None:
@@ -520,6 +718,9 @@ def snapshot() -> dict:
         counters = dict(_COUNTERS)
         gauges = dict(_GAUGES)
         hists = {k: [list(v[0]), v[1], v[2]] for k, v in _HISTS.items()}
+        digest_out: dict[str, list[dict]] = {}
+        for (name, labels), d in sorted(_DIGESTS.items()):
+            digest_out.setdefault(name, []).append(digest_entry(dict(labels), d))
         evs = list(_EVENTS or ())
         traces = list(_KTRACES)
     hist_out: dict[str, list[dict]] = {}
@@ -538,6 +739,7 @@ def snapshot() -> dict:
         "counters": _metric_entries(counters),
         "gauges": _metric_entries(gauges),
         "histograms": hist_out,
+        "digests": digest_out,
         "events": evs,
         "kernel_traces": traces,
     }
@@ -590,6 +792,21 @@ def to_prometheus(snap: dict | None = None) -> str:
                 )
             lines.append(f"{name}_sum{_fmt_labels(e['labels'])} {e['sum']:g}")
             lines.append(f"{name}_count{_fmt_labels(e['labels'])} {e['count']}")
+    # Digests render as Prometheus summaries: one pre-computed quantile
+    # series per entry plus _sum/_count, mirroring the histogram layout.
+    for name, entries in snap.get("digests", {}).items():
+        lines.append(f"# TYPE {name} summary")
+        for e in entries:
+            for q, qname in sorted(_QUANTILE_NAMES.items()):
+                v = (e.get("quantiles") or {}).get(qname)
+                if v is None:
+                    continue
+                lines.append(
+                    f"{name}{_fmt_labels(e['labels'], [('quantile', f'{q:g}')])}"
+                    f" {v:g}"
+                )
+            lines.append(f"{name}_sum{_fmt_labels(e['labels'])} {e['sum']:g}")
+            lines.append(f"{name}_count{_fmt_labels(e['labels'])} {e['count']}")
     return "\n".join(lines) + "\n"
 
 
@@ -600,6 +817,10 @@ def summary() -> dict:
     with _LOCK:
         counters = dict(_COUNTERS)
         hists = {k: (v[1], v[2]) for k, v in _HISTS.items()}
+        digest_stats = {
+            k: (d.n, d.quantile(0.5), d.quantile(0.99))
+            for k, d in _DIGESTS.items()
+        }
         n_events = len(_EVENTS or ())
         n_traces = len(_KTRACES)
 
@@ -613,10 +834,18 @@ def summary() -> dict:
             "sum_s": round(total, 6),
             "mean_s": round(total / n, 6) if n else 0.0,
         }
+    digest_summary = {}
+    for (name, labels), (n, p50, p99) in sorted(digest_stats.items()):
+        digest_summary[flat(name, labels)] = {
+            "count": n,
+            "p50": round(p50, 6) if p50 is not None else None,
+            "p99": round(p99, 6) if p99 is not None else None,
+        }
     return {
         "enabled": enabled(),
         "counters": {flat(n, l): v for (n, l), v in sorted(counters.items())},
         "histograms": hist_summary,
+        "digests": digest_summary,
         "events": n_events,
         "kernel_traces": n_traces,
     }

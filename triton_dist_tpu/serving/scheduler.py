@@ -75,7 +75,7 @@ import time
 from typing import Callable
 
 from triton_dist_tpu.models.kv_cache import NULL_BLOCK, BlockAllocator
-from triton_dist_tpu.runtime import telemetry, tracing
+from triton_dist_tpu.runtime import slo, telemetry, tracing
 from triton_dist_tpu.runtime.utils import get_float_env, get_int_env
 
 #: EWMA smoothing for the decode-capacity estimate: heavy enough to ride
@@ -746,6 +746,7 @@ class Scheduler:
         req.reject_reason = reason
         telemetry.inc("tdt_serving_admission_rejects_total", reason=reason)
         telemetry.emit("serving_reject", req_id=req.req_id, reason=reason)
+        slo.record_reject(req, reason)
         req.trace.finish(status="rejected", reason=reason)
         return req
 

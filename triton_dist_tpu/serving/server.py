@@ -126,7 +126,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from triton_dist_tpu.runtime import resilience, telemetry, tracing
+from triton_dist_tpu.runtime import resilience, slo, telemetry, tracing
 from triton_dist_tpu.runtime.utils import get_float_env, get_int_env
 from triton_dist_tpu.serving.scheduler import (
     KVLedger,
@@ -278,6 +278,9 @@ class InferenceServer:
         self._introspect = introspect.maybe_start()
         introspect.set_health_provider(self._health_info)
         introspect.set_requests_provider(self._requests_info)
+        # Live SLO view: per-tenant goodput/violations + latency quantiles
+        # and the engine's step-phase digests (see runtime/slo.py).
+        introspect.register_json_route("/slo", self._r_slo, methods=("GET",))
 
     def _build_drafter(self):
         """Construct the env-selected drafter (``TDT_SPEC_DRAFTER``):
@@ -385,6 +388,24 @@ class InferenceServer:
             "journal": (
                 self._journal.stats() if self._journal is not None else None
             ),
+        }
+
+    def _r_slo(self, method: str, query: str, body) -> tuple[int, dict]:
+        """The `/slo` introspection payload: per-(tenant, tier) goodput +
+        latency quantiles, and the engine's per-backend step-phase digests
+        ("where did this step's milliseconds go", live)."""
+        snap = telemetry.snapshot()
+        phases: dict[str, dict] = {}
+        for e in snap.get("digests", {}).get("tdt_engine_phase_seconds", []):
+            backend = e["labels"].get("backend", "?")
+            phases.setdefault(backend, {})[e["labels"].get("phase", "?")] = {
+                "count": e["count"], **(e.get("quantiles") or {})
+            }
+        return 200, {
+            **slo.slo_summary(snap),
+            "phases": phases,
+            "backend": self.engine.backend,
+            "alpha": telemetry.DIGEST_ALPHA,
         }
 
     def _is_ep_model(self) -> bool:
@@ -1116,6 +1137,9 @@ class InferenceServer:
             if tpot is not None:
                 telemetry.observe("tdt_serving_tpot_seconds", tpot)
             telemetry.inc("tdt_serving_requests_completed_total")
+        # Per-(tenant, tier) SLO ledger: digests + goodput/violation
+        # counters, classified against the request's own deadline fields.
+        slo.record_finish(req, reason)
         self.scheduler.finish(slot)
         self.scheduler.release(slot)
         self._remaining[slot.idx] = 0
@@ -1490,6 +1514,7 @@ class InferenceServer:
 
         introspect.set_health_provider(None)
         introspect.set_requests_provider(None)
+        introspect.register_json_route("/slo", None)
         if self._introspect is not None:
             self._introspect.stop()
             self._introspect = None

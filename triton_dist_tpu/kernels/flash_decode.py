@@ -272,6 +272,81 @@ def _paged_decode_kernel(
         lse_ref[0, 0] = lse.astype(lse_ref.dtype)
 
 
+def _paged_decode_quant_kernel(
+    tables_ref,  # scalar-prefetch (B, max_blocks) int32
+    lengths_ref,  # SMEM (B,)
+    q_ref,  # (1, group, d)
+    k_ref,  # (1, 1, bs, d) — one physical pool block, wire dtype
+    v_ref,  # (1, 1, bs, d)
+    ks_ref,  # (1, 1, bs, 1) f32 — the block's per-row scales
+    vs_ref,  # (1, 1, bs, 1) f32
+    o_ref,  # (1, group, d)
+    lse_ref,  # (1, 1, group)
+    acc_scr,  # VMEM (group, d) f32
+    m_scr,  # VMEM (group, LANES) f32
+    l_scr,  # VMEM (group, LANES) f32
+    *,
+    scale: float,
+    block_size: int,
+    n_kv: int,
+    hkv: int,
+):
+    """``_paged_decode_kernel`` over a QUANTIZED pool: the scale pool walks
+    the same table through the same index map (a whole (bs, 1) block read —
+    legal where a sublane-slice of a lane-padded memref is not, see
+    ``models/quant.py``), each block dequantizes to f32 in VMEM right after
+    the walk, and everything downstream is the identical online-softmax.
+    Dequantization ``q·scale`` is exact in f32 (power-of-two scales), so
+    this path is bitwise-comparable to the gather→dequant→contiguous oracle
+    at the same block partition."""
+    bh = pl.program_id(0)
+    ik = pl.program_id(1)
+    length = lengths_ref[bh // hkv]
+
+    @pl.when(ik == 0)
+    def _():
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+
+    @pl.when(ik * block_size < length)  # logical blocks past the cache end skip
+    def _():
+        q = q_ref[0]  # (group, d)
+        k = k_ref[0, 0].astype(jnp.float32) * ks_ref[0, 0]  # (bs, d) f32
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # (group, bs)
+        k_ids = ik * block_size + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(k_ids < length, s, NEG_INF)
+
+        m_prev = m_scr[...]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, jnp.broadcast_to(m_cur, m_prev.shape))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, :1])
+        l_scr[...] = l_scr[...] * alpha + jnp.broadcast_to(
+            jnp.sum(p, axis=1, keepdims=True), m_prev.shape
+        )
+        m_scr[...] = m_new
+        v = v_ref[0, 0].astype(jnp.float32) * vs_ref[0, 0]  # (bs, d) f32
+        acc_scr[...] = acc_scr[...] * alpha[:, :1] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(ik == n_kv - 1)
+    def _():
+        l = l_scr[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[...] / l_safe).astype(o_ref.dtype)
+        lse = jnp.where(
+            l_scr[:, 0] == 0.0,
+            NEG_INF,
+            m_scr[:, 0] + jnp.log(jnp.maximum(l_scr[:, 0], 1e-30)),
+        )
+        lse_ref[0, 0] = lse.astype(lse_ref.dtype)
+
+
 def gather_paged_kv(k_pool: jax.Array, tables: jax.Array) -> jax.Array:
     """Materialize a contiguous (B, Hkv, max_blocks*bs, D) cache view from a
     (num_blocks, Hkv, bs, D) pool and a (B, max_blocks) int32 block table.
@@ -296,6 +371,8 @@ def paged_flash_decode(
     scale: float | None = None,
     impl: str = "pallas",
     return_lse: bool = False,
+    k_scale: jax.Array | None = None,  # (num_blocks, Hkv, bs, 1) f32
+    v_scale: jax.Array | None = None,
 ):
     """One-token GQA decode against a PAGED cache.
 
@@ -305,7 +382,24 @@ def paged_flash_decode(
     position, physical position is table data, shapes stay fixed.
     ``impl="gather"`` is the oracle: gather the pool into a contiguous view
     and run the proven contiguous kernel at ``block_k=block_size`` (the
-    same KV partition → bitwise-identical accumulation order)."""
+    same KV partition → bitwise-identical accumulation order).
+
+    With ``k_scale``/``v_scale`` (or ``QuantPool`` operands) the pool is
+    quantized (``models/quant.py``): the kernel walks the parallel scale
+    pool through the same table and dequantizes each block to f32 right
+    after the VMEM read — no gather bounce, no fp32 pool ever materializes.
+    The gather oracle dequantizes host-side and feeds the contiguous kernel
+    f32 KV, which is the bitwise-identical computation (power-of-two scales
+    make dequantization exact in f32)."""
+    from triton_dist_tpu.models.quant import QuantPool, dequantize_kv
+
+    if isinstance(k_pool, QuantPool):
+        k_pool, k_scale = k_pool.q, k_pool.scale
+    if isinstance(v_pool, QuantPool):
+        v_pool, v_scale = v_pool.q, v_pool.scale
+    quant = k_scale is not None
+    assert (k_scale is None) == (v_scale is None)
+
     b, hq, d = q.shape
     nb, hkv, bs, _ = k_pool.shape
     assert hq % hkv == 0
@@ -316,6 +410,9 @@ def paged_flash_decode(
     if impl == "gather":
         kc = gather_paged_kv(k_pool, tables)
         vc = gather_paged_kv(v_pool, tables)
+        if quant:
+            kc = dequantize_kv(kc, gather_paged_kv(k_scale, tables))
+            vc = dequantize_kv(vc, gather_paged_kv(v_scale, tables))
         return flash_decode(
             q, kc, vc, lengths, scale=scale, block_k=bs, return_lse=return_lse
         )
@@ -324,21 +421,32 @@ def paged_flash_decode(
 
     qr = q.reshape(b, hkv, group, d).reshape(b * hkv, group, d)
 
+    def walk(width):
+        # Payload and scale pools walk the SAME table entry — one physical
+        # block id resolves both the bytes and their per-row scales.
+        return pl.BlockSpec(
+            (1, 1, bs, width),
+            lambda bh, ik, tab: (tab[bh // hkv, ik], bh % hkv, 0, 0),
+        )
+
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),
+        pl.BlockSpec((1, group, d), lambda bh, ik, tab: (bh, 0, 0)),
+        walk(d),
+        walk(d),
+    ]
+    operands = [lengths.astype(jnp.int32), qr, k_pool, v_pool]
+    if quant:
+        in_specs += [walk(1), walk(1)]
+        operands += [k_scale, v_scale]
+        kernel = _paged_decode_quant_kernel
+    else:
+        kernel = _paged_decode_kernel
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,  # tables ride ahead of the grid for index maps
         grid=(b * hkv, mb),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, group, d), lambda bh, ik, tab: (bh, 0, 0)),
-            pl.BlockSpec(
-                (1, 1, bs, d),
-                lambda bh, ik, tab: (tab[bh // hkv, ik], bh % hkv, 0, 0),
-            ),
-            pl.BlockSpec(
-                (1, 1, bs, d),
-                lambda bh, ik, tab: (tab[bh // hkv, ik], bh % hkv, 0, 0),
-            ),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, group, d), lambda bh, ik, tab: (bh, 0, 0)),
             pl.BlockSpec((1, 1, group), lambda bh, ik, tab: (bh, 0, 0)),
@@ -351,7 +459,7 @@ def paged_flash_decode(
     )
     o, lse = pl.pallas_call(
         functools.partial(
-            _paged_decode_kernel, scale=scale, block_size=bs, n_kv=mb, hkv=hkv
+            kernel, scale=scale, block_size=bs, n_kv=mb, hkv=hkv
         ),
         grid_spec=grid_spec,
         out_shape=[
@@ -364,10 +472,7 @@ def paged_flash_decode(
         interpret=interpret_mode_default(),
     )(
         tables.astype(jnp.int32).reshape(b, mb),
-        lengths.astype(jnp.int32),
-        qr,
-        k_pool,
-        v_pool,
+        *operands,
     )
 
     o = o.reshape(b, hq, d)

@@ -49,6 +49,12 @@ from triton_dist_tpu.runtime import resilience, telemetry
 from triton_dist_tpu.runtime.mesh import DistContext
 from triton_dist_tpu.kernels.allgather import all_gather_shard, AllGatherMethod
 from triton_dist_tpu.kernels.allreduce import all_reduce_shard, AllReduceMethod
+from triton_dist_tpu.kernels.allgather_gemm import (
+    SCALE_LANES,
+    _dequant_chunk,
+    _is_quant,
+    note_quant_dispatch,
+)
 from triton_dist_tpu.kernels.gemm import GemmConfig, fit_block
 from triton_dist_tpu.kernels.gemm_reduce_scatter import _gemm_rs_xla_ring
 from triton_dist_tpu.shmem import kernel as sk
@@ -72,22 +78,28 @@ class GemmARMethod(enum.Enum):
 DEFAULT_GEMM_AR_CROSSOVER_M = 64
 
 
-def gemm_ar_crossover_m(world: int) -> int:
+def gemm_ar_crossover_m(world: int, wire: str | None = None) -> int:
     """ll_one_shot↔pallas_fused routing threshold (rows of M), fed from the
     tune cache (``gemm_ar_crossover|world=<w>``, emitted by bench.py's
     ``gemm_ar_decode`` section) through ``agreed_cfg_value`` — the lookup is
     resolved once per process and gated by cross-rank agreement, because the
     two sides of the crossover are different collective kernels (see
-    ``allreduce.ar_crossover_bytes`` for the deadlock argument)."""
+    ``allreduce.ar_crossover_bytes`` for the deadlock argument).
+
+    ``wire`` keys a dtype-aware entry (``…|wire=fp8``): a quantized A operand
+    leaves the fp32 partial wire untouched but shifts the GEMM-side HBM
+    traffic, so the tuned crossover differs from the bf16/f32 one."""
     from triton_dist_tpu.tools.tune import agreed_cfg_value
 
-    return agreed_cfg_value(
-        f"gemm_ar_crossover|world={world}", "crossover_m",
-        DEFAULT_GEMM_AR_CROSSOVER_M,
-    )
+    key = f"gemm_ar_crossover|world={world}"
+    if wire is not None:
+        key += f"|wire={wire}"
+    return agreed_cfg_value(key, "crossover_m", DEFAULT_GEMM_AR_CROSSOVER_M)
 
 
-def get_auto_gemm_ar_method(m: int, world: int) -> GemmARMethod:
+def get_auto_gemm_ar_method(
+    m: int, world: int, wire: str | None = None
+) -> GemmARMethod:
     """Reference ``get_auto_method`` analog for GEMM-AR: ragged M (the fused
     ring chunks rows over ranks) or decode-sized M → the low-latency one-shot
     kernel; larger M → the tile-granular fused ring.
@@ -101,7 +113,7 @@ def get_auto_gemm_ar_method(m: int, world: int) -> GemmARMethod:
             "gemm_ar.auto", "routing AUTO gemm+allreduce to XLA dot+psum"
         )
         method = GemmARMethod.XLA
-    elif m % world != 0 or m <= gemm_ar_crossover_m(world):
+    elif m % world != 0 or m <= gemm_ar_crossover_m(world, wire):
         method = GemmARMethod.LL_ONE_SHOT
     else:
         method = GemmARMethod.PALLAS_FUSED
@@ -131,30 +143,34 @@ def create_gemm_ar_context(
 def _gemm_ar_fused_kernel(
     sched_ref,  # SMEM (world,) int32 — sched[s] = (me - 1 - s) % world
     a_ref,  # (bm, bk) VMEM — pipelined A tile (rows of chunk sched[s])
-    b_ref,  # (bk, bn) VMEM — pipelined B tile
-    o_ref,  # (m, n) ANY — full product; my chunk tile-DMA'd at s==world-1,
-    #         the rest ring-broadcast in the AG phase
-    send_buf,  # (2, chunk, n) f32 ANY — outgoing partial chunk, per-slot
-    recv_buf,  # (2, chunk, n) f32 ANY — incoming partial chunk, per-slot
-    status_ref,  # SMEM (STATUS_WORDS,) bounded-wait abort record
-    acc,  # VMEM (bm, bn) f32
-    recv_tile,  # VMEM (bm, bn) f32 — staged incoming tile
-    send_stage,  # VMEM (2, bm, bn) f32 — outgoing tile, double-buffered
-    out_stage,  # VMEM (2, bm, bn) out dtype — final tile, double-buffered
-    recv_sem,  # DMA (2,)
-    send_sem,  # DMA (2,) — remote send completion
-    tile_out_sem,  # DMA (2,) — local copies into send_buf (byte-counted)
-    tile_in_sem,  # DMA (1,) — recv tile staging
-    out_sem,  # DMA (2,) — final tile copies into o_ref
-    ag_send_sem,  # DMA (world-1,) — AG-phase sends, one slot per ring step
-    ag_recv_sem,  # DMA (world-1,) — AG-phase arrivals, one slot per ring step
-    credit_sem,  # REGULAR (2,) — receiver → left: RS slot consumed
-    *,
+    # When ``quant``, an ``a_scale_ref`` — (bm, SCALE_LANES) VMEM f32 per-row
+    # scales walked in lockstep with a_ref — precedes b_ref in ``rest``.
+    # Then, in order:
+    #   b_ref,      (bk, bn) VMEM — pipelined B tile
+    #   o_ref,      (m, n) ANY — full product; my chunk tile-DMA'd at
+    #               s==world-1, the rest ring-broadcast in the AG phase
+    #   send_buf,   (2, chunk, n) f32 ANY — outgoing partial chunk, per-slot
+    #   recv_buf,   (2, chunk, n) f32 ANY — incoming partial chunk, per-slot
+    #   status_ref, SMEM (STATUS_WORDS,) bounded-wait abort record
+    #   acc,        VMEM (bm, bn) f32
+    #   recv_tile,  VMEM (bm, bn) f32 — staged incoming tile
+    #   send_stage, VMEM (2, bm, bn) f32 — outgoing tile, double-buffered
+    #   out_stage,  VMEM (2, bm, bn) out dtype — final tile, double-buffered
+    #   recv_sem,   DMA (2,)
+    #   send_sem,   DMA (2,) — remote send completion
+    #   tile_out_sem,  DMA (2,) — local copies into send_buf (byte-counted)
+    #   tile_in_sem,   DMA (1,) — recv tile staging
+    #   out_sem,    DMA (2,) — final tile copies into o_ref
+    #   ag_send_sem,  DMA (world-1,) — AG-phase sends, one slot per step
+    #   ag_recv_sem,  DMA (world-1,) — AG-phase arrivals, one slot per step
+    #   credit_sem,   REGULAR (2,) — receiver → left: RS slot consumed
+    *rest,
     axis,
     mesh_axes,
     n_m: int,
     n_n: int,
     n_k: int,
+    quant: bool = False,
 ):
     """Fused GEMM + all-reduce in one kernel: ring reduce-scatter matmul
     (identical structure to ``_gemm_rs_fused_kernel`` — step ``s`` computes
@@ -166,6 +182,14 @@ def _gemm_ar_fused_kernel(
     credit-semaphore backpressure on its two send slots; the AG leg needs no
     credits because each of its ``world-1`` steps owns a dedicated slot and
     the destination rows are disjoint per chunk."""
+    rest = list(rest)
+    a_scale_ref = rest.pop(0) if quant else None
+    (
+        b_ref, o_ref, send_buf, recv_buf, status_ref,
+        acc, recv_tile, send_stage, out_stage,
+        recv_sem, send_sem, tile_out_sem, tile_in_sem, out_sem,
+        ag_send_sem, ag_recv_sem, credit_sem,
+    ) = rest
     s, im, jn, kk = (pl.program_id(i) for i in range(4))
     me = tpl.rank(axis)
     world = tpl.num_ranks(axis)
@@ -219,8 +243,16 @@ def _gemm_ar_fused_kernel(
     def _():
         acc[...] = jnp.zeros_like(acc)
 
+    a_tile = a_ref[...]
+    if quant:
+        # Dequantize during the VMEM tile consume: exact power-of-two
+        # ``q * scale`` in f32, cast to the weight dtype — the ring wire
+        # stays fp32 partials, only the A operand arrives quantized.
+        a_tile = (a_tile.astype(jnp.float32) * a_scale_ref[:, :1]).astype(
+            b_ref.dtype
+        )
     acc[...] += jax.lax.dot_general(
-        a_ref[...], b_ref[...], (((1,), (0,)), ((), ())),
+        a_tile, b_ref[...], (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
 
@@ -383,7 +415,10 @@ def _gemm_ar_fused(a, b, *, axis, mesh_axes, config=None):
     # deadlock. Callers go through gemm_ar_shard's world==1 shortcut.
     assert world > 1, "fused GEMM-AR needs world > 1 (use gemm_ar_shard)"
     me = jax.lax.axis_index(axis)
-    m, k = a.shape
+    quant = _is_quant(a)
+    a_q = a.q if quant else a
+    out_dt = b.dtype if quant else a.dtype
+    m, k = a_q.shape
     n = b.shape[1]
     assert m % world == 0, (m, world)
     chunk = m // world
@@ -395,7 +430,23 @@ def _gemm_ar_fused(a, b, *, axis, mesh_axes, config=None):
     bk = fit_block(k, cfg.block_k)
     n_m, n_n, n_k = chunk // bm, n // bn, k // bk
     sched = jnp.mod(me - 1 - jnp.arange(world, dtype=jnp.int32), world).astype(jnp.int32)
+    kernel_name = "_gemm_ar_fused_kernel" + ("_quant" if quant else "")
 
+    in_specs = [
+        pl.BlockSpec(
+            (bm, bk), lambda s, im, jn, kk, sched: (sched[s] * n_m + im, kk)
+        ),
+    ]
+    if quant:
+        # Per-row scale tile walks the same row schedule as its A tile.
+        in_specs.append(
+            pl.BlockSpec(
+                (bm, SCALE_LANES),
+                lambda s, im, jn, kk, sched: (sched[s] * n_m + im, 0),
+            )
+        )
+    in_specs.append(pl.BlockSpec((bk, bn), lambda s, im, jn, kk, sched: (kk, jn)))
+    operands = (sched, a_q, a.scale, b) if quant else (sched, a_q, b)
     out, _, _, status = dist_pallas_call(
         functools.partial(
             _gemm_ar_fused_kernel,
@@ -404,16 +455,12 @@ def _gemm_ar_fused(a, b, *, axis, mesh_axes, config=None):
             n_m=n_m,
             n_n=n_n,
             n_k=n_k,
+            quant=quant,
         ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(world, n_m, n_n, n_k),
-            in_specs=[
-                pl.BlockSpec(
-                    (bm, bk), lambda s, im, jn, kk, sched: (sched[s] * (a.shape[0] // world // bm) + im, kk)
-                ),
-                pl.BlockSpec((bk, bn), lambda s, im, jn, kk, sched: (kk, jn)),
-            ],
+            in_specs=in_specs,
             out_specs=(
                 pl.BlockSpec(memory_space=pl.ANY),
                 pl.BlockSpec(memory_space=pl.ANY),
@@ -424,7 +471,7 @@ def _gemm_ar_fused(a, b, *, axis, mesh_axes, config=None):
                 pltpu.VMEM((bm, bn), jnp.float32),
                 pltpu.VMEM((bm, bn), jnp.float32),
                 pltpu.VMEM((2, bm, bn), jnp.float32),
-                pltpu.VMEM((2, bm, bn), a.dtype),
+                pltpu.VMEM((2, bm, bn), out_dt),
                 pltpu.SemaphoreType.DMA((2,)),
                 pltpu.SemaphoreType.DMA((2,)),
                 pltpu.SemaphoreType.DMA((2,)),
@@ -436,7 +483,7 @@ def _gemm_ar_fused(a, b, *, axis, mesh_axes, config=None):
             ],
         ),
         out_shape=(
-            jax.ShapeDtypeStruct((m, n), a.dtype),
+            jax.ShapeDtypeStruct((m, n), out_dt),
             jax.ShapeDtypeStruct((2, chunk, n), jnp.float32),
             jax.ShapeDtypeStruct((2, chunk, n), jnp.float32),
             sk.status_out_shape(),
@@ -444,21 +491,21 @@ def _gemm_ar_fused(a, b, *, axis, mesh_axes, config=None):
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary", "arbitrary", "arbitrary"),
             has_side_effects=True,
-            collective_id=collective_id_for("_gemm_ar_fused_kernel"),
+            collective_id=collective_id_for(kernel_name),
         ),
-    )(sched, a, b)
-    resilience.consume_status(
-        status, feature="gemm_ar", kernel="_gemm_ar_fused_kernel"
-    )
+    )(*operands)
+    resilience.consume_status(status, feature="gemm_ar", kernel=kernel_name)
     return out
 
 
 def _gemm_ar_ll_kernel(
     a_ref,  # (m, bk) VMEM — pipelined A panel (full M: ragged/tiny is fine)
-    b_ref,  # (bk, bn) VMEM — pipelined B tile
-    out_ref,  # (m, n) VMEM — full reduced product (flushed once, at the end)
-    gather_buf,  # (world, m, n) f32 ANY — symmetric landing zones (dummy out)
-    status_ref,  # SMEM (STATUS_WORDS,) bounded-wait abort record
+    # When ``quant``, an ``a_scale_ref`` — (m, SCALE_LANES) VMEM f32 per-row
+    # scales, constant across the grid — precedes b_ref in ``rest``. Then:
+    #   b_ref,     (bk, bn) VMEM — pipelined B tile
+    #   out_ref,   (m, n) VMEM — full reduced product (flushed once, at end)
+    #   gather_buf, (world, m, n) f32 ANY — symmetric landing zones (dummy)
+    #   status_ref, SMEM (STATUS_WORDS,) bounded-wait abort record
     # With ``trace`` set, its SMEM event buffer follows status_ref (the last
     # output); then the scratch operands below in order:
     #   acc,       VMEM (m, bn) f32
@@ -474,6 +521,7 @@ def _gemm_ar_ll_kernel(
     mesh_axes,
     n_n: int,
     n_k: int,
+    quant: bool = False,
     trace=None,
 ):
     """Fused low-latency GEMM-AR (grid ``(Nt, Kt)``): the partial GEMM's
@@ -485,6 +533,10 @@ def _gemm_ar_ll_kernel(
     per peer covers its whole (m, n) contribution. fp32 on the wire → exact
     parity with the fp32-accum ``dot + psum`` reference."""
     rest = list(rest)
+    a_scale_ref = rest.pop(0) if quant else None
+    b_ref, out_ref, gather_buf, status_ref = (
+        rest.pop(0), rest.pop(0), rest.pop(0), rest.pop(0)
+    )
     ev_ref = rest.pop(0) if trace is not None else None
     acc, stage, red, tmp, tile_sem, send_sem, recv_sem, copy_sem = rest
     jn, kk = pl.program_id(0), pl.program_id(1)
@@ -514,8 +566,16 @@ def _gemm_ar_ll_kernel(
             trace.mark(ev_ref, jn, profiler.TAG_COMPUTE, kk)
         acc[...] = jnp.zeros_like(acc)
 
+    a_panel = a_ref[...]
+    if quant:
+        # Dequantize the full-M panel during the VMEM consume — exact
+        # power-of-two ``q * scale`` in f32, cast to the weight dtype. The
+        # fp32 landing-zone wire is unchanged; only A arrives quantized.
+        a_panel = (a_panel.astype(jnp.float32) * a_scale_ref[:, :1]).astype(
+            b_ref.dtype
+        )
     acc[...] += jax.lax.dot_general(
-        a_ref[...], b_ref[...], (((1,), (0,)), ((), ())),
+        a_panel, b_ref[...], (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
 
@@ -604,12 +664,16 @@ def gemm_ar_ll_call(a, b, *, axis, mesh_axes=None, config=None):
     to the local landing-zone copy; the measured time is the kernel-overhead
     floor, symmetric with ``allreduce.one_shot_ar_call``)."""
     world = jax.lax.axis_size(axis)
-    m, k = a.shape
+    quant = _is_quant(a)
+    a_q = a.q if quant else a
+    out_dt = b.dtype if quant else a.dtype
+    m, k = a_q.shape
     n = b.shape[1]
     cfg = config or GemmConfig(512, 512, 1024)
     bn = fit_block(n, cfg.block_n)
     bk = fit_block(k, cfg.block_k)
     n_n, n_k = n // bn, k // bk
+    kernel_name = "_gemm_ar_ll_kernel" + ("_quant" if quant else "")
 
     trace = telemetry.maybe_kernel_trace()
     out_specs = [
@@ -620,23 +684,27 @@ def gemm_ar_ll_call(a, b, *, axis, mesh_axes=None, config=None):
         sk.status_out_spec(),
     ]
     out_shape = [
-        jax.ShapeDtypeStruct((m, n), a.dtype),
+        jax.ShapeDtypeStruct((m, n), out_dt),
         jax.ShapeDtypeStruct((world, m, n), jnp.float32),
         sk.status_out_shape(),
     ]
     if trace is not None:
         out_specs.append(trace.out_spec())
         out_shape.append(trace.out_shape)
+    in_specs = [pl.BlockSpec((m, bk), lambda jn, kk: (0, kk))]
+    if quant:
+        # Whole-panel scales, constant across the (Nt, Kt) grid — the LL
+        # kernel keeps the full M rows resident, so the scales do too.
+        in_specs.append(pl.BlockSpec((m, SCALE_LANES), lambda jn, kk: (0, 0)))
+    in_specs.append(pl.BlockSpec((bk, bn), lambda jn, kk: (kk, jn)))
+    operands = (a_q, a.scale, b) if quant else (a_q, b)
     out, _, status, *ev = dist_pallas_call(
         functools.partial(
             _gemm_ar_ll_kernel, axis=axis, mesh_axes=mesh_axes, n_n=n_n, n_k=n_k,
-            trace=trace,
+            quant=quant, trace=trace,
         ),
         grid=(n_n, n_k),
-        in_specs=[
-            pl.BlockSpec((m, bk), lambda jn, kk: (0, kk)),
-            pl.BlockSpec((bk, bn), lambda jn, kk: (kk, jn)),
-        ],
+        in_specs=in_specs,
         out_specs=tuple(out_specs),
         out_shape=tuple(out_shape),
         scratch_shapes=[
@@ -652,14 +720,12 @@ def gemm_ar_ll_call(a, b, *, axis, mesh_axes=None, config=None):
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary"),
             has_side_effects=True,
-            collective_id=collective_id_for("_gemm_ar_ll_kernel"),
+            collective_id=collective_id_for(kernel_name),
         ),
-    )(a, b)
-    resilience.consume_status(
-        status, feature="gemm_ar", kernel="_gemm_ar_ll_kernel"
-    )
+    )(*operands)
+    resilience.consume_status(status, feature="gemm_ar", kernel=kernel_name)
     if trace is not None:
-        telemetry.consume_kernel_trace(trace, ev[0], kernel="_gemm_ar_ll_kernel")
+        telemetry.consume_kernel_trace(trace, ev[0], kernel=kernel_name)
     return out
 
 
@@ -676,15 +742,23 @@ def gemm_ar_shard(
     product. Usable inside shard_map. Reference host ops
     ``gemm_ar_op``/``ll_gemm_ar_op`` (``gemm_allreduce.py:660,:722``)."""
     world = jax.lax.axis_size(axis)
-    m = a.shape[0]
+    quant = _is_quant(a)
+    out_dt = b.dtype if quant else a.dtype
+    m = a.q.shape[0] if quant else a.shape[0]
     if world == 1:
-        return jnp.dot(a, b, preferred_element_type=jnp.float32).astype(a.dtype)
+        a1 = _dequant_chunk(a.q, a.scale, b.dtype) if quant else a
+        return jnp.dot(a1, b, preferred_element_type=jnp.float32).astype(out_dt)
+    if quant:
+        # AR wire stays fp32 partials: no wire_hops — the win is the
+        # quantized A operand's HBM/VMEM footprint.
+        note_quant_dispatch("gemm_ar", a, world)
     if method is GemmARMethod.AUTO:
-        method = get_auto_gemm_ar_method(m, world)
+        method = get_auto_gemm_ar_method(m, world, wire=a.wire if quant else None)
 
     if method is GemmARMethod.XLA:
-        partial = jnp.dot(a, b, preferred_element_type=jnp.float32)
-        return jax.lax.psum(partial, axis).astype(a.dtype)
+        a1 = _dequant_chunk(a.q, a.scale, b.dtype) if quant else a
+        partial = jnp.dot(a1, b, preferred_element_type=jnp.float32)
+        return jax.lax.psum(partial, axis).astype(out_dt)
 
     if method is GemmARMethod.LL_ONE_SHOT:
         return gemm_ar_ll_call(
@@ -695,11 +769,13 @@ def gemm_ar_shard(
         return _gemm_ar_fused(a, b, axis=axis, mesh_axes=mesh_axes, config=gemm_config)
 
     if method is GemmARMethod.ONE_SHOT:
-        partial = jnp.dot(a, b, preferred_element_type=jnp.float32).astype(a.dtype)
+        a1 = _dequant_chunk(a.q, a.scale, b.dtype) if quant else a
+        partial = jnp.dot(a1, b, preferred_element_type=jnp.float32).astype(out_dt)
         return all_reduce_shard(
             partial, axis=axis, mesh_axes=mesh_axes, method=AllReduceMethod.ONE_SHOT
         )
 
+    # RS_AG: _gemm_rs_xla_ring handles a quantized A itself.
     scattered = _gemm_rs_xla_ring(a, b, axis=axis)
     gathered = all_gather_shard(
         scattered, axis=axis, mesh_axes=mesh_axes, method=AllGatherMethod.RING_1D

@@ -41,6 +41,12 @@ from jax.sharding import PartitionSpec as P
 import triton_dist_tpu.language as tpl
 from triton_dist_tpu.runtime import resilience, telemetry
 from triton_dist_tpu.runtime.mesh import DistContext
+from triton_dist_tpu.kernels.allgather_gemm import (
+    SCALE_LANES,
+    _dequant_chunk,
+    _is_quant,
+    note_quant_dispatch,
+)
 from triton_dist_tpu.kernels.gemm import gemm, GemmConfig
 from triton_dist_tpu.kernels.reduce_scatter import reduce_scatter_shard
 from triton_dist_tpu.shmem import kernel as sk
@@ -80,22 +86,30 @@ def create_gemm_rs_context(
 DEFAULT_GEMM_RS_CROSSOVER_M = 256
 
 
-def gemm_rs_crossover_m(world: int) -> int:
+def gemm_rs_crossover_m(world: int, wire: str | None = None) -> int:
     """xla_ring↔pallas_fused routing threshold (rows of M), fed from the
     tune cache (``gemm_rs_crossover|world=<w>``, emitted by bench.py's
     ``prefill_overlap`` section) through ``agreed_cfg_value`` — resolved once
     per process and gated by cross-rank agreement, because the two sides of
     the crossover are different collective programs (see
-    ``allreduce.ar_crossover_bytes`` for the deadlock argument)."""
+    ``allreduce.ar_crossover_bytes`` for the deadlock argument).
+
+    ``wire`` selects the dtype-aware entry
+    (``gemm_rs_crossover|world=<w>|wire=<wire>``): the RS wire itself stays
+    fp32 partials, but a quantized A operand shifts the GEMM:HBM ratio (the
+    fused kernel reads 2–4x fewer A bytes per tile), so the profitable
+    crossover differs from the bf16 one."""
     from triton_dist_tpu.tools.tune import agreed_cfg_value
 
-    return agreed_cfg_value(
-        f"gemm_rs_crossover|world={world}", "crossover_m",
-        DEFAULT_GEMM_RS_CROSSOVER_M,
-    )
+    key = f"gemm_rs_crossover|world={world}"
+    if wire:
+        key += f"|wire={wire}"
+    return agreed_cfg_value(key, "crossover_m", DEFAULT_GEMM_RS_CROSSOVER_M)
 
 
-def get_auto_gemm_rs_method(m: int, world: int) -> GemmRSMethod:
+def get_auto_gemm_rs_method(
+    m: int, world: int, wire: str | None = None
+) -> GemmRSMethod:
     """Reference ``get_auto_method`` analog for GEMM-RS: ragged M (the fused
     ring chunks rows over ranks) or small M → the XLA ring's
     compiler-scheduled overlap; prefill-sized M above the tuned crossover →
@@ -110,7 +124,7 @@ def get_auto_gemm_rs_method(m: int, world: int) -> GemmRSMethod:
             "gemm_rs.auto", "routing AUTO gemm+reduce_scatter to XLA dot+psum_scatter"
         )
         method = GemmRSMethod.XLA
-    elif m % world != 0 or m <= gemm_rs_crossover_m(world):
+    elif m % world != 0 or m <= gemm_rs_crossover_m(world, wire):
         method = GemmRSMethod.XLA_RING
     else:
         method = GemmRSMethod.PALLAS_FUSED
@@ -122,16 +136,26 @@ def get_auto_gemm_rs_method(m: int, world: int) -> GemmRSMethod:
 
 def _gemm_rs_xla_ring(a, b, *, axis, accum_dtype=jnp.float32):
     """Ring reduce-scatter matmul (see module doc). Chunk ``c`` finishes on
-    rank ``c`` after visiting every rank once."""
+    rank ``c`` after visiting every rank once. ``a`` may be a QuantTensor —
+    each row chunk is then dequantized right before its chunk-GEMM (fp32
+    accumulate); the ring wire carries fp32 partials either way."""
+    quant = _is_quant(a)
+    out_dt = b.dtype if quant else a.dtype
     world = jax.lax.axis_size(axis)
     me = jax.lax.axis_index(axis)
-    m, _ = a.shape
+    m = a.shape[0]
+    k = a.shape[1]
     assert m % world == 0, (m, world)
     chunk = m // world
     perm = [(i, (i + 1) % world) for i in range(world)]
 
     def chunk_gemm(idx):
-        rows = jax.lax.dynamic_slice(a, (idx * chunk, 0), (chunk, a.shape[1]))
+        if quant:
+            q = jax.lax.dynamic_slice(a.q, (idx * chunk, 0), (chunk, k))
+            sc = jax.lax.dynamic_slice(a.scale, (idx * chunk, 0), (chunk, 1))
+            rows = _dequant_chunk(q, sc, out_dt)
+        else:
+            rows = jax.lax.dynamic_slice(a, (idx * chunk, 0), (chunk, k))
         return jnp.dot(rows, b, preferred_element_type=accum_dtype)
 
     first = jnp.mod(me - 1, world)
@@ -140,17 +164,22 @@ def _gemm_rs_xla_ring(a, b, *, axis, accum_dtype=jnp.float32):
         acc = jax.lax.ppermute(acc, axis, perm)
         incoming = jnp.mod(me - s - 2, world)
         acc = acc + chunk_gemm(incoming)
-    return acc.astype(a.dtype)
+    return acc.astype(out_dt)
 
 
 def _gemm_rs_fused_kernel(
     sched_ref,  # SMEM (world,) int32 — sched[s] = (me - 1 - s) % world
-    a_ref,  # (bm, bk) VMEM — pipelined A tile (rows of chunk sched[s])
-    b_ref,  # (bk, bn) VMEM — pipelined B tile
-    o_ref,  # (chunk, n) ANY — final reduced chunk, tile-DMA'd at s==world-1
-    send_buf,  # (2, chunk, n) f32 ANY — outgoing partial chunk, per-slot
-    recv_buf,  # (2, chunk, n) f32 ANY — incoming partial chunk, per-slot
-    status_ref,  # SMEM (STATUS_WORDS,) bounded-wait abort record
+    a_ref,  # (bm, bk) VMEM — pipelined A tile (rows of chunk sched[s]);
+    #         wire dtype under ``quant``, then the row-aligned scale tile
+    #         follows as the next input:
+    #   a_scale_ref, (bm, SCALE_LANES) f32 VMEM — per-row scales of this tile
+    # then:
+    #   b_ref,      (bk, bn) VMEM — pipelined B tile
+    #   o_ref,      (chunk, n) ANY — final reduced chunk, tile-DMA'd at
+    #               s==world-1
+    #   send_buf,   (2, chunk, n) f32 ANY — outgoing partial chunk, per-slot
+    #   recv_buf,   (2, chunk, n) f32 ANY — incoming partial chunk, per-slot
+    #   status_ref, SMEM (STATUS_WORDS,) bounded-wait abort record
     # With ``trace`` set, its SMEM event buffer follows status_ref (the last
     # output); then the scratch operands below in order:
     #   acc,          VMEM (bm, bn) f32
@@ -169,6 +198,7 @@ def _gemm_rs_fused_kernel(
     n_m: int,
     n_n: int,
     n_k: int,
+    quant: bool = False,
     trace=None,
 ):
     """Fused ring reduce-scatter matmul (see module doc). Step ``s`` computes
@@ -179,6 +209,12 @@ def _gemm_rs_fused_kernel(
     and carry the SMEM status-buffer abort protocol (phase + peer named on
     timeout); LOCAL DMA drains stay unbounded by design."""
     rest = list(rest)
+    a_scale_ref = rest.pop(0) if quant else None
+    b_ref = rest.pop(0)
+    o_ref = rest.pop(0)
+    send_buf = rest.pop(0)
+    recv_buf = rest.pop(0)
+    status_ref = rest.pop(0)
     ev_ref = rest.pop(0) if trace is not None else None
     (acc, recv_tile, send_stage, out_stage, recv_sem, send_sem, tile_out_sem,
      tile_in_sem, out_sem, credit_sem) = rest
@@ -243,8 +279,16 @@ def _gemm_rs_fused_kernel(
     def _():
         acc[...] = jnp.zeros_like(acc)
 
+    a_tile = a_ref[...]
+    if quant:
+        # Dequantize during the VMEM tile consume: exact power-of-two
+        # ``q * scale`` in f32, cast to the weight dtype — the ring wire
+        # stays fp32 partials, only the A operand arrives quantized.
+        a_tile = (a_tile.astype(jnp.float32) * a_scale_ref[:, :1]).astype(
+            b_ref.dtype
+        )
     acc[...] += jax.lax.dot_general(
-        a_ref[...], b_ref[...], (((1,), (0,)), ((), ())),
+        a_tile, b_ref[...], (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
 
@@ -378,7 +422,10 @@ def _gemm_rs_fused(a, b, *, axis, mesh_axes, config=None):
     # gemm_rs_shard's world==1 shortcut.
     assert world > 1, "fused GEMM-RS needs world > 1 (use gemm_rs_shard)"
     me = jax.lax.axis_index(axis)
-    m, k = a.shape
+    quant = _is_quant(a)
+    a_q = a.q if quant else a
+    out_dt = b.dtype if quant else a.dtype
+    m, k = a_q.shape
     n = b.shape[1]
     assert m % world == 0, (m, world)
     chunk = m // world
@@ -392,6 +439,7 @@ def _gemm_rs_fused(a, b, *, axis, mesh_axes, config=None):
     bk = fit_block(k, cfg.block_k)
     n_m, n_n, n_k = chunk // bm, n // bn, k // bk
     sched = jnp.mod(me - 1 - jnp.arange(world, dtype=jnp.int32), world).astype(jnp.int32)
+    kernel_name = "_gemm_rs_fused_kernel" + ("_quant" if quant else "")
 
     trace = telemetry.maybe_kernel_trace()
     out_specs = [
@@ -401,7 +449,7 @@ def _gemm_rs_fused(a, b, *, axis, mesh_axes, config=None):
         sk.status_out_spec(),
     ]
     out_shape = [
-        jax.ShapeDtypeStruct((chunk, n), a.dtype),
+        jax.ShapeDtypeStruct((chunk, n), out_dt),
         jax.ShapeDtypeStruct((2, chunk, n), jnp.float32),
         jax.ShapeDtypeStruct((2, chunk, n), jnp.float32),
         sk.status_out_shape(),
@@ -409,6 +457,22 @@ def _gemm_rs_fused(a, b, *, axis, mesh_axes, config=None):
     if trace is not None:
         out_specs.append(trace.out_spec())
         out_shape.append(trace.out_shape)
+    in_specs = [
+        pl.BlockSpec(
+            (bm, bk), lambda s, im, jn, kk, sched: (sched[s] * n_m + im, kk)
+        ),
+    ]
+    if quant:
+        # Per-row scale tile rides next to its A tile; the index map mirrors
+        # the A map's row walk so scale rows stay aligned with q rows.
+        in_specs.append(
+            pl.BlockSpec(
+                (bm, SCALE_LANES),
+                lambda s, im, jn, kk, sched: (sched[s] * n_m + im, 0),
+            )
+        )
+    in_specs.append(pl.BlockSpec((bk, bn), lambda s, im, jn, kk, sched: (kk, jn)))
+    operands = (sched, a_q, a.scale, b) if quant else (sched, a_q, b)
     out, _, _, status, *ev = dist_pallas_call(
         functools.partial(
             _gemm_rs_fused_kernel,
@@ -417,23 +481,19 @@ def _gemm_rs_fused(a, b, *, axis, mesh_axes, config=None):
             n_m=n_m,
             n_n=n_n,
             n_k=n_k,
+            quant=quant,
             trace=trace,
         ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(world, n_m, n_n, n_k),
-            in_specs=[
-                pl.BlockSpec(
-                    (bm, bk), lambda s, im, jn, kk, sched: (sched[s] * (a.shape[0] // world // bm) + im, kk)
-                ),
-                pl.BlockSpec((bk, bn), lambda s, im, jn, kk, sched: (kk, jn)),
-            ],
+            in_specs=in_specs,
             out_specs=tuple(out_specs),
             scratch_shapes=[
                 pltpu.VMEM((bm, bn), jnp.float32),
                 pltpu.VMEM((bm, bn), jnp.float32),
                 pltpu.VMEM((2, bm, bn), jnp.float32),
-                pltpu.VMEM((2, bm, bn), a.dtype),
+                pltpu.VMEM((2, bm, bn), out_dt),
                 pltpu.SemaphoreType.DMA((2,)),
                 pltpu.SemaphoreType.DMA((2,)),
                 pltpu.SemaphoreType.DMA((2,)),
@@ -446,14 +506,12 @@ def _gemm_rs_fused(a, b, *, axis, mesh_axes, config=None):
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary", "arbitrary", "arbitrary"),
             has_side_effects=True,
-            collective_id=collective_id_for("_gemm_rs_fused_kernel"),
+            collective_id=collective_id_for(kernel_name),
         ),
-    )(sched, a, b)
-    resilience.consume_status(
-        status, feature="gemm_rs", kernel="_gemm_rs_fused_kernel"
-    )
+    )(*operands)
+    resilience.consume_status(status, feature="gemm_rs", kernel=kernel_name)
     if trace is not None:
-        telemetry.consume_kernel_trace(trace, ev[0], kernel="_gemm_rs_fused_kernel")
+        telemetry.consume_kernel_trace(trace, ev[0], kernel=kernel_name)
     return out
 
 
@@ -470,22 +528,34 @@ def gemm_rs_shard(
     ``(m/world, n)`` row-chunk of the summed product. Usable inside shard_map.
     Reference host op ``gemm_rs`` (``gemm_reduce_scatter.py:593``)."""
     world = jax.lax.axis_size(axis)
+    quant = _is_quant(a)
+    out_dt = b.dtype if quant else a.dtype
     if world == 1:
-        return jnp.dot(a, b, preferred_element_type=jnp.float32).astype(a.dtype)
+        a1 = _dequant_chunk(a.q, a.scale, b.dtype) if quant else a
+        return jnp.dot(a1, b, preferred_element_type=jnp.float32).astype(out_dt)
+    if quant:
+        # RS wire stays fp32 partials: no wire_hops — the win is the
+        # quantized A operand's HBM/VMEM footprint.
+        note_quant_dispatch("gemm_rs", a, world)
     if method is GemmRSMethod.AUTO:
-        method = get_auto_gemm_rs_method(a.shape[0], world)
+        m_rows = a.q.shape[0] if quant else a.shape[0]
+        method = get_auto_gemm_rs_method(
+            m_rows, world, wire=a.wire if quant else None
+        )
 
     if method is GemmRSMethod.XLA:
-        partial = jnp.dot(a, b, preferred_element_type=jnp.float32)
+        a1 = _dequant_chunk(a.q, a.scale, b.dtype) if quant else a
+        partial = jnp.dot(a1, b, preferred_element_type=jnp.float32)
         return jax.lax.psum_scatter(
             partial, axis, scatter_dimension=0, tiled=True
-        ).astype(a.dtype)
+        ).astype(out_dt)
 
     if method is GemmRSMethod.PALLAS_FUSED:
         return _gemm_rs_fused(a, b, axis=axis, mesh_axes=mesh_axes, config=gemm_config)
 
     if method is GemmRSMethod.PALLAS:
-        partial = gemm(a, b, config=gemm_config)
+        a1 = _dequant_chunk(a.q, a.scale, b.dtype) if quant else a
+        partial = gemm(a1, b, config=gemm_config)
         return reduce_scatter_shard(partial, axis=axis, mesh_axes=mesh_axes)
 
     return _gemm_rs_xla_ring(a, b, axis=axis)

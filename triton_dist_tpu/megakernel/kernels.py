@@ -528,11 +528,20 @@ def fused_paged_attn_back(
     another tenant — the contiguous mode's "harmless junk write" would be
     cross-slot corruption here) and attend only their frozen ``lengths``
     rows. Returns ``(o_proj_partial (B, n) f32, pk', pv')``; the caller
-    all-reduces the partial over tp and adds the residual."""
+    all-reduces the partial over tp and adds the residual.
+
+    ``pk``/``pv`` may be ``QuantPool`` pairs (``models/quant.py``): the new
+    token's rows are quantized ONCE, here, at append — payload and per-row
+    scale scatter together, and the table walk dequantizes in-kernel. No
+    stored row is ever re-quantized (the prefix-trie/CoW invariant), and
+    the step stays one fused launch: quantize → scatter → walk all ride the
+    same jit step."""
     from triton_dist_tpu.kernels.flash_decode import paged_flash_decode
+    from triton_dist_tpu.models.quant import QuantPool, quantize_kv_rows
 
     b, hq, d = q.shape
-    bs = pk.shape[3]
+    quant = isinstance(pk, QuantPool)
+    bs = (pk.q if quant else pk).shape[3]
     scale = scale if scale is not None else d ** -0.5
 
     step = active.astype(lengths.dtype)
@@ -540,11 +549,29 @@ def fused_paged_attn_back(
     blk = jnp.take_along_axis(tables, (pos // bs)[:, None], axis=1)[:, 0]
     phys = jnp.where(active, blk, 0)
     sub = pos % bs
-    pk = pk.at[li, phys, :, sub, :].set(k_new)
-    pv = pv.at[li, phys, :, sub, :].set(v_new)
-    o = paged_flash_decode(
-        q, pk[li], pv[li], tables, lengths + step, scale=scale
-    )
+    if quant:
+        kq, ks = quantize_kv_rows(k_new, pk.wire)  # (B, Hkv, D), (B, Hkv, 1)
+        vq, vs = quantize_kv_rows(v_new, pv.wire)
+        pk = QuantPool(
+            pk.q.at[li, phys, :, sub, :].set(kq),
+            pk.scale.at[li, phys, :, sub, :].set(ks),
+            pk.wire,
+        )
+        pv = QuantPool(
+            pv.q.at[li, phys, :, sub, :].set(vq),
+            pv.scale.at[li, phys, :, sub, :].set(vs),
+            pv.wire,
+        )
+        o = paged_flash_decode(
+            q, pk.q[li], pv.q[li], tables, lengths + step, scale=scale,
+            k_scale=pk.scale[li], v_scale=pv.scale[li],
+        )
+    else:
+        pk = pk.at[li, phys, :, sub, :].set(k_new)
+        pv = pv.at[li, phys, :, sub, :].set(v_new)
+        o = paged_flash_decode(
+            q, pk[li], pv[li], tables, lengths + step, scale=scale
+        )
     part = jnp.dot(
         o.reshape(b, hq * d), wo, preferred_element_type=jnp.float32
     )

@@ -28,6 +28,7 @@ from jax.sharding import PartitionSpec as P
 
 from triton_dist_tpu.models.dense import DenseLLM
 from triton_dist_tpu.models.kv_cache import KVCache, PagedKVCache
+from triton_dist_tpu.models.quant import QuantPool, dequantize_kv, quantize_kv_rows
 from triton_dist_tpu.runtime import telemetry, tracing
 
 
@@ -468,30 +469,46 @@ class Engine:
             donate_argnums=(2, 3),
         )
 
-        def paged_gather(pk, pv, tables):
-            nl, _, hkv_l, bs, hd = pk.shape
+        def paged_gather(pk, pv, ks, vs, tables):
+            nl, _, hkv_l, bs, _ = pk.shape
             b, mb = tables.shape
 
             def g(pool):
+                hd = pool.shape[-1]
                 x = jnp.take(pool, tables.reshape(-1), axis=1)
                 x = x.reshape(nl, b, mb, hkv_l, bs, hd).transpose(0, 1, 3, 2, 4, 5)
                 return x.reshape(nl, b, hkv_l, mb * bs, hd)
 
-            return g(pk), g(pv)
+            kc, vc = g(pk), g(pv)
+            if ks is not None:
+                # Quantized pool: gather the parallel scale pool along the
+                # same tables and dequantize to f32 — the same exact
+                # (power-of-two) dequantization the in-kernel table walk
+                # performs, so the contiguous bounce stays the mega path's
+                # numerical twin.
+                kc = dequantize_kv(kc, g(ks))
+                vc = dequantize_kv(vc, g(vs))
+            return kc, vc
 
         self._paged_gather = jax.jit(
             paged_gather, out_shardings=(self._kv_sharding, self._kv_sharding)
         )
 
-        @partial(jax.jit, static_argnums=(7,), donate_argnums=(0, 1))
-        def paged_scatter_decode(pk, pv, kc, vc, tables, lengths0, remaining0, chunk):
+        @partial(jax.jit, static_argnums=(9, 10), donate_argnums=(0, 1, 2, 3))
+        def paged_scatter_decode(pk, pv, ks, vs, kc, vc, tables, lengths0,
+                                 remaining0, chunk, wire):
             """Write the decode chunk's freshly-written contiguous rows back
             into the pool. Row r of slot b landed at position lengths0[b]+r
             and is real only while r < remaining0[b] (the chunk's active
             mask); masked rows redirect to the NULL block — a freed slot's
             old blocks may already belong to another tenant, so the
             contiguous mode's "harmless junk write" would be cross-slot
-            corruption here."""
+            corruption here.
+
+            With ``wire`` set the pool is quantized: each NEW row quantizes
+            exactly once here (payload + per-row scale scatter together);
+            rows already in the pool are never touched, so shared prefix
+            blocks stay bitwise-stable."""
             bs = pk.shape[3]
             b = tables.shape[0]
             smax = kc.shape[3]
@@ -502,19 +519,31 @@ class Engine:
                 blk = jnp.take_along_axis(tables, (pos // bs)[:, None], axis=1)[:, 0]
                 phys = jnp.where(r < nv, blk, 0)
                 sub = pos % bs
-                pk = pk.at[:, phys, :, sub, :].set(kc[:, b_ids, :, pos])
-                pv = pv.at[:, phys, :, sub, :].set(vc[:, b_ids, :, pos])
-            return pk, pv
+                krow = kc[:, b_ids, :, pos]
+                vrow = vc[:, b_ids, :, pos]
+                if wire is not None:
+                    kq, ksc = quantize_kv_rows(krow, wire)
+                    vq, vsc = quantize_kv_rows(vrow, wire)
+                    pk = pk.at[:, phys, :, sub, :].set(kq)
+                    pv = pv.at[:, phys, :, sub, :].set(vq)
+                    ks = ks.at[:, phys, :, sub, :].set(ksc)
+                    vs = vs.at[:, phys, :, sub, :].set(vsc)
+                else:
+                    pk = pk.at[:, phys, :, sub, :].set(krow)
+                    pv = pv.at[:, phys, :, sub, :].set(vrow)
+            return pk, pv, ks, vs
 
         self._paged_scatter_decode = paged_scatter_decode
 
-        @partial(jax.jit, donate_argnums=(0, 1))
-        def paged_scatter_prefill(pk, pv, kbuf, vbuf, table_row, start_block):
+        @partial(jax.jit, static_argnums=(8,), donate_argnums=(0, 1, 2, 3))
+        def paged_scatter_prefill(pk, pv, ks, vs, kbuf, vbuf, table_row,
+                                  start_block, wire):
             """Block-granular scatter of a COMPLETED prefill buffer into the
             pool: one advanced-index write per pool, not one per row.
             Blocks below ``start_block`` are prefix-shared (owned by the
             radix index, possibly by other slots) — they redirect to NULL
-            instead of being rewritten."""
+            instead of being rewritten (and, quantized, never re-quantized:
+            only the freshly-computed owned tail picks up scales here)."""
             bs = pk.shape[3]
             p_len = kbuf.shape[3]
             mbf = -(-p_len // bs)
@@ -529,19 +558,32 @@ class Engine:
 
             owned = jnp.arange(mbf) >= start_block
             phys = jnp.where(owned, table_row[:mbf], 0)
-            pk = pk.at[:, phys].set(blocks_of(kbuf))
-            pv = pv.at[:, phys].set(blocks_of(vbuf))
-            return pk, pv
+            kb, vb = blocks_of(kbuf), blocks_of(vbuf)
+            if wire is not None:
+                kq, ksc = quantize_kv_rows(kb, wire)
+                vq, vsc = quantize_kv_rows(vb, wire)
+                pk = pk.at[:, phys].set(kq)
+                pv = pv.at[:, phys].set(vq)
+                ks = ks.at[:, phys].set(ksc)
+                vs = vs.at[:, phys].set(vsc)
+            else:
+                pk = pk.at[:, phys].set(kb)
+                pv = pv.at[:, phys].set(vb)
+            return pk, pv, ks, vs
 
         self._paged_scatter_prefill = paged_scatter_prefill
 
-        def paged_seed_kbuf(pk, pv, table_row, shared_rows, p_len):
+        cdtype = jnp.dtype(model.config.dtype)
+
+        def paged_seed_kbuf(pk, pv, ks, vs, table_row, shared_rows, p_len):
             """Start a prefix-sharing prefill: gather the slot's table chain
             into a fresh (L, 1, Hkv, P, D) context buffer, keeping only the
             first ``shared_rows`` rows (the reused prefix) and zeroing the
             rest — recycled blocks hold stale tenants' values, and the
             chunk attention needs finite-but-masked garbage, not arbitrary
-            reads standing in for zeros."""
+            reads standing in for zeros. A quantized pool dequantizes into
+            the model-dtype buffer (the chunk program's operand dtype); the
+            donor blocks themselves are read-only here."""
             bs = pk.shape[3]
             mbf = -(-p_len // bs)
 
@@ -549,26 +591,33 @@ class Engine:
                 nl, _, hkv_l, _, hd = pool.shape
                 x = jnp.take(pool, table_row[:mbf], axis=1)  # (L, MBf, Hkv, bs, D)
                 x = x.transpose(0, 2, 1, 3, 4).reshape(nl, hkv_l, mbf * bs, hd)
-                x = x[:, :, :p_len]
+                return x[:, :, :p_len]
+
+            def seed(pool, spool):
+                x = g(pool)
+                if spool is not None:
+                    x = dequantize_kv(x, g(spool), cdtype)
                 row = jnp.arange(p_len)
                 x = jnp.where(row[None, None, :, None] < shared_rows, x, 0)
                 return x[:, None]  # (L, 1, Hkv, P, D)
 
-            return g(pk), g(pv)
+            return seed(pk, ks), seed(pv, vs)
 
         self._paged_seed_kbuf = jax.jit(
-            paged_seed_kbuf, static_argnums=(4,),
+            paged_seed_kbuf, static_argnums=(6,),
             out_shardings=(self._kv_sharding, self._kv_sharding),
         )
 
-        @partial(jax.jit, static_argnums=(7,), donate_argnums=(0, 1))
-        def paged_scatter_rows(pk, pv, kc, vc, tables, lengths0, nv, max_rows):
+        @partial(jax.jit, static_argnums=(9, 10), donate_argnums=(0, 1, 2, 3))
+        def paged_scatter_rows(pk, pv, ks, vs, kc, vc, tables, lengths0, nv,
+                               max_rows, wire):
             """Generalized ``paged_scatter_decode``: the per-slot valid row
             count ``nv`` is DATA, not derived from the chunk's remaining —
             the speculative path writes back exactly the accepted prefix
             (``lengths' - lengths0``), so rejected draft rows in the
             contiguous bounce buffer never reach the pool. Masked rows
-            redirect to the NULL block, as everywhere."""
+            redirect to the NULL block, as everywhere; quantized rows
+            quantize once, here."""
             bs = pk.shape[3]
             b = tables.shape[0]
             smax = kc.shape[3]
@@ -578,9 +627,19 @@ class Engine:
                 blk = jnp.take_along_axis(tables, (pos // bs)[:, None], axis=1)[:, 0]
                 phys = jnp.where(r < nv, blk, 0)
                 sub = pos % bs
-                pk = pk.at[:, phys, :, sub, :].set(kc[:, b_ids, :, pos])
-                pv = pv.at[:, phys, :, sub, :].set(vc[:, b_ids, :, pos])
-            return pk, pv
+                krow = kc[:, b_ids, :, pos]
+                vrow = vc[:, b_ids, :, pos]
+                if wire is not None:
+                    kq, ksc = quantize_kv_rows(krow, wire)
+                    vq, vsc = quantize_kv_rows(vrow, wire)
+                    pk = pk.at[:, phys, :, sub, :].set(kq)
+                    pv = pv.at[:, phys, :, sub, :].set(vq)
+                    ks = ks.at[:, phys, :, sub, :].set(ksc)
+                    vs = vs.at[:, phys, :, sub, :].set(vsc)
+                else:
+                    pk = pk.at[:, phys, :, sub, :].set(krow)
+                    pv = pv.at[:, phys, :, sub, :].set(vrow)
+            return pk, pv, ks, vs
 
         self._paged_scatter_rows = paged_scatter_rows
 
@@ -666,17 +725,41 @@ class Engine:
 
     # ------------------------------------------------ serving (paged blocks)
     def alloc_paged(self, num_slots: int, *, block_size: int,
-                    num_blocks: int) -> PagedKVCache:
+                    num_blocks: int, quant: str | None = None) -> PagedKVCache:
         """Fresh paged KV: a global (num_blocks, block_size) pool + per-slot
         block tables sized for ``max_len``. Block 0 is the reserved NULL
         block (see ``BlockAllocator``); the pool is zeroed so null reads are
-        finite."""
+        finite. ``quant`` ("int8"/"fp8") stores the pool in the wire dtype
+        with a parallel per-row scale pool (``models/quant.py``)."""
         c = self.model.config
         return PagedKVCache.create(
             c.num_layers, num_slots, c.num_kv_heads, c.head_dim,
             block_size=block_size, num_blocks=num_blocks, max_len=self.max_len,
-            dtype=jnp.dtype(c.dtype), sharding=self._pool_sharding,
+            dtype=jnp.dtype(c.dtype), sharding=self._pool_sharding, quant=quant,
         )
+
+    @staticmethod
+    def _pool_pair(paged: PagedKVCache):
+        """The (pk, pv) operands the paged step programs take: bare pools,
+        or ``QuantPool`` pairs when quantized — ONE pytree per cache half,
+        so the jit cache keys on structure and a quantized serve compiles
+        once per chunk size, exactly like bf16."""
+        if paged.quant is None:
+            return paged.k, paged.v
+        return (
+            QuantPool(paged.k, paged.k_scale, paged.quant),
+            QuantPool(paged.v, paged.v_scale, paged.quant),
+        )
+
+    @staticmethod
+    def _pool_update(paged: PagedKVCache, pk, pv, lengths) -> PagedKVCache:
+        """Fold a step program's returned pools back into the handle."""
+        if isinstance(pk, QuantPool):
+            return dataclasses.replace(
+                paged, k=pk.q, k_scale=pk.scale, v=pv.q, v_scale=pv.scale,
+                lengths=lengths,
+            )
+        return dataclasses.replace(paged, k=pk, v=pv, lengths=lengths)
 
     def paged_kbuf_zeros(self, p_len: int):
         """Zeroed (L, 1, Hkv, p_len, D) chunk-prefill context buffers.
@@ -694,7 +777,8 @@ class Engine:
         ``shared_rows`` rows gathered from the slot's block chain, the rest
         zeros (see the in-jit docstring)."""
         return self._paged_seed_kbuf(
-            paged.k, paged.v, jnp.asarray(table_row, jnp.int32),
+            paged.k, paged.v, paged.k_scale, paged.v_scale,
+            jnp.asarray(table_row, jnp.int32),
             jnp.int32(shared_rows), int(p_len),
         )
 
@@ -726,13 +810,14 @@ class Engine:
         update (they travel as data with the next dispatch)."""
         timed = telemetry.enabled()
         t = time.perf_counter() if timed else 0.0
-        pk, pv = self._paged_scatter_prefill(
-            paged.k, paged.v, kbuf, vbuf,
+        pk, pv, ks, vs = self._paged_scatter_prefill(
+            paged.k, paged.v, paged.k_scale, paged.v_scale, kbuf, vbuf,
             jnp.asarray(table_row, jnp.int32), jnp.int32(start_block),
+            paged.quant,
         )
         if timed:
             self._phase("cache_scatter", t, pk)
-        return dataclasses.replace(paged, k=pk, v=pv)
+        return dataclasses.replace(paged, k=pk, v=pv, k_scale=ks, v_scale=vs)
 
     def decode_steps_paged(self, paged: PagedKVCache, tokens: jax.Array,
                            remaining: jax.Array, chunk: int,
@@ -751,9 +836,10 @@ class Engine:
         timed = telemetry.enabled()
         t = time.perf_counter() if timed else 0.0
         if self.backend == "mega":
+            pk_in, pv_in = self._pool_pair(paged)
             out, tok, pk, pv, lengths, rem = self._decode_chunk_paged(
-                self.model.params, self._decode_extra, tokens, paged.k,
-                paged.v, paged.tables, paged.lengths, remaining, int(chunk),
+                self.model.params, self._decode_extra, tokens, pk_in,
+                pv_in, paged.tables, paged.lengths, remaining, int(chunk),
                 key,
             )
             telemetry.set_gauge(
@@ -765,10 +851,10 @@ class Engine:
                 # mega path scatters in place — no cache_scatter phase.
                 t = self._phase("dispatch", t)
                 self._phase("host_sync", t, tok)
-            return out, tok, dataclasses.replace(
-                paged, k=pk, v=pv, lengths=lengths
-            ), rem
-        kc, vc = self._paged_gather(paged.k, paged.v, paged.tables)
+            return out, tok, self._pool_update(paged, pk, pv, lengths), rem
+        kc, vc = self._paged_gather(
+            paged.k, paged.v, paged.k_scale, paged.v_scale, paged.tables
+        )
         out, tok, k2, v2, lengths, rem = self._decode_chunk(
             self.model.params, self._decode_extra, tokens, kc, vc,
             paged.lengths, remaining, int(chunk), key,
@@ -776,16 +862,16 @@ class Engine:
         if timed:
             t = self._phase("dispatch", t)
             t = self._phase("host_sync", t, tok)
-        pk, pv = self._paged_scatter_decode(
-            paged.k, paged.v, k2, v2, paged.tables, paged.lengths, remaining,
-            int(chunk),
+        pk, pv, ks, vs = self._paged_scatter_decode(
+            paged.k, paged.v, paged.k_scale, paged.v_scale, k2, v2,
+            paged.tables, paged.lengths, remaining, int(chunk), paged.quant,
         )
         if timed:
             # The gather/scatter bounce around the contiguous chunk program
             # — exactly the cost the mega in-place path deletes.
             self._phase("cache_scatter", t, pk)
         return out, tok, dataclasses.replace(
-            paged, k=pk, v=pv, lengths=lengths
+            paged, k=pk, v=pv, k_scale=ks, v_scale=vs, lengths=lengths
         ), rem
 
     def sample_logits(self, logits: jax.Array, key: jax.Array) -> jax.Array:
@@ -951,9 +1037,10 @@ class Engine:
         timed = telemetry.enabled()
         t = time.perf_counter() if timed else 0.0
         if self.backend == "mega":
+            pk_in, pv_in = self._pool_pair(paged)
             out, tok, pk, pv, lengths, rem, dstate, stats = self._spec_chunk_paged(
                 self.model.params, self._decode_extra, self._drafter.params,
-                tokens, paged.k, paged.v, paged.tables, paged.lengths,
+                tokens, pk_in, pv_in, paged.tables, paged.lengths,
                 remaining, kcap, int(chunk), int(k), dstate,
             )
             telemetry.set_gauge(
@@ -961,10 +1048,12 @@ class Engine:
             )
             if timed:
                 self._phase("spec_propose", t, tok)
-            return out, tok, dataclasses.replace(
-                paged, k=pk, v=pv, lengths=lengths
+            return out, tok, self._pool_update(
+                paged, pk, pv, lengths
             ), rem, dstate, stats
-        kc, vc = self._paged_gather(paged.k, paged.v, paged.tables)
+        kc, vc = self._paged_gather(
+            paged.k, paged.v, paged.k_scale, paged.v_scale, paged.tables
+        )
         out, tok, k2, v2, lengths, rem, dstate, stats = self._spec_chunk(
             self.model.params, self._decode_extra, self._drafter.params,
             tokens, kc, vc, paged.lengths, remaining, kcap,
@@ -973,15 +1062,15 @@ class Engine:
         if timed:
             t = self._phase("spec_propose", t, tok)
         nv = lengths - paged.lengths
-        pk, pv = self._paged_scatter_rows(
-            paged.k, paged.v, k2, v2, paged.tables, paged.lengths, nv,
-            int(chunk) * int(k),
+        pk, pv, ks, vs = self._paged_scatter_rows(
+            paged.k, paged.v, paged.k_scale, paged.v_scale, k2, v2,
+            paged.tables, paged.lengths, nv, int(chunk) * int(k), paged.quant,
         )
         if timed:
             # Commit: only the ACCEPTED rows scatter back into the pool.
             self._phase("spec_commit", t, pk)
         return out, tok, dataclasses.replace(
-            paged, k=pk, v=pv, lengths=lengths
+            paged, k=pk, v=pv, k_scale=ks, v_scale=vs, lengths=lengths
         ), rem, dstate, stats
 
     def decode_steps(self, cache: KVCache, tokens: jax.Array, remaining: jax.Array,

@@ -153,23 +153,52 @@ class PagedKVCache:
     vector the contiguous cache carries. Fixed shapes throughout: batch
     composition, chain layout, and prefix sharing all change *data* in the
     tables, never array shapes — nothing recompiles (the vLLM block table,
-    Kwon et al. SOSP'23, under the jit discipline)."""
+    Kwon et al. SOSP'23, under the jit discipline).
+
+    With ``quant`` set ("int8"/"fp8", ``models/quant.py``) the payload pools
+    hold the wire dtype and ``k_scale``/``v_scale`` are the parallel scale
+    pools — (L, num_blocks, Hkv_local, block_size, 1) f32, one scale per
+    stored ROW. Per-row scales make the quantize-once invariant structural:
+    a row is quantized exactly once, at append, by whichever scatter wrote
+    it; sharing, CoW copies, and gathers only ever move the (payload, scale)
+    pair — they never re-derive a scale, so a shared prefix block stays
+    byte-identical across donor and borrower."""
 
     k: jax.Array
     v: jax.Array
     tables: jax.Array  # (B, max_blocks) int32
     lengths: jax.Array  # (B,) int32
     block_size: int
+    k_scale: jax.Array | None = None  # (L, blocks, Hkv, bs, 1) f32 when quant
+    v_scale: jax.Array | None = None
+    quant: str | None = None  # None | "int8" | "fp8"
 
     @staticmethod
     def create(num_layers, num_slots, num_kv_heads, head_dim, *,
                block_size, num_blocks, max_len, dtype=jnp.bfloat16,
-               sharding=None):
+               sharding=None, quant=None):
+        if quant is not None:
+            from triton_dist_tpu.models.quant import wire_dtype
+
+            dtype = wire_dtype(quant)
         shape = (num_layers, num_blocks, num_kv_heads, block_size, head_dim)
         if sharding is not None:
             zeros = jax.jit(lambda: jnp.zeros(shape, dtype), out_shardings=sharding)()
         else:
             zeros = jnp.zeros(shape, dtype)
+        k_scale = v_scale = None
+        if quant is not None:
+            # Scale pools start at 1.0 — quantize_rows' scale for an
+            # all-zero row — so NULL-block reads dequantize to exact zeros
+            # and an untouched row round-trips bitwise.
+            sshape = shape[:-1] + (1,)
+            if sharding is not None:
+                ones = jax.jit(
+                    lambda: jnp.ones(sshape, jnp.float32), out_shardings=sharding
+                )()
+            else:
+                ones = jnp.ones(sshape, jnp.float32)
+            k_scale, v_scale = ones, jnp.copy(ones)
         max_blocks = -(-max_len // block_size)
         return PagedKVCache(
             k=zeros,
@@ -177,6 +206,9 @@ class PagedKVCache:
             tables=jnp.zeros((num_slots, max_blocks), jnp.int32),
             lengths=jnp.zeros((num_slots,), jnp.int32),
             block_size=block_size,
+            k_scale=k_scale,
+            v_scale=v_scale,
+            quant=quant,
         )
 
     @property
@@ -191,11 +223,23 @@ class PagedKVCache:
     def max_len(self) -> int:
         return self.max_blocks * self.block_size
 
+    @property
+    def bytes_per_block(self) -> int:
+        """Real HBM bytes one pool block costs across k+v payloads AND the
+        scale pools — the ledger's admission unit (logical block count alone
+        under-charges quantized pools by the scale overhead and over-charges
+        them by the dtype shrink)."""
+        nl, _, hkv, bs, hd = self.k.shape
+        per = 2 * nl * hkv * bs * hd * self.k.dtype.itemsize
+        if self.k_scale is not None:
+            per += 2 * nl * hkv * bs * self.k_scale.dtype.itemsize
+        return per
+
 
 jax.tree_util.register_dataclass(
     PagedKVCache,
-    data_fields=["k", "v", "tables", "lengths"],
-    meta_fields=["block_size"],
+    data_fields=["k", "v", "tables", "lengths", "k_scale", "v_scale"],
+    meta_fields=["block_size", "quant"],
 )
 
 

@@ -1,0 +1,228 @@
+"""Symmetric int8/fp8 quantization: the wire format for quantized operands.
+
+One module owns the number format so every consumer — the fused-collective
+operand paths (``kernels/allgather_gemm.py``, ``kernels/gemm_allreduce.py``,
+``kernels/gemm_reduce_scatter.py``), the quantized paged-KV pool
+(``models/kv_cache.py`` + ``kernels/flash_decode.py`` +
+``megakernel/kernels.py``), and the EP decode wire that pioneered it
+(``kernels/ep_fused.py`` / ``kernels/low_latency_a2a.py``) — agrees byte for
+byte on what a quantized row means.
+
+Format (per row, i.e. per contraction-axis vector):
+
+  ``x ≈ q · scale`` with ``q`` int8 or float8_e4m3fn and ``scale`` a single
+  f32 **power of two** chosen from the row's absmax:
+
+      absmax = m · 2^e   (frexp: m ∈ [0.5, 1))
+      scale  = 2^(e - 1 - SHIFT)
+
+  so ``|x|/scale`` lands in ``[2^SHIFT, 2^(SHIFT+1))`` — the top octave of
+  the target format (SHIFT=6 for int8 → [64, 128); SHIFT=7 for fp8 e4m3 →
+  [128, 256), clipped to 240 before the cast because 248 would round up to
+  256 and bump the octave).
+
+Why powers of two and not the usual ``absmax / QMAX``: **bitwise-stable
+requantization**. Dequantization ``q · scale`` is exact in f32 (an ≤ 8-bit
+significand times a power of two), and re-quantizing the dequantized row
+reproduces ``q`` bit for bit — the new absmax ``|q|_max · scale`` sits in the
+same octave, frexp returns the same exponent, the same scale falls out, and
+``round((q·s)/s) == q`` exactly. With an ``absmax/QMAX`` scale the division
+double-rounds and quantize-twice ≠ quantize-once. That stability is what the
+prefix trie / CoW invariant rides on (a shared quantized block must stay
+byte-identical no matter how many times it is gathered, dequantized, and
+re-examined), at a cost of up to one bit of SNR vs absmax scaling — the
+documented trade (``docs/quantization.md``).
+
+Error bands (absolute error relative to the row's absmax — the bound the
+round-trip tests assert):
+
+  int8:  |x - dq| ≤ absmax · 2^-7   (round-to-nearest on a [64,128) grid)
+  fp8 :  |x - dq| ≤ absmax · 2^-4   (e4m3: 3 mantissa bits → ULP/2 = y·2^-4)
+
+Scale layout differs by consumer:
+
+  * Weight / activation tensors (``QuantTensor``): scales are
+    **lane-replicated** to ``(rows, 128)`` f32 — a ``(rows, 1)`` buffer
+    can't be DMA-sliced on Mosaic's lane-padded memrefs (the r5 lowering
+    find recorded in ``kernels/ep_fused.py``), and panels of rows ride the
+    AG ring as ``(payload, scale)`` pairs.
+  * KV pools (``QuantPool``): scales are a **parallel pool** shaped like the
+    payload pool with the head dim collapsed to 1 (``(..., bs, 1)`` f32,
+    4 B per row). Kernels read whole ``(bs, 1)`` scale blocks through the
+    same table index map as the payload — a whole-block read, which is
+    legal where the sublane-slice of a lane-padded memref is not.
+
+Knobs (the ``TDT_QUANT_*`` table in ``docs/quantization.md``):
+
+  TDT_QUANT_KV    "" | "int8" | "fp8" — quantize the paged KV pool
+  TDT_QUANT_WIRE  "" | "int8" | "fp8" — default wire for quantized collectives
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+LANES = 128
+
+WIRES = ("int8", "fp8")
+WIRE_DTYPES = {"int8": jnp.int8, "fp8": jnp.float8_e4m3fn}
+
+# |x|/scale lands in [2^SHIFT, 2^(SHIFT+1)) — the top octave of the format.
+_SHIFT = {"int8": 6, "fp8": 7}
+# Magnitude clip BEFORE the cast. int8: round-to-nearest of [127, 128) would
+# hit 128. fp8 e4m3: the grid above 240 is {256} — anything in (244, 256)
+# rounds up and escapes the octave, breaking requantization stability.
+_CLIP = {"int8": 127.0, "fp8": 240.0}
+
+# Absolute round-trip error bound, relative to the row absmax (see module doc).
+ERROR_BOUND = {"int8": 2.0 ** -7, "fp8": 2.0 ** -4}
+
+# f32 per-row scale.
+SCALE_BYTES = 4
+
+
+def wire_dtype(wire: str):
+    """The on-wire element dtype for ``wire`` (validates the name)."""
+    if wire not in WIRE_DTYPES:
+        raise ValueError(f"unknown quant wire {wire!r}; expected one of {WIRES}")
+    return WIRE_DTYPES[wire]
+
+
+def wire_itemsize(wire: str) -> int:
+    return jnp.dtype(wire_dtype(wire)).itemsize
+
+
+def kv_quant_from_env() -> str | None:
+    """Resolve ``TDT_QUANT_KV`` ("" → None)."""
+    return _env_wire("TDT_QUANT_KV")
+
+
+def wire_quant_from_env() -> str | None:
+    """Resolve ``TDT_QUANT_WIRE`` ("" → None)."""
+    return _env_wire("TDT_QUANT_WIRE")
+
+
+def _env_wire(name: str) -> str | None:
+    w = os.environ.get(name, "").strip().lower()
+    if not w or w in ("0", "none", "off"):
+        return None
+    if w not in WIRES:
+        raise ValueError(f"{name}={w!r}: expected one of {WIRES} (or empty)")
+    return w
+
+
+def _pow2_scale(absmax: jax.Array, shift: int) -> jax.Array:
+    """Exponent-snapped scale: absmax = m·2^e (m ∈ [0.5, 1)) → 2^(e-1-shift).
+    Zero rows get scale 1.0 (their payload quantizes to exact zeros)."""
+    _, e = jnp.frexp(absmax)
+    scale = jnp.ldexp(jnp.ones_like(absmax), e - 1 - shift)
+    return jnp.where(absmax > 0, scale, jnp.ones_like(absmax))
+
+
+def quantize_rows(x: jax.Array, wire: str):
+    """Quantize ``x`` along its LAST axis (one scale per row).
+
+    Returns ``(q, scale)``: ``q`` has ``x.shape`` in the wire dtype, ``scale``
+    is ``x.shape[:-1] + (1,)`` f32. Exact round trip of already-quantized
+    data: ``quantize_rows(dequantize_rows(q, s), wire) == (q, s)`` bitwise.
+    """
+    dt = wire_dtype(wire)
+    xf = x.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+    scale = _pow2_scale(absmax, _SHIFT[wire])
+    y = jnp.clip(xf / scale, -_CLIP[wire], _CLIP[wire])
+    if wire == "int8":
+        q = jnp.round(y).astype(dt)
+    else:
+        q = y.astype(dt)  # e4m3 cast rounds to nearest-even on the grid
+    return q, scale
+
+
+def dequantize_rows(q: jax.Array, scale: jax.Array, dtype=jnp.float32) -> jax.Array:
+    """Exact inverse of ``quantize_rows`` (in f32): ``q·scale`` cast to
+    ``dtype``. Accepts ``(rows, 1)`` or lane-replicated ``(rows, LANES)``
+    scales — only column 0 is read."""
+    s = scale[..., :1]
+    return (q.astype(jnp.float32) * s).astype(dtype)
+
+
+def replicate_scale_lanes(scale: jax.Array) -> jax.Array:
+    """``(..., 1)`` → ``(..., LANES)`` f32: the weight-tensor scale layout.
+    Lane replication is load-bearing — Mosaic cannot DMA-slice a ``(rows, 1)``
+    lane-padded memref (``kernels/ep_fused.py`` r5 note)."""
+    assert scale.shape[-1] == 1, scale.shape
+    return jnp.broadcast_to(scale, scale.shape[:-1] + (LANES,))
+
+
+# --------------------------------------------------------------------- tensors
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["q", "scale"],
+    meta_fields=["wire"],
+)
+@dataclasses.dataclass(frozen=True)
+class QuantTensor:
+    """A quantized 2-D operand: ``q`` (rows, cols) in the wire dtype plus
+    lane-replicated per-row scales (rows, LANES) f32. Rows are the
+    contraction-panel axis — the unit that rides the AG ring and the unit a
+    fused epilogue dequantizes per VMEM panel."""
+
+    q: jax.Array
+    scale: jax.Array
+    wire: str
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def nbytes_wire(self) -> int:
+        """Bytes a panel of these rows puts on the wire (payload + scale —
+        the scale row travels with its panel, see allgather_gemm)."""
+        return self.q.size * wire_itemsize(self.wire) + self.scale.size * SCALE_BYTES
+
+
+def quantize_tensor(x: jax.Array, wire: str) -> QuantTensor:
+    assert x.ndim == 2, x.shape
+    q, s = quantize_rows(x, wire)
+    return QuantTensor(q=q, scale=replicate_scale_lanes(s), wire=wire)
+
+
+def dequantize_tensor(t: QuantTensor, dtype=jnp.float32) -> jax.Array:
+    return dequantize_rows(t.q, t.scale, dtype)
+
+
+# ----------------------------------------------------------------------- pools
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["q", "scale"],
+    meta_fields=["wire"],
+)
+@dataclasses.dataclass(frozen=True)
+class QuantPool:
+    """A quantized KV pool half: payload pool ``q`` (..., bs, D) in the wire
+    dtype + parallel scale pool (..., bs, 1) f32 (one scale per stored row,
+    written once at append — the quantize-once invariant the prefix trie and
+    CoW ride on). Threaded through the megakernel step as ONE pytree so the
+    jit cache keys on structure, not on a second argument list."""
+
+    q: jax.Array
+    scale: jax.Array
+    wire: str
+
+
+def quantize_kv_rows(x: jax.Array, wire: str):
+    """Quantize freshly-appended KV rows (..., D) → ``(q, scale)`` with
+    ``scale`` (..., 1) f32 — the exact pair a paged scatter writes into the
+    payload and scale pools."""
+    return quantize_rows(x, wire)
+
+
+def dequantize_kv(q: jax.Array, scale: jax.Array, dtype=jnp.float32) -> jax.Array:
+    """Dequantize gathered KV payload (..., D) with its (..., 1) scales."""
+    return dequantize_rows(q, scale, dtype)

@@ -447,11 +447,21 @@ class KVLedger:
     prompt row (its logits seed decode)."""
 
     def __init__(self, num_blocks: int, block_size: int, *,
-                 prefix_reuse: bool = True):
+                 prefix_reuse: bool = True, bytes_per_block: int = 0):
         self.allocator = BlockAllocator(num_blocks)
         self.block_size = int(block_size)
         self.prefix_reuse = bool(prefix_reuse)
+        #: Real HBM bytes one pool block costs (payloads + scale pools,
+        #: ``PagedKVCache.bytes_per_block``). The server teaches the ledger
+        #: this after allocating the device pool — budget math and the
+        #: ``/requests`` view then report bytes, not logical block counts,
+        #: so a quantized pool's smaller per-block cost is visible to
+        #: admission and federation instead of being a dtype fiction.
+        self.bytes_per_block = int(bytes_per_block)
         self.prefix = PrefixIndex(self.allocator, self.block_size)
+
+    def set_bytes_per_block(self, nbytes: int) -> None:
+        self.bytes_per_block = int(nbytes)
 
     def blocks_needed(self, prompt_len: int, max_new: int) -> int:
         return -(-(int(prompt_len) + int(max_new)) // self.block_size)
@@ -525,7 +535,7 @@ class KVLedger:
 
     def stats(self) -> dict:
         a = self.allocator
-        return {
+        out = {
             "blocks_total": a.num_blocks - 1,
             "blocks_free": a.num_free,
             "blocks_used": a.num_used,
@@ -533,6 +543,11 @@ class KVLedger:
             "blocks_indexed": self.prefix.num_blocks_indexed,
             "block_size": self.block_size,
         }
+        if self.bytes_per_block:
+            out["bytes_per_block"] = self.bytes_per_block
+            out["bytes_used"] = a.num_used * self.bytes_per_block
+            out["bytes_free"] = a.num_free * self.bytes_per_block
+        return out
 
     def reset(self) -> None:
         """Drop every reservation and index entry (engine-rebuild path:

@@ -126,6 +126,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from triton_dist_tpu.models.quant import kv_quant_from_env
 from triton_dist_tpu.runtime import resilience, slo, telemetry, tracing
 from triton_dist_tpu.runtime.utils import get_float_env, get_int_env
 from triton_dist_tpu.serving.scheduler import (
@@ -188,6 +189,10 @@ class InferenceServer:
                 "TDT_PREFILL_CHUNK", engine.max_len
             )
             assert self.prefill_chunk >= 1
+            #: Quantized KV storage (TDT_QUANT_KV=int8|fp8): the pool holds
+            #: wire-dtype blocks + per-row scale pools; greedy streams stay
+            #: byte-identical across prefix sharing/CoW (quantize-once).
+            self.kv_quant = kv_quant_from_env()
             self.kv_ledger = KVLedger(
                 self.num_blocks, self.block_size,
                 prefix_reuse=get_int_env("TDT_PREFIX_REUSE", 1) != 0,
@@ -674,8 +679,12 @@ class InferenceServer:
             self.scheduler.restore(req)
         self.cache = self.engine.alloc_paged(
             self.num_slots, block_size=self.block_size,
-            num_blocks=self.num_blocks,
+            num_blocks=self.num_blocks, quant=self.kv_quant,
         )
+        # Teach admission the pool's REAL per-block HBM cost (payloads +
+        # scale pools) — quantized pools admit more chains per byte and the
+        # ledger/gauges must reflect that, not the logical block count.
+        led.set_bytes_per_block(self.cache.bytes_per_block)
         self._push_tables()
         self._publish_kv_gauges()
         return self.cache
@@ -710,6 +719,10 @@ class InferenceServer:
         telemetry.set_gauge("tdt_kv_blocks_free", float(s["blocks_free"]))
         telemetry.set_gauge("tdt_kv_blocks_used", float(s["blocks_used"]))
         telemetry.set_gauge("tdt_kv_blocks_shared", float(s["blocks_shared"]))
+        if s.get("bytes_per_block"):
+            telemetry.set_gauge(
+                "tdt_kv_bytes_per_block", float(s["bytes_per_block"])
+            )
 
     # ------------------------------------------------------------------ joins
     def _join_ready(self) -> bool:

@@ -1136,6 +1136,124 @@ def bench_serving_quant(on_tpu):
     return out
 
 
+def bench_serving_disagg(on_tpu):
+    """Disaggregated prefill/decode benchmark (the handoff subsystem, see
+    docs/disagg.md): the same request sweep runs once through a unified
+    server and once split across a prefill-pool and a decode-pool server
+    joined by the paged-KV wire (``export_kv`` → JSON blob → ``import_kv``,
+    the in-process version of what the fleet router does between replica
+    subprocesses). Gated by check_bench_regression.py:
+    ``serving_disagg_tpot_p99_ms`` — the decode-pool inter-token p99, THE
+    number disaggregation exists to protect — and
+    ``serving_disagg_handoff_p50_ms`` (both lower better) plus
+    ``serving_disagg_tokens_per_s`` (higher better, decode arm).
+    ``serving_disagg_greedy_parity`` must stay 1.0: the split streams are
+    byte-identical to the unified ones or the wire is broken."""
+    import os
+    import time
+
+    from triton_dist_tpu.models import PRESETS, DenseLLM, Engine
+    from triton_dist_tpu.runtime.mesh import initialize_distributed
+    from triton_dist_tpu.serving import InferenceServer
+
+    ctx = initialize_distributed(
+        devices=jax.devices()[:1], axis_names=("tp",), set_default=False
+    )
+    model = DenseLLM(PRESETS["test-dense"], ctx, key=jax.random.PRNGKey(1))
+    plen = 96 if on_tpu else 24
+    eng = Engine(model, backend="xla", max_len=plen + 40)
+
+    slots, chunk = 4, 4
+    reqs = [
+        ([(7 * i + j) % 256 for j in range(plen - (i % 4))], 16 + (3 * i) % 8)
+        for i in range(8)
+    ]
+    out = {"serving_disagg_requests": len(reqs)}
+
+    def _sweep(server, handles_out, gaps):
+        """Drive to completion, recording per-request inter-token gaps
+        (decode TPOT samples; the first token / TTFT is excluded)."""
+        last: dict[int, float] = {}
+
+        def on_token(req, tok, idx):
+            now = time.perf_counter()
+            if req.req_id in last:
+                gaps.append(now - last[req.req_id])
+            last[req.req_id] = now
+
+        t0 = time.perf_counter()
+        for p, g in reqs:
+            handles_out.append(server.submit(p, g, on_token=on_token))
+        server.run()
+        return time.perf_counter() - t0
+
+    # Warmup compiles prefill/decode programs for every distinct shape.
+    warm = InferenceServer(eng, num_slots=slots, chunk=chunk)
+    for p, g in reqs:
+        warm.submit(p, 2)
+    warm.run()
+
+    # Unified arm: one pool does both phases.
+    uni, uni_gaps = [], []
+    _sweep(InferenceServer(eng, num_slots=slots, chunk=chunk), uni, uni_gaps)
+
+    # Disagg arm: prefill pool parks + exports, decode pool imports; the
+    # handoff sample times the full export → JSON → import splice.
+    prev_role = os.environ.get("TDT_POOL_ROLE")
+    os.environ["TDT_POOL_ROLE"] = "prefill"
+    pre = InferenceServer(eng, num_slots=slots, chunk=chunk)
+    os.environ["TDT_POOL_ROLE"] = "decode"
+    dec = InferenceServer(eng, num_slots=slots, chunk=chunk)
+    if prev_role is None:
+        os.environ.pop("TDT_POOL_ROLE", None)
+    else:
+        os.environ["TDT_POOL_ROLE"] = prev_role
+
+    dis, dis_gaps, hand_ms, wire_bytes = [], [], [], 0
+    last: dict[int, float] = {}
+
+    def on_token(req, tok, idx):
+        now = time.perf_counter()
+        if req.req_id in last:
+            dis_gaps.append(now - last[req.req_id])
+        last[req.req_id] = now
+
+    # Waves of one slot-batch: park, splice, release — releasing as the
+    # splice lands (as the router does) keeps the parked chains' extra
+    # refs bounded by one wave instead of pinning the whole pool.
+    t0 = time.perf_counter()
+    for w0 in range(0, len(reqs), slots):
+        wave = reqs[w0:w0 + slots]
+        parked = [pre.submit(p, g, prefill_only=True) for p, g in wave]
+        pre.run()
+        for (p, g), h in zip(wave, parked):
+            h0 = time.perf_counter()
+            blob = json.loads(json.dumps(pre.export_kv(h.req_id)))
+            req = dec.import_kv(p, g, list(h.tokens), blob,
+                                on_token=on_token)
+            hand_ms.append(1e3 * (time.perf_counter() - h0))
+            wire_bytes += blob["wire_bytes"]
+            pre.release_handoff(h.req_id)
+            dis.append(req)
+    dec.run()
+    dis_wall = time.perf_counter() - t0
+
+    toks = sum(len(r.tokens) for r in dis)
+    tpot_p50, tpot_p99 = _pctl(dis_gaps, 0.5, 0.99)
+    u_p50, u_p99 = _pctl(uni_gaps, 0.5, 0.99)
+    h_p50, _ = _pctl(hand_ms, 0.5, 0.99)
+    out["serving_disagg_tokens_per_s"] = round(toks / dis_wall, 1)
+    out["serving_disagg_tpot_p50_ms"] = round(1e3 * tpot_p50, 3)
+    out["serving_disagg_tpot_p99_ms"] = round(1e3 * tpot_p99, 3)
+    out["serving_disagg_unified_tpot_p99_ms"] = round(1e3 * u_p99, 3)
+    out["serving_disagg_handoff_p50_ms"] = round(h_p50, 3)
+    out["serving_disagg_handoff_kib"] = round(wire_bytes / 1024, 1)
+    out["serving_disagg_greedy_parity"] = float(
+        [list(r.tokens) for r in dis] == [list(h.tokens) for h in uni]
+    )
+    return out
+
+
 def bench_serving_chaos(on_tpu):
     """Chaos-arc serving benchmark (the SLO-guardrail subsystem): drive the
     ``dist_ar`` server through a scripted abort → degraded-XLA recovery →
@@ -2641,6 +2759,15 @@ def main():
         emit()
     else:
         extra["serving_quant_skipped"] = "budget"
+    if remaining() > 45:
+        phase("serving_disagg")
+        try:
+            absorb(bench_serving_disagg(on_tpu))
+        except Exception as e:  # noqa: BLE001
+            extra["serving_disagg_error"] = f"{type(e).__name__}"
+        emit()
+    else:
+        extra["serving_disagg_skipped"] = "budget"
     if remaining() > 240:
         # Multi-process: two replica fleets boot (and one rebuilds) inside
         # this section, so it needs a bigger slice than the in-process ones.

@@ -46,7 +46,6 @@ WAIVER = "# unbounded-wait-ok:"
 ALLOWLIST = {
     "common_ops.py",
     "ep_fused.py",
-    "p2p.py",
 }
 
 

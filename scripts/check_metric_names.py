@@ -178,6 +178,18 @@ REQUIRED_NAMES = {
     "tdt_quant_operand_bytes_total",
     "tdt_quant_wire_bytes_total",
     "tdt_kv_bytes_per_block",
+    # disaggregated prefill/decode: TP×PP engine pipeline accounting
+    # (models/engine.py, layers/pp_schedule.py) and the paged-KV handoff
+    # channel + pool placement (serving/server.py, fleet/router.py) — see
+    # docs/disagg.md
+    "tdt_pp_stages",
+    "tdt_pp_prefill_microbatches_total",
+    "tdt_pp_ticks_total",
+    "tdt_disagg_pool_role",
+    "tdt_disagg_handoffs_total",
+    "tdt_disagg_handoff_bytes_total",
+    "tdt_disagg_handoff_seconds",
+    "tdt_disagg_pool_fallbacks_total",
     # span names
     "tdt_serving_probe",
     "tdt_serving_restore",

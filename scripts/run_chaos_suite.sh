@@ -51,6 +51,10 @@
 #                       one slo_alert event, holds under hysteresis, and
 #                       clears exactly once after recovery — asserted via
 #                       the telemetry event ring (NEW)
+#   disagg-handoff-kill SIGKILL the whole prefill pool mid-handoff, and
+#                       separately drop every kv_export wire attempt -> the
+#                       router falls back to journal re-derivation on the
+#                       decode pool; every stream byte-identical (NEW)
 #   observability       chaos arcs stay visible in traces + telemetry
 #
 # The env pins below make the arcs quick and reproducible:
@@ -122,6 +126,9 @@ run_scenario fleet-tenant-burst \
   tests/test_fleet.py::test_fleet_tenant_burst_sheds_only_aggressor "$@"
 run_scenario slo-burn-alert \
   tests/test_fleet.py::test_slo_burn_alert_fires_and_clears_once "$@"
+run_scenario disagg-handoff-kill \
+  tests/test_disagg.py::test_fleet_kill_prefill_pool_mid_handoff \
+  tests/test_disagg.py::test_fleet_export_wire_fault_falls_back "$@"
 run_scenario observability tests/test_telemetry.py tests/test_tracing.py "$@"
 
 echo

@@ -302,7 +302,18 @@ def cmd_fleet(base: str, interval_s: float, count: int) -> int:
               f"pending={topo['pending']} "
               f"done={topo['done']}/{topo['requests']} "
               f"affinity={topo['affinity']}")
-        hdr = (f"  {'idx':>3} {'gen':>3} {'state':<8} {'port':>6} "
+        if topo.get("disagg"):
+            pools = ", ".join(
+                f"{role}={idxs}" for role, idxs in
+                sorted(topo.get("pools", {}).items())
+            )
+            hoffs = topo.get("handoffs", {})
+            print(f"  disagg pools: {pools}  handoffs: "
+                  f"pending={hoffs.get('pending', 0)} "
+                  f"ok={hoffs.get('ok', 0)} "
+                  f"fallback={hoffs.get('fallback', 0)}")
+        hdr = (f"  {'idx':>3} {'gen':>3} {'state':<8} {'role':<8} "
+               f"{'port':>6} "
                f"{'infl':>4} {'place':>6} {'hit%':>6} {'est_wait':>9} "
                f"{'backlog':>8} {'queue':>5}")
         print(hdr)
@@ -312,6 +323,7 @@ def cmd_fleet(base: str, interval_s: float, count: int) -> int:
             load = rep.get("load") or {}
             est = load.get("est_wait_s")
             print(f"  {rep['idx']:>3} {rep['gen']:>3} {state:<8} "
+                  f"{rep.get('role', 'unified'):<8} "
                   f"{rep['port'] or '-':>6} {rep['inflight']:>4} "
                   f"{rep['placements']:>6} {rep['hit_rate'] * 100:>5.1f}% "
                   f"{'-' if est is None else f'{est:.3f}s':>9} "
@@ -327,7 +339,8 @@ def cmd_fleet(base: str, interval_s: float, count: int) -> int:
                 if "replica" not in e["labels"]:
                     sums[name + _fmt_labels(e["labels"])] = e["value"]
         shown = sorted(k for k in sums if k.startswith("tdt_serving_")
-                       or k.startswith("tdt_fleet_"))
+                       or k.startswith("tdt_fleet_")
+                       or k.startswith("tdt_disagg_"))
         if shown:
             print("  fleet counters (summed across replicas):")
             for k in shown:

@@ -1,0 +1,28 @@
+"""Disaggregated prefill/decode serving (DistServe, arXiv:2401.09670).
+
+Three pieces, layered bottom-up:
+
+* :mod:`pp_engine` — the TP×PP engine programs: prefill microbatches flow
+  through ``gpipe_forward`` over a 2-D ``pp×tp`` mesh, decode round-robins
+  slot groups across stages.
+* :mod:`kv_transfer` — the paged-KV handoff wire: quantized block payloads
+  + scale pools walked out of a prefill pool's block chain, shipped over
+  the fleet HTTP wire (base64 blob) or the on-mesh p2p layer, scattered
+  into the decode pool's ``PagedKVCache`` bitwise.
+* :mod:`pool` — replica roles (``prefill``/``decode``/``unified``) and the
+  env knobs (``TDT_DISAGG``, ``TDT_POOL_ROLE``, ``TDT_KV_WIRE``) the fleet
+  router's pool-placement decision keys on.
+
+See ``docs/disagg.md`` for the wire format and the determinism fallback.
+"""
+
+from triton_dist_tpu.disagg.pool import (  # noqa: F401
+    ROLE_DECODE,
+    ROLE_PREFILL,
+    ROLE_UNIFIED,
+    default_roles,
+    disagg_enabled,
+    kv_wire_from_env,
+    pool_role_from_env,
+    role_id,
+)

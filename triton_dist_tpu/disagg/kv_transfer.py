@@ -1,0 +1,180 @@
+"""The paged-KV handoff wire: prefill pool → decode pool block transfer.
+
+A handoff ships exactly the blocks a prefill wrote — ``ceil(len / bs)``
+chain positions walked out of the donor's block table — in the pool's
+STORED format (PR 19): quantized payload rows plus the parallel per-row
+scale pools when ``cache.quant`` is set. Shipping stored bytes (never
+dequantizing on the wire) is what makes the transfer bitwise: the decode
+pool's rows after :func:`scatter_kv_blocks` are byte-identical to the rows
+a local prefill of the same prompt would have written, so the greedy
+decode stream that follows is byte-identical too (``tests/test_disagg.py``).
+
+Two transports behind ``TDT_KV_WIRE`` (``disagg/pool.py``):
+
+* ``http`` — :func:`pack_kv_blocks` / :func:`unpack_kv_blocks`: a JSON
+  blob with base64 payloads, carried over the fleet wire between replica
+  subprocesses (the CPU-harness path, and any cross-host fleet).
+* ``p2p`` — :func:`ship_kv_stacked`: pools sharing one mesh shift packed
+  slabs along an axis through the one-sided ``p2p_put_shard`` layer (no
+  host round-trip; ``use_xla`` off-TPU).
+
+Wire format (version 1)::
+
+    {"ver": 1, "kind": "tdt-paged-kv", "block_size": B, "n_blocks": n,
+     "length": L_prompt, "quant": null|"int8"|"fp8",
+     "dtype": "...", "shape": [L, n, Hkv, B, D], "k": b64, "v": b64,
+     # quant only:
+     "scale_dtype": "...", "scale_shape": [L, n, Hkv, B, 1],
+     "k_scale": b64, "v_scale": b64,
+     "wire_bytes": total payload bytes}
+"""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+try:  # registers "bfloat16"/"float8_*" with np.dtype (ships with jax)
+    import ml_dtypes  # noqa: F401
+except ImportError:  # pragma: no cover - jax always vendors it
+    pass
+
+WIRE_KIND = "tdt-paged-kv"
+WIRE_VERSION = 1
+
+
+def blocks_for(length: int, block_size: int) -> int:
+    """Chain positions holding ``length`` prefilled rows."""
+    return max(-(-int(length) // int(block_size)), 1)
+
+
+def _b64(a: np.ndarray) -> str:
+    return base64.b64encode(np.ascontiguousarray(a).tobytes()).decode("ascii")
+
+
+def _unb64(s: str, dtype: str, shape) -> np.ndarray:
+    return np.frombuffer(base64.b64decode(s), dtype=np.dtype(dtype)).reshape(
+        tuple(shape)
+    )
+
+
+def pack_kv_blocks(cache, chain, *, length: int) -> dict:
+    """Walk ``chain`` (a request's block-table positions) out of ``cache``
+    and pack the first ``ceil(length / block_size)`` blocks — the prefilled
+    content — into a wire blob. Stored bytes only: quantized pools ship
+    payload + scales, never a dequantized intermediate."""
+    bs = int(cache.block_size)
+    n = min(blocks_for(length, bs), len(chain))
+    idxs = np.asarray(list(chain[:n]), np.int32)
+    k = np.asarray(jax.device_get(cache.k[:, idxs]))
+    v = np.asarray(jax.device_get(cache.v[:, idxs]))
+    blob = {
+        "ver": WIRE_VERSION,
+        "kind": WIRE_KIND,
+        "block_size": bs,
+        "n_blocks": int(n),
+        "length": int(length),
+        "quant": cache.quant,
+        "dtype": str(k.dtype),
+        "shape": list(k.shape),
+        "k": _b64(k),
+        "v": _b64(v),
+    }
+    wire_bytes = k.nbytes + v.nbytes
+    if cache.quant is not None:
+        ks = np.asarray(jax.device_get(cache.k_scale[:, idxs]))
+        vs = np.asarray(jax.device_get(cache.v_scale[:, idxs]))
+        blob["scale_dtype"] = str(ks.dtype)
+        blob["scale_shape"] = list(ks.shape)
+        blob["k_scale"] = _b64(ks)
+        blob["v_scale"] = _b64(vs)
+        wire_bytes += ks.nbytes + vs.nbytes
+    blob["wire_bytes"] = int(wire_bytes)
+    return blob
+
+
+def unpack_kv_blocks(blob: dict) -> dict:
+    """Decode a wire blob into host arrays + meta (validates the header)."""
+    if blob.get("kind") != WIRE_KIND \
+            or int(blob.get("ver", -1)) != WIRE_VERSION:
+        raise ValueError(
+            f"not a {WIRE_KIND} v{WIRE_VERSION} blob: "
+            f"kind={blob.get('kind')!r} ver={blob.get('ver')!r}"
+        )
+    out = {
+        "block_size": int(blob["block_size"]),
+        "n_blocks": int(blob["n_blocks"]),
+        "length": int(blob["length"]),
+        "quant": blob.get("quant"),
+        "k": _unb64(blob["k"], blob["dtype"], blob["shape"]),
+        "v": _unb64(blob["v"], blob["dtype"], blob["shape"]),
+        "k_scale": None,
+        "v_scale": None,
+    }
+    if out["quant"] is not None:
+        out["k_scale"] = _unb64(
+            blob["k_scale"], blob["scale_dtype"], blob["scale_shape"]
+        )
+        out["v_scale"] = _unb64(
+            blob["v_scale"], blob["scale_dtype"], blob["scale_shape"]
+        )
+    return out
+
+
+def scatter_kv_blocks(cache, chain, payload: dict):
+    """Scatter an unpacked payload into ``cache`` at the importer's own
+    ``chain`` positions (donor block ids are donor-local and never cross
+    the wire as addresses). Returns the updated cache."""
+    n = int(payload["n_blocks"])
+    if len(chain) < n:
+        raise ValueError(f"chain holds {len(chain)} blocks, payload has {n}")
+    if int(payload["block_size"]) != int(cache.block_size):
+        raise ValueError(
+            f"wire block_size {payload['block_size']} != pool "
+            f"{cache.block_size}"
+        )
+    if (payload["quant"] or None) != (cache.quant or None):
+        raise ValueError(
+            f"wire quant {payload['quant']!r} != pool {cache.quant!r}"
+        )
+    k = np.asarray(payload["k"])
+    if np.dtype(k.dtype) != np.dtype(cache.k.dtype):
+        raise ValueError(f"wire dtype {k.dtype} != pool {cache.k.dtype}")
+    idxs = jnp.asarray(list(chain[:n]), jnp.int32)
+    new = {
+        "k": cache.k.at[:, idxs].set(jnp.asarray(k)),
+        "v": cache.v.at[:, idxs].set(jnp.asarray(np.asarray(payload["v"]))),
+    }
+    if cache.quant is not None:
+        new["k_scale"] = cache.k_scale.at[:, idxs].set(
+            jnp.asarray(np.asarray(payload["k_scale"]))
+        )
+        new["v_scale"] = cache.v_scale.at[:, idxs].set(
+            jnp.asarray(np.asarray(payload["v_scale"]))
+        )
+    return dataclasses.replace(cache, **new)
+
+
+def ship_kv_stacked(ctx, arrays: dict, *, axis: str = "pp", offset: int = 1,
+                    use_xla: bool | None = None) -> dict:
+    """On-mesh wire (``TDT_KV_WIRE=p2p``): each rank contributes one packed
+    slab — ``arrays`` values are ``(world, ...)`` stacks, rank-major on dim
+    0 — and the ring shifts every slab ``offset`` pools along ``axis``
+    through the one-sided p2p layer, so rank r receives rank r-offset's
+    blocks without a host round-trip. Returns the shifted stacks."""
+    from triton_dist_tpu.kernels.p2p import p2p_send_recv
+
+    if use_xla is None:
+        use_xla = jax.default_backend() != "tpu"
+    return {
+        name: np.asarray(
+            p2p_send_recv(ctx, jnp.asarray(a), axis=axis, offset=offset,
+                          use_xla=use_xla)
+        )
+        for name, a in arrays.items()
+        if a is not None
+    }

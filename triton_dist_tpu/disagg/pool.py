@@ -1,0 +1,71 @@
+"""Pool roles for disaggregated serving (the Llumnix/DistServe split).
+
+A replica's role decides which phase of a request's life it hosts:
+
+* ``prefill`` — admits fresh (unseeded) requests, runs prefill + the first
+  sampled token, then parks the KV chain for handoff instead of decoding.
+* ``decode`` — admits handoff imports and journal-seeded resumes; its slots
+  only ever run the decode loop, so a prefill burst elsewhere cannot
+  inflate its TPOT.
+* ``unified`` — the pre-disaggregation behavior: both phases in one loop.
+
+Roles are plumbed as env (``TDT_POOL_ROLE``, set per replica by the fleet
+router) so a replica subprocess self-describes in ``/fleet/status`` and the
+``tdt_disagg_pool_role`` gauge. See ``docs/disagg.md``.
+"""
+
+from __future__ import annotations
+
+import os
+
+ROLE_PREFILL = "prefill"
+ROLE_DECODE = "decode"
+ROLE_UNIFIED = "unified"
+ROLES = (ROLE_PREFILL, ROLE_DECODE, ROLE_UNIFIED)
+
+# Stable gauge encoding (labels carry the string; the value must be numeric).
+_ROLE_IDS = {ROLE_UNIFIED: 0, ROLE_PREFILL: 1, ROLE_DECODE: 2}
+
+KV_WIRE_HTTP = "http"
+KV_WIRE_P2P = "p2p"
+
+
+def pool_role_from_env(default: str = ROLE_UNIFIED) -> str:
+    """This process's pool role (``TDT_POOL_ROLE``)."""
+    role = os.environ.get("TDT_POOL_ROLE", default).strip().lower()
+    if role not in ROLES:
+        raise ValueError(f"TDT_POOL_ROLE={role!r} not in {ROLES}")
+    return role
+
+
+def disagg_enabled() -> bool:
+    """Whether the fleet router splits replicas into pools (``TDT_DISAGG``)."""
+    return os.environ.get("TDT_DISAGG", "0").strip().lower() in (
+        "1", "true", "yes", "on",
+    )
+
+
+def kv_wire_from_env(default: str = KV_WIRE_HTTP) -> str:
+    """Handoff transport (``TDT_KV_WIRE``): "http" (base64 blob over the
+    fleet wire — the only option between subprocess replicas) or "p2p"
+    (the one-sided stage-shift layer, for pools sharing one mesh)."""
+    wire = os.environ.get("TDT_KV_WIRE", default).strip().lower()
+    if wire not in (KV_WIRE_HTTP, KV_WIRE_P2P):
+        raise ValueError(f"TDT_KV_WIRE={wire!r} not in ('http', 'p2p')")
+    return wire
+
+
+def role_id(role: str) -> int:
+    """Numeric encoding for the ``tdt_disagg_pool_role`` gauge."""
+    return _ROLE_IDS[role]
+
+
+def default_roles(n: int) -> list[str]:
+    """Default pool split for ``n`` replicas: lower half prefill, upper
+    half decode (decode gets the larger share — decode slots are the
+    scarce resource under steady load). ``n < 2`` cannot split and stays
+    unified."""
+    if n < 2:
+        return [ROLE_UNIFIED] * n
+    n_prefill = max(n // 2, 1)
+    return [ROLE_PREFILL] * n_prefill + [ROLE_DECODE] * (n - n_prefill)

@@ -1,0 +1,210 @@
+"""TP×PP engine programs: the 2-D-mesh prefill/decode the Engine swaps in.
+
+``Engine._build_impl`` calls :func:`build_pp_programs` when the mesh has a
+``pp`` axis of size > 1. Two programs come back, drop-in replacements for
+the single-mesh ``_prefill`` / ``_decode_shard`` contract (same specs, so
+everything downstream — ``generate``, ``decode_chunk``, the paged bounce,
+``serve`` — composes unchanged):
+
+* **Prefill** — one microbatch per prompt row, flowing through
+  ``gpipe_forward`` over ``PPCommLayer``: stage ``s`` scans its contiguous
+  ``L/S`` layer block (``gpipe_stage_params``) and records its stage-local
+  KV through the schedule's aux channel; the last stage's hidden states and
+  every stage's KV slabs are reassembled with ``all_gather`` over ``pp``
+  (an all-gather pick is bitwise — a masked psum would re-associate
+  ``-0.0 + 0.0``).
+* **Decode** — slot groups round-robin across stages: with ``B`` slots and
+  ``S`` stages, ``S`` groups of ``B/S`` rows ride a ``G + S - 1``-tick
+  pipeline, each stage updating its own layer slice of the KV cache for
+  every group.
+
+Byte parity vs the single-mesh engine is the contract, not an aspiration:
+each KV row and each logit row is computed by exactly one stage with the
+very layer bodies ``dense.py`` uses, so ``tests/test_pp.py`` asserts
+bitwise equality on the CPU harness (world 4 = 2×2). The MoE
+capacity-dropping caveat of chunked prefill applies here identically.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from triton_dist_tpu.layers.pp import PPCommLayer
+from triton_dist_tpu.layers.pp_schedule import gpipe_forward, gpipe_stage_params
+from triton_dist_tpu.layers.tp import RMSNorm
+from triton_dist_tpu.runtime import telemetry
+
+
+def build_pp_programs(engine, *, p_specs, tok_spec, kv_spec, len_spec):
+    """Build (prefill, decode_shard) for ``engine`` over its ``pp×tp`` mesh.
+
+    ``prefill(params, tokens)`` and ``decode_shard(params, extra, token,
+    ks, vs, lengths)`` match the single-mesh program signatures exactly.
+    """
+    from triton_dist_tpu.models.engine import DECODE_MODE, PREFILL_MODE
+
+    model = engine.model
+    ctx = model.ctx
+    mesh = ctx.mesh
+    c = model.config
+    tp_axis = model.axis
+    S = int(mesh.shape["pp"])
+    L = c.num_layers
+    if L % S != 0:
+        raise ValueError(
+            f"num_layers={L} must divide over pp={S} stages "
+            "(gpipe_stage_params assigns contiguous L/S blocks)"
+        )
+    per = L // S
+    prefill_mode = PREFILL_MODE[engine.backend]
+    decode_mode = DECODE_MODE[engine.backend]
+    eps = c.rms_eps
+    dt = jnp.dtype(c.dtype)
+    hkv_l = c.num_kv_heads // model.world
+    hd = c.head_dim
+    comm = PPCommLayer(
+        axis="pp",
+        # The one-sided DMA kernel needs real TPU cores; everywhere else
+        # (the CPU parity harness) the ring shift is collective-permute.
+        backend="pallas" if jax.default_backend() == "tpu" else "xla",
+        mesh_axes=ctx.axis_names,
+    )
+    telemetry.set_gauge("tdt_pp_stages", float(S))
+
+    def _mlp_mode(mode):
+        # dense.py's per-mode MLP routing collapses to this for the
+        # replicated modes PP supports (xla / dist_ar).
+        return "xla" if mode == "xla" else "dist_ar"
+
+    # ---------------------------------------------------------- prefill
+    def prefill_fn(p, tokens):
+        bsz, seq = tokens.shape
+        stack = gpipe_stage_params(model._layer_stack(p), L, axis="pp")
+        pos1 = jnp.arange(seq, dtype=jnp.int32)[None]  # (1, seq)
+
+        def stage_fn(xm):  # (seq, d): one prompt row through my layer block
+            def layer_fn(x, lp):
+                attn = model._attn(lp)
+                h = RMSNorm(weight=lp["ln1"], eps=eps)(x)
+                a, (k, v) = attn.prefill(h, pos1, mode=prefill_mode, bsz=1)
+                x = x + a
+                h = RMSNorm(weight=lp["ln2"], eps=eps)(x)
+                m = model._mlp(lp)(h, mode=_mlp_mode(prefill_mode))
+                return x + m, (k, v)
+
+            return jax.lax.scan(layer_fn, xm, stack)
+
+        x = p.embed[tokens]  # (B, seq, d) — stage 0 injects row microbatches
+        aux0 = (
+            jnp.zeros((bsz, per, 1, hkv_l, seq, hd), dt),
+            jnp.zeros((bsz, per, 1, hkv_l, seq, hd), dt),
+        )
+        out, (k_aux, v_aux) = gpipe_forward(
+            stage_fn, x, axis="pp", comm=comm, aux_init=aux0
+        )
+        # ``out`` is real on the last stage, zeros elsewhere; picking the
+        # last stage's gathered copy is a bitwise broadcast.
+        out = jax.lax.all_gather(out, "pp", axis=0)[S - 1]
+        x_last = RMSNorm(weight=p.final_norm, eps=eps)(out[:, -1])
+        logits = jnp.dot(x_last, p.lm_head, preferred_element_type=jnp.float32)
+        # (B, per, 1, Hkv, seq, D) aux → stage-local (per, B, Hkv, seq, D),
+        # then rank-major tiled gather = layer order.
+        ks = jax.lax.all_gather(
+            jnp.moveaxis(k_aux[:, :, 0], 0, 1), "pp", axis=0, tiled=True
+        )
+        vs = jax.lax.all_gather(
+            jnp.moveaxis(v_aux[:, :, 0], 0, 1), "pp", axis=0, tiled=True
+        )
+        return jax.lax.all_gather(logits, tp_axis, axis=1, tiled=True), ks, vs
+
+    pp_prefill_sm = jax.jit(
+        jax.shard_map(
+            prefill_fn, mesh=mesh,
+            in_specs=(p_specs, tok_spec),
+            out_specs=(tok_spec, kv_spec, kv_spec),
+            check_vma=False,
+        )
+    )
+
+    def pp_prefill(params, tokens):
+        telemetry.inc(
+            "tdt_pp_prefill_microbatches_total", float(tokens.shape[0])
+        )
+        telemetry.inc("tdt_pp_ticks_total", float(tokens.shape[0] + S - 1))
+        return pp_prefill_sm(params, tokens)
+
+    # ----------------------------------------------------------- decode
+    def decode_fn(p, token, ks, vs, lengths):
+        B = token.shape[0]
+        me = jax.lax.axis_index("pp")
+        stack = gpipe_stage_params(model._layer_stack(p), L, axis="pp")
+        k_loc = jax.lax.dynamic_slice_in_dim(ks, me * per, per, axis=0)
+        v_loc = jax.lax.dynamic_slice_in_dim(vs, me * per, per, axis=0)
+        # Round-robin: S groups of B/S slots when the batch divides; a
+        # single full-width group otherwise (the bsz-1 serve path).
+        gsz = B // S if (B % S == 0 and B >= S) else B
+        G = B // gsz
+        steps = G + S - 1
+        recv = jnp.zeros((gsz, c.hidden_size), dt)
+        fin = jnp.zeros((B, c.hidden_size), dt)
+
+        for t in range(steps):
+            g = t - me
+            active = jnp.logical_and(g >= 0, g < G)
+            g_idx = jnp.clip(g, 0, G - 1)
+            r0 = g_idx * gsz
+            tok_g = jax.lax.dynamic_slice_in_dim(token, r0, gsz, axis=0)
+            len_g = jax.lax.dynamic_slice_in_dim(lengths, r0, gsz, axis=0)
+            k_g = jax.lax.dynamic_slice_in_dim(k_loc, r0, gsz, axis=1)
+            v_g = jax.lax.dynamic_slice_in_dim(v_loc, r0, gsz, axis=1)
+            x = jnp.where(me == 0, p.embed[tok_g], recv)
+
+            def layer_fn(x, layer, len_g=len_g):
+                lp, k_c, v_c = layer
+                attn = model._attn(lp)
+                h = RMSNorm(weight=lp["ln1"], eps=eps)(x)
+                a, (k_c, v_c) = attn.decode(
+                    h, len_g, k_c, v_c, len_g, mode=decode_mode
+                )
+                x = x + a
+                h = RMSNorm(weight=lp["ln2"], eps=eps)(x)
+                m = model._mlp(lp)(h, mode=_mlp_mode(decode_mode))
+                return x + m, (k_c, v_c)
+
+            y, (k_new, v_new) = jax.lax.scan(layer_fn, x, (stack, k_g, v_g))
+            y = jnp.where(active, y, jnp.zeros_like(y))
+            # Masked ticks must not touch the cache (their rows belong to
+            # whichever stage IS active on that group this tick).
+            k_loc = jax.lax.dynamic_update_slice_in_dim(
+                k_loc, jnp.where(active, k_new, k_g), r0, axis=1
+            )
+            v_loc = jax.lax.dynamic_update_slice_in_dim(
+                v_loc, jnp.where(active, v_new, v_g), r0, axis=1
+            )
+            take = jnp.logical_and(active, me == S - 1)
+            fin_g = jax.lax.dynamic_slice_in_dim(fin, r0, gsz, axis=0)
+            fin = jax.lax.dynamic_update_slice_in_dim(
+                fin, jnp.where(take, y, fin_g), r0, axis=0
+            )
+            if t + 1 < steps:
+                recv = comm.send_next(y)
+
+        fin = jax.lax.all_gather(fin, "pp", axis=0)[S - 1]
+        x = RMSNorm(weight=p.final_norm, eps=eps)(fin)
+        logits = jnp.dot(x, p.lm_head, preferred_element_type=jnp.float32)
+        ks = jax.lax.all_gather(k_loc, "pp", axis=0, tiled=True)
+        vs = jax.lax.all_gather(v_loc, "pp", axis=0, tiled=True)
+        return jax.lax.all_gather(logits, tp_axis, axis=1, tiled=True), ks, vs
+
+    pp_decode_sm = jax.shard_map(
+        decode_fn, mesh=mesh,
+        in_specs=(p_specs, tok_spec, kv_spec, kv_spec, len_spec),
+        out_specs=(tok_spec, kv_spec, kv_spec),
+        check_vma=False,
+    )
+
+    def pp_decode(p_, extra, t_, k_, v_, l_):
+        return pp_decode_sm(p_, t_, k_, v_, l_)
+
+    return pp_prefill, pp_decode

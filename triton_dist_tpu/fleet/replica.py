@@ -9,6 +9,10 @@ introspection endpoint (``runtime.introspect.register_json_route``):
 ``POST /fleet/stream``     batched positional poll: ``{reqs: [[id, from]..]}``
 ``POST /fleet/placement``  warm-prefix + load hint for ``{prompt}``
 ``POST /fleet/cancel``     cancel ``{req_id}`` (drain-side of a migration)
+``POST /fleet/kv_export``  pack a parked handoff's KV blocks: ``{req_id}``
+``POST /fleet/kv_import``  admit with wire KV: ``{prompt, max_new, tokens,
+                           kv}`` (the decode-pool half of a handoff)
+``POST /fleet/kv_release`` drop a parked handoff's refs: ``{req_id}``
 ``POST /fleet/drain``      enter drain mode (rolling rebuild)
 ``GET  /fleet/status``     ready / draining / drained / occupancy
 ``GET  /fleet/journal``    flush + export the write-ahead journal records
@@ -78,6 +82,9 @@ class ReplicaService:
             ("stream", self._r_stream, ("POST",)),
             ("placement", self._r_placement, ("POST",)),
             ("cancel", self._r_cancel, ("POST",)),
+            ("kv_export", self._r_kv_export, ("POST",)),
+            ("kv_import", self._r_kv_import, ("POST",)),
+            ("kv_release", self._r_kv_release, ("POST",)),
             ("drain", self._r_drain, ("GET", "POST")),
             ("status", self._r_status, ("GET", "POST")),
             ("journal", self._r_journal, ("GET", "POST")),
@@ -157,6 +164,7 @@ class ReplicaService:
                 ttft_deadline_s=body.get("ttft_deadline_s"),
                 deadline_s=body.get("deadline_s"),
                 trace_ctx=tracing.extract(body.get("trace")),
+                prefill_only=bool(body.get("prefill_only", False)),
             )
         except (TypeError, ValueError) as e:
             return 400, {"error": f"bad field value: {e}"}
@@ -229,6 +237,53 @@ class ReplicaService:
         except (TypeError, ValueError) as e:
             return 400, {"error": f"bad field value: {e}"}
 
+    def _r_kv_export(self, method, query, body) -> tuple[int, dict]:
+        """Pack a parked handoff's prefilled KV blocks into the wire blob
+        (``disagg.kv_transfer`` v1). 404 when nothing is parked — the
+        router's cue to fall back to journal re-derivation."""
+        err = self._body_error(body, "req_id")
+        if err:
+            return 400, {"error": err}
+        try:
+            return 200, {"kv": self.server.export_kv(int(body["req_id"]))}
+        except KeyError as e:
+            return 404, {"error": str(e)}
+        except (TypeError, ValueError) as e:
+            return 400, {"error": f"bad field value: {e}"}
+
+    def _r_kv_import(self, method, query, body) -> tuple[int, dict]:
+        """Admit a request whose prefill KV arrives in the body (the
+        decode-pool half of a disaggregated handoff)."""
+        err = self._body_error(body, "prompt", "max_new", "tokens", "kv")
+        if err:
+            return 400, {"error": err}
+        try:
+            req = self.server.import_kv(
+                body["prompt"], int(body["max_new"]), body["tokens"],
+                body["kv"],
+                on_token=self._on_token, on_finish=self._on_finish,
+                priority=int(body.get("priority", 1)),
+                tenant=str(body.get("tenant", "default")),
+                weight=float(body.get("weight", 1.0)),
+                ttft_deadline_s=body.get("ttft_deadline_s"),
+                deadline_s=body.get("deadline_s"),
+                trace_ctx=tracing.extract(body.get("trace")),
+            )
+        except (TypeError, ValueError) as e:
+            return 400, {"error": f"bad field value: {e}"}
+        return self._admit_response(req)
+
+    def _r_kv_release(self, method, query, body) -> tuple[int, dict]:
+        err = self._body_error(body, "req_id")
+        if err:
+            return 400, {"error": err}
+        try:
+            return 200, {
+                "released": self.server.release_handoff(int(body["req_id"]))
+            }
+        except (TypeError, ValueError) as e:
+            return 400, {"error": f"bad field value: {e}"}
+
     def _r_trace(self, method, query, body, rest="") -> tuple[int, dict]:
         """``GET /fleet/trace/<id>``: this process's span ring for one
         trace — what the router merges into the fleet-wide timeline. The id
@@ -271,6 +326,8 @@ class ReplicaService:
             "occupancy": s.scheduler.occupancy(),
             "queue_depth": s.scheduler.queue_depth(),
             "backend": s.engine.backend,
+            "role": s.role,
+            "parked_handoffs": len(s._handoffs),
             "pid": os.getpid(),
         }
 
@@ -289,24 +346,37 @@ def build_server():
     (default ``xla``), ``TDT_REPLICA_MAX_LEN`` (default 32) and
     ``TDT_REPLICA_SEED`` (default 1) pick the model; every replica of a
     fleet must share preset/seed/backend so greedy decoding regenerates
-    migrated streams byte-identically. Slots/chunk/journal ride the usual
-    ``TDT_SERVE_*`` / ``TDT_JOURNAL_DIR`` knobs.
+    migrated streams byte-identically. ``TDT_PP_STAGES`` > 1 builds the
+    replica over a ``pp×tp`` CPU mesh of that many pipeline stages (model
+    init is mesh-independent, so PP replicas stay byte-compatible with
+    world-1 peers). Slots/chunk/journal ride the usual ``TDT_SERVE_*`` /
+    ``TDT_JOURNAL_DIR`` knobs.
     """
     import jax
 
     from triton_dist_tpu.models import PRESETS, DenseLLM, Engine
     from triton_dist_tpu.runtime.mesh import initialize_distributed
-    from triton_dist_tpu.runtime.platform import cpu_mesh
+    from triton_dist_tpu.runtime.platform import cpu_mesh, use_cpu_devices
     from triton_dist_tpu.serving import InferenceServer
 
     preset = os.environ.get("TDT_REPLICA_PRESET", "test-dense")
     backend = os.environ.get("TDT_REPLICA_BACKEND", "xla")
     max_len = get_int_env("TDT_REPLICA_MAX_LEN", 32)
     seed = get_int_env("TDT_REPLICA_SEED", 1)
-    m = cpu_mesh((1,), ("tp",))
-    ctx = initialize_distributed(
-        devices=list(m.devices.flat), axis_names=("tp",), set_default=False
-    )
+    pp = get_int_env("TDT_PP_STAGES", 1)
+    if pp > 1:
+        use_cpu_devices(max(pp, 2))
+        m = cpu_mesh((pp, 1), ("pp", "tp"))
+        ctx = initialize_distributed(
+            devices=list(m.devices.flat), axis_names=("pp", "tp"),
+            axis_sizes=(pp, 1), set_default=False,
+        )
+    else:
+        m = cpu_mesh((1,), ("tp",))
+        ctx = initialize_distributed(
+            devices=list(m.devices.flat), axis_names=("tp",),
+            set_default=False,
+        )
     model = DenseLLM(PRESETS[preset], ctx, key=jax.random.PRNGKey(seed))
     engine = Engine(model, backend=backend, max_len=max_len)
     return InferenceServer(engine)

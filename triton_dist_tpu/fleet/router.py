@@ -106,6 +106,26 @@ priority-aware ``queue_full`` shed (lowest tier, most-parked tenant
 first), and placement affinity probes are tenant-scoped — see
 ``docs/fleet.md`` ("Elasticity & multi-tenant QoS").
 
+**Disaggregated pools** (``roles=`` / ``TDT_DISAGG=1`` — see
+``docs/disagg.md``). Each replica gets a pool role (``disagg.pool``,
+injected as ``TDT_POOL_ROLE`` at spawn): fresh requests place on the
+*prefill* pool with ``prefill_only`` set, the prefill replica parks its
+KV chain after the first sampled token and finishes its slot with
+``reason="handoff"``, and :meth:`pump` splices the stream onto the
+least-loaded *decode* replica — ``POST /fleet/kv_export`` on the donor,
+``POST /fleet/kv_import`` (the ``disagg.kv_transfer`` wire blob) on the
+target, best-effort ``POST /fleet/kv_release`` back on the donor. Any
+failure along that arc — donor killed mid-transfer, export 404 after a
+pool rebuild, import reject, wire fault — falls back to the SAME journal
+re-derivation every other failure path uses: the request re-places
+seeded with its delivered history and the decode replica recomputes the
+prefill KV locally, byte-identical. A whole pool going dark only widens
+placement back to any survivor (``tdt_disagg_pool_fallbacks_total``) —
+the client never sees a reject for a pool-sized failure. Telemetry:
+``tdt_disagg_handoffs_total{outcome}``,
+``tdt_disagg_handoff_bytes_total``, ``tdt_disagg_handoff_seconds``
+(histogram), ``tdt_disagg_pool_fallbacks_total{phase}``.
+
 Control plane is stdlib-only: ``subprocess`` + ``urllib`` + JSON over
 each replica's loopback introspection endpoint. The router itself is
 single-threaded — drive it with :meth:`pump` (one poll sweep) or
@@ -147,6 +167,10 @@ import time
 import urllib.error
 import urllib.request
 
+from triton_dist_tpu.disagg.pool import (
+    ROLE_DECODE, ROLE_PREFILL, ROLE_UNIFIED, ROLES,
+    default_roles, disagg_enabled,
+)
 from triton_dist_tpu.runtime import introspect, slo, telemetry, tracing
 from triton_dist_tpu.runtime.resilience import WireChaosSchedule
 from triton_dist_tpu.runtime.utils import get_float_env, get_int_env, tdt_log
@@ -192,6 +216,7 @@ def _classify_oserror(err: BaseException) -> str:
 _IDEMPOTENT_ROUTES = frozenset({
     "/fleet/stream", "/fleet/placement", "/fleet/status", "/fleet/journal",
     "/fleet/drain", "/fleet/cancel", "/fleet/trace/*", "/snapshot",
+    "/fleet/kv_export", "/fleet/kv_release",
 })
 
 
@@ -345,7 +370,7 @@ class FleetRequest:
         "fleet_id", "prompt", "max_new", "priority", "tenant", "weight",
         "wfq_tag", "on_token", "on_finish",
         "tokens", "done", "finish_reason", "replica", "remote_id",
-        "migrations", "placed_reason", "trace", "_seed",
+        "migrations", "placed_reason", "trace", "_seed", "handoff",
         "ttft_deadline_s", "deadline_s", "arrived_at",
     )
 
@@ -389,6 +414,11 @@ class FleetRequest:
         #: Resume history to seed at the next placement (migration only):
         #: max(journal tokens, delivered tokens) from the previous replica.
         self._seed: list[int] = []
+        #: Disaggregated handoff state: None (never parked), "pending"
+        #: (prefill done, KV parked, awaiting the export/import splice),
+        #: "ok" (spliced onto a decode replica), "fallback" (the KV wire
+        #: failed — decode re-derived from journaled token history).
+        self.handoff: str | None = None
 
 
 class ReplicaHandle:
@@ -410,6 +440,10 @@ class ReplicaHandle:
         self._log_f = None
         self.alive = False
         self.draining = False
+        #: Disaggregated pool role (``disagg.pool``): the router stamps it
+        #: here and injects ``TDT_POOL_ROLE`` at every (re)spawn, so a
+        #: rebuilt replica rejoins its pool.
+        self.role = ROLE_UNIFIED
         #: Scaled-down slot: permanently out of the pump loop (never
         #: respawned, never placed) — the autoscaler's tombstone.
         self.retired = False
@@ -449,7 +483,8 @@ class Router:
     def __init__(self, num_replicas: int, workdir: str, env: dict | None = None,
                  affinity: bool = True, request_timeout_s: float = 30.0,
                  per_replica_env: dict | None = None,
-                 wire_chaos: str | None = None):
+                 wire_chaos: str | None = None,
+                 roles: list[str] | None = None):
         assert num_replicas >= 1
         self.workdir = os.fspath(workdir)
         #: Extra env for replica subprocesses (TDT_REPLICA_*, TDT_SERVE_*…)
@@ -496,13 +531,35 @@ class Router:
             except ValueError as e:
                 tdt_log(f"[fleet] ignoring bad TDT_FLEET_CHAOS: {e}",
                         level="warn")
+        #: Disaggregated pool roles, one per replica (``disagg.pool``).
+        #: Explicit ``roles=`` wins; otherwise ``TDT_DISAGG=1`` splits the
+        #: fleet with :func:`default_roles` (lower half prefill, upper half
+        #: decode); otherwise everything stays unified (pre-disagg behavior).
+        if roles is None:
+            roles = default_roles(num_replicas) if disagg_enabled() \
+                else [ROLE_UNIFIED] * num_replicas
+        if len(roles) != num_replicas:
+            raise ValueError(
+                f"roles has {len(roles)} entries for {num_replicas} replicas"
+            )
+        for r in roles:
+            if r not in ROLES:
+                raise ValueError(f"unknown pool role {r!r} (not in {ROLES})")
+        self.roles = [str(r) for r in roles]
+        #: Whether placement is pool-aware (any non-unified role).
+        self.disagg = any(r != ROLE_UNIFIED for r in self.roles)
         self._replicas = [
             ReplicaHandle(i, os.path.join(self.workdir, f"r{i}"))
             for i in range(num_replicas)
         ]
         now = time.monotonic()
-        for h in self._replicas:
+        for h, r in zip(self._replicas, self.roles):
             h.health = ReplicaHealth(now=now, **self._health_kw)
+            h.role = r
+        #: Prefill-done requests whose parked KV awaits the export → import
+        #: splice onto a decode replica: {"donor": idx, "rid", "fr", "at"}.
+        #: Processed by :meth:`pump` via :meth:`_do_handoff`.
+        self._pending_handoffs: list[dict] = []
         self._requests: list[FleetRequest] = []
         #: Requests with no eligible/accepting replica right now; retried
         #: every pump — the zero-reject guarantee during rebuild windows.
@@ -605,6 +662,7 @@ class Router:
             "TDT_HTTP_PORT": "0",           # ephemeral: N replicas, one host
             "TDT_HTTP_PORT_FILE": h.port_file,
             "TDT_JOURNAL_DIR": gdir,
+            "TDT_POOL_ROLE": h.role,
         })
         # Flight recorder next to the journal by default: the postmortem
         # harvest path. An explicit setting in self.env wins (""  disables —
@@ -878,13 +936,41 @@ class Router:
             telemetry.set_gauge("tdt_tenant_pending_requests",
                                 float(counts.get(t, 0)), tenant=t)
 
-    def _eligible(self) -> list[ReplicaHandle]:
+    def _eligible(self, phase: str | None = None) -> list[ReplicaHandle]:
         """Replicas placement may use: alive, not draining, health LIVE —
         SUSPECT/QUARANTINED replicas keep their streams but take no new
-        work until they prove themselves again."""
-        return [h for h in self._replicas
+        work until they prove themselves again. ``phase`` ("prefill" /
+        "decode", disaggregated fleets only) keeps each pool to its side
+        of the split; unified replicas serve both phases."""
+        live = [h for h in self._replicas
                 if h.alive and not h.draining
                 and h.health.state == HEALTH_LIVE]
+        if phase is None:
+            return live
+        want = ROLE_PREFILL if phase == "prefill" else ROLE_DECODE
+        return [h for h in live if h.role in (want, ROLE_UNIFIED)]
+
+    def _phase_candidates(self, fr: FleetRequest) -> list[ReplicaHandle]:
+        """Pool-aware candidate set for ``fr``: fresh requests go to the
+        prefill pool, seeded resumes (migrations, handoff fallbacks) and
+        anything already streaming to the decode pool. When a whole pool
+        is gone (every member dead/draining), placement falls back to ANY
+        eligible replica — a unified admit is always byte-identical, just
+        not phase-isolated — so the client never sees a reject for a
+        pool-sized failure."""
+        if not self.disagg:
+            return self._eligible()
+        phase = "decode" if (fr._seed or fr.tokens) else "prefill"
+        cands = self._eligible(phase)
+        if not cands:
+            cands = self._eligible()
+            if cands:
+                telemetry.inc("tdt_disagg_pool_fallbacks_total", phase=phase)
+                telemetry.emit("fleet_pool_fallback", phase=phase,
+                               fleet_id=fr.fleet_id)
+                tdt_log(f"[fleet] no LIVE {phase}-pool replica; placing "
+                        f"request {fr.fleet_id} across pools", level="warn")
+        return cands
 
     def _expire_if_due(self, fr: FleetRequest) -> bool:
         """Finish ``fr`` router-side with ``finish_reason="deadline"`` when
@@ -945,7 +1031,7 @@ class Router:
                     psp["attrs"].update(kv)
 
             infos = []
-            for h in self._eligible():
+            for h in self._phase_candidates(fr):
                 try:
                     infos.append((h, self._http(
                         h, "/fleet/placement",
@@ -1079,6 +1165,10 @@ class Router:
             body["tokens"] = list(seed)
             resp = self._http(h, "/fleet/resume", body)
         else:
+            if self.disagg and h.role == ROLE_PREFILL:
+                # Prefill pool: run prefill + the first sampled token, then
+                # park the KV for the handoff splice instead of decoding.
+                body["prefill_only"] = True
             resp = self._http(h, "/fleet/submit", body)
         if resp.get("state") != "queued":
             return False
@@ -1161,6 +1251,11 @@ class Router:
             worked = self._poll_replica(h) or worked
         worked = self._autoscale(now) or worked
         self._slo_tick(now)
+        if self._pending_handoffs:
+            todo, self._pending_handoffs = self._pending_handoffs, []
+            for entry in todo:
+                self._do_handoff(entry)
+                worked = True
         if self._pending:
             still = []
             # WFQ order: lowest virtual finish tag places first — the
@@ -1352,7 +1447,11 @@ class Router:
         idx = len(self._replicas)
         h = ReplicaHandle(idx, os.path.join(self.workdir, f"r{idx}"))
         h.health = ReplicaHealth(now=time.monotonic(), **self._health_kw)
+        if self.disagg:
+            # Scale-up capacity lands where steady load is scarcest.
+            h.role = ROLE_DECODE
         self._replicas.append(h)
+        self.roles.append(h.role)
         self._spawn(h)
         h.booting = True
         h.boot_deadline = time.monotonic() + 240.0
@@ -1499,11 +1598,134 @@ class Router:
                 worked = True
             if st["done"]:
                 del h.inflight[rid]
-                self._finish(fr, st["reason"])
+                if st["reason"] == "handoff":
+                    # Not a client-visible finish: the prefill replica
+                    # parked the KV chain; pump splices it onto a decode
+                    # replica (or re-derives from the journal on failure).
+                    fr.replica = None
+                    fr.remote_id = None
+                    fr.handoff = "pending"
+                    self._pending_handoffs.append({
+                        "donor": h.idx, "rid": rid, "fr": fr,
+                        "at": time.monotonic(),
+                    })
+                else:
+                    self._finish(fr, st["reason"])
                 worked = True
         if worked:
             h.health.note_progress(time.monotonic())
         return worked
+
+    # ------------------------------------------------------------- handoff
+    def _do_handoff(self, entry: dict) -> None:
+        """Splice one prefill-done request onto the decode pool: export the
+        donor's parked KV blocks, import them on the least-loaded decode
+        replica, then release the donor's parked refs. ANY failure — donor
+        dead (kill -9 mid-transfer), export 404 (the donor rebuilt its
+        pool), import reject, wire fault — falls back to journal
+        re-derivation: the request re-places SEEDED with its delivered
+        token history and the decode replica recomputes the prefill KV
+        locally. Greedy determinism makes both paths byte-identical, so
+        the fallback trades only latency, never correctness."""
+        fr: FleetRequest = entry["fr"]
+        donor = self._replicas[entry["donor"]]
+        rid = entry["rid"]
+        if self._expire_if_due(fr):
+            self._release_handoff(donor, rid)
+            return
+        t0 = time.monotonic()
+        blob = None
+        if donor.alive:
+            try:
+                blob = self._http(
+                    donor, "/fleet/kv_export", {"req_id": rid}
+                )["kv"]
+            except FleetWireError as e:
+                tdt_log(f"[fleet] kv_export for request {fr.fleet_id} on "
+                        f"replica {donor.idx} answered {e.code}; falling "
+                        f"back to journal re-derivation", level="warn")
+            except OSError:
+                pass  # health accounted in _http; fall back below
+        if blob is not None:
+            target = self._place_import(fr, blob)
+            if target is not None:
+                dt = time.monotonic() - t0
+                fr.handoff = "ok"
+                nbytes = float(blob.get("wire_bytes", 0))
+                telemetry.inc("tdt_disagg_handoffs_total", outcome="ok")
+                telemetry.inc("tdt_disagg_handoff_bytes_total", nbytes)
+                telemetry.observe("tdt_disagg_handoff_seconds", dt)
+                fr.trace.point(
+                    "tdt_disagg_handoff", outcome="ok",
+                    from_replica=donor.idx, to_replica=target.idx,
+                    wire_bytes=int(nbytes),
+                )
+                self._release_handoff(donor, rid)
+                return
+        # Determinism fallback: seed the delivered history and re-place as
+        # a normal resume — the decode pool (or any survivor) re-derives
+        # the KV from the token history, byte-identical.
+        fr.handoff = "fallback"
+        if len(fr.tokens) > len(fr._seed):
+            fr._seed = list(fr.tokens)
+        fr.replica = None
+        fr.remote_id = None
+        fr.migrations += 1
+        telemetry.inc("tdt_disagg_handoffs_total", outcome="fallback")
+        telemetry.inc("tdt_fleet_migrations_total", reason="handoff_fallback")
+        fr.trace.point("tdt_disagg_handoff", outcome="fallback",
+                       from_replica=donor.idx, seeded=len(fr._seed))
+        tdt_log(f"[fleet] handoff of request {fr.fleet_id} from replica "
+                f"{donor.idx} failed; re-deriving KV from journaled "
+                f"history ({len(fr._seed)} token(s))", level="warn")
+        self._release_handoff(donor, rid)
+        if not self._try_place(fr):
+            self._park(fr)
+
+    def _place_import(self, fr: FleetRequest, blob: dict):
+        """Admit ``fr`` + its wire KV on the least-loaded decode-pool
+        replica (any eligible replica when the pool is gone). Returns the
+        accepting handle, or None when nobody queued it. Prefix affinity
+        buys nothing here — the KV ships with the request — so the rank
+        is load only, no placement probes."""
+        cands = self._eligible("decode" if self.disagg else None)
+        if not cands:
+            cands = self._eligible()
+        cands.sort(key=lambda h: (len(h.inflight), h.idx))
+        body = self._stamp(fr, None, {
+            "prompt": fr.prompt, "max_new": fr.max_new,
+            "tokens": list(fr.tokens), "kv": blob,
+            "priority": fr.priority,
+            "tenant": fr.tenant, "weight": fr.weight,
+        })
+        if fr.deadline_s is not None:
+            body["deadline_s"] = \
+                fr.deadline_s - (time.monotonic() - fr.arrived_at)
+        for h in cands:
+            try:
+                resp = self._http(h, "/fleet/kv_import", body)
+            except (OSError, FleetWireError):
+                continue
+            if resp.get("state") != "queued":
+                continue
+            fr.replica = h.idx
+            fr.remote_id = int(resp["req_id"])
+            h.inflight[fr.remote_id] = fr
+            h.health.note_progress(time.monotonic())
+            return h
+        return None
+
+    def _release_handoff(self, donor: ReplicaHandle, rid: int) -> None:
+        """Best-effort drop of the donor's parked refs — the donor also
+        drops them itself on any pool rebuild, so a miss here leaks
+        nothing durable."""
+        if not donor.alive:
+            return
+        try:
+            self._http(donor, "/fleet/kv_release", {"req_id": rid},
+                       retries=0)
+        except (OSError, FleetWireError):
+            pass
 
     def serve_all(self, timeout_s: float = 600.0, poll_s: float = 0.01,
                   idle_cap_s: float = 0.1) -> None:
@@ -1595,7 +1817,8 @@ class Router:
         for rid, fr in moved:
             rr = state.get(rid)
             jt = [int(t) for t in rr.tokens] if rr is not None else []
-            if rr is not None and rr.done:
+            if rr is not None and rr.done \
+                    and rr.finish_reason != "handoff":
                 # Finished on the donor before it went away: the journal
                 # fsyncs every finish, so the full stream is durable —
                 # complete from the journal, nothing to re-place.
@@ -1605,6 +1828,13 @@ class Router:
                               reason=f"{reason}_journal_complete")
                 self._finish(fr, rr.finish_reason)
                 continue
+            if rr is not None and rr.done and rr.finish_reason == "handoff":
+                # Prefill parked the KV and died before the router could
+                # splice it: the parked content is gone with the process,
+                # but the journaled history re-derives it byte-identically.
+                fr.handoff = "fallback"
+                telemetry.inc("tdt_disagg_handoffs_total",
+                              outcome="fallback")
             fr._seed = jt if len(jt) > len(fr.tokens) else list(fr.tokens)
             fr.replica = None
             fr.remote_id = None
@@ -1739,7 +1969,7 @@ class Router:
             "replicas": [
                 {
                     "idx": h.idx, "alive": h.alive, "draining": h.draining,
-                    "retired": h.retired,
+                    "retired": h.retired, "role": h.role,
                     "gen": h.gen, "port": h.port,
                     "inflight": len(h.inflight),
                     "pid": None if h.proc is None else h.proc.pid,
@@ -1747,6 +1977,8 @@ class Router:
                 }
                 for h in self._replicas
             ],
+            "disagg": self.disagg,
+            "pending_handoffs": len(self._pending_handoffs),
             "pending": len(self._pending),
             "requests": len(self._requests),
             "done": sum(1 for fr in self._requests if fr.done),
@@ -1815,7 +2047,8 @@ class Router:
         merged = self._merge_scrapes(scrapes)
         local = telemetry.snapshot()
         local_prefixes = (
-            "tdt_fleet_", "tdt_flight_", "tdt_tenant_", "tdt_slo_"
+            "tdt_fleet_", "tdt_flight_", "tdt_tenant_", "tdt_slo_",
+            "tdt_disagg_",
         )
         for sec in ("counters", "gauges"):
             for name, entries in local.get(sec, {}).items():
@@ -1931,7 +2164,7 @@ class Router:
             entry = {
                 "idx": h.idx, "gen": h.gen, "port": h.port,
                 "alive": h.alive, "draining": h.draining,
-                "retired": h.retired,
+                "retired": h.retired, "role": h.role,
                 "pid": None if h.proc is None else h.proc.pid,
                 "inflight": len(h.inflight),
                 "placements": h.placements,
@@ -1960,6 +2193,21 @@ class Router:
             reps.append(entry)
         return {
             "replicas": reps,
+            "disagg": self.disagg,
+            "pools": {
+                role: [h.idx for h in self._replicas if h.role == role]
+                for role in (ROLE_PREFILL, ROLE_DECODE, ROLE_UNIFIED)
+                if any(h.role == role for h in self._replicas)
+            },
+            "pending_handoffs": [
+                {"fleet_id": e["fr"].fleet_id, "donor": e["donor"]}
+                for e in self._pending_handoffs
+            ],
+            "handoffs": {
+                state: sum(1 for fr in self._requests
+                           if fr.handoff == state)
+                for state in ("pending", "ok", "fallback")
+            },
             "pending": len(self._pending),
             "requests": len(self._requests),
             "done": sum(1 for fr in self._requests if fr.done),

@@ -17,19 +17,35 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import PartitionSpec as P
 
 import triton_dist_tpu.language as tpl
+from triton_dist_tpu.runtime import resilience
 from triton_dist_tpu.runtime.mesh import DistContext
+from triton_dist_tpu.shmem import kernel as sk
 from triton_dist_tpu.shmem.kernel import dist_pallas_call
 
 
-def _p2p_kernel(x_ref, out_ref, send_sem, recv_sem, copy_sem, *, axis, mesh_axes, offset):
+def _p2p_kernel(x_ref, out_ref, status_ref, send_sem, recv_sem, copy_sem, *,
+                axis, mesh_axes, offset):
     """Every rank sends its buffer to rank+offset and receives from
-    rank-offset (a ppermute — the building block of PP stage handoff)."""
+    rank-offset (a ppermute — the building block of PP stage handoff).
+
+    Bounded-wait adopter: the recv and the closing barrier poll through
+    the status buffer, so a dead pipeline neighbour aborts this stage in
+    ``TDT_WAIT_BOUND_ITERS`` polls (phase ``pp_recv``, peer = the upstream
+    stage) instead of wedging the whole pipeline schedule."""
+    sk.init_status(status_ref, axis=axis)
+    me = tpl.rank(axis)
+    world = tpl.num_ranks(axis)
+    # My arrival comes from the rank ``offset`` behind me on the ring.
+    src = jax.lax.rem(me - jnp.int32(offset % world) + world, world)
     dst = tpl.ring_neighbor(axis, offset, mesh_axes=mesh_axes)
     dma = tpl.putmem_signal(x_ref, out_ref, send_sem, recv_sem, dst)
     dma.start()
-    tpl.wait_recv(recv_sem, out_ref)
+    sk.bounded_wait_recv(recv_sem, out_ref, status_ref,
+                         phase="pp_recv", peer=src)
+    # Send-leg drain is a LOCAL DMA completion — unbounded by design.
     dma.wait_send()
-    tpl.barrier_all(axis, mesh_axes=mesh_axes)
+    sk.bounded_barrier_all(status_ref, axis, mesh_axes=mesh_axes,
+                           phase="barrier")
 
 
 import functools as _functools
@@ -67,26 +83,35 @@ def _p2p_put_impl(
     if use_xla or world == 1:
         perm = [(i, (i + offset) % world) for i in range(world)]
         return jax.lax.ppermute(x, axis, perm)
-    return dist_pallas_call(
+    out, status = dist_pallas_call(
         functools.partial(_p2p_kernel, axis=axis, mesh_axes=mesh_axes, offset=offset),
-        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        out_shape=(
+            jax.ShapeDtypeStruct(x.shape, x.dtype),
+            sk.status_out_shape(),
+        ),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
-        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        out_specs=(pl.BlockSpec(memory_space=pl.ANY), sk.status_out_spec()),
         scratch_shapes=[
             pltpu.SemaphoreType.DMA,
             pltpu.SemaphoreType.DMA,
             pltpu.SemaphoreType.DMA,
         ],
     )(x)
+    resilience.consume_status(status, feature="p2p", kernel="_p2p_kernel")
+    return out
 
 
-def p2p_send_recv(ctx: DistContext, x: jax.Array, *, axis: str = "pp", offset: int = 1) -> jax.Array:
+def p2p_send_recv(ctx: DistContext, x: jax.Array, *, axis: str = "pp",
+                  offset: int = 1, use_xla: bool | None = None) -> jax.Array:
     """Standalone host op: shift ``x`` (sharded on dim 0 over ``axis``) by
-    ``offset`` stages (reference host p2p ops)."""
+    ``offset`` stages (reference host p2p ops). ``use_xla`` None routes by
+    platform — the one-sided kernel on TPU, collective-permute elsewhere."""
     mesh_axes = ctx.axis_names
+    if use_xla is None:
+        use_xla = jax.default_backend() != "tpu"
 
     def fn(x_local):
-        return p2p_put_shard(x_local, axis, offset, mesh_axes)
+        return p2p_put_shard(x_local, axis, offset, mesh_axes, use_xla)
 
     shard_f = jax.shard_map(
         fn, mesh=ctx.mesh, in_specs=P(axis), out_specs=P(axis), check_vma=False

@@ -22,8 +22,44 @@ import jax
 import jax.numpy as jnp
 
 from triton_dist_tpu.layers.pp import PPCommLayer
+from triton_dist_tpu.runtime.utils import get_int_env
 
 
+def _tick(stage_fn, x, recv, out, aux, t, *, me, world, m_total):
+    """One GPipe tick, shared by the unrolled and scanned schedules: at
+    tick ``t`` stage ``me`` handles microbatch ``m = t - me`` (masked ticks
+    compute on zeros and discard). Returns (y, out', aux')."""
+    m = t - me  # microbatch index this stage handles at tick t
+    active = jnp.logical_and(m >= 0, m < m_total)
+    m_idx = jnp.clip(m, 0, m_total - 1)
+    # Stage 0 injects fresh microbatches; later stages consume the wire.
+    inj = jax.lax.dynamic_index_in_dim(x, m_idx, axis=0, keepdims=False)
+    inp = jnp.where(me == 0, inj, recv)
+    if aux is None:
+        y, a = stage_fn(inp), None
+    else:
+        y, a = stage_fn(inp)
+    y = jnp.where(active, y, jnp.zeros_like(y))
+    # Last stage records its finished microbatch.
+    take = jnp.logical_and(active, me == world - 1)
+    out = jax.lax.dynamic_update_index_in_dim(
+        out,
+        jnp.where(take, y, jax.lax.dynamic_index_in_dim(out, m_idx, 0, keepdims=False)),
+        m_idx,
+        axis=0,
+    )
+    if aux is not None:
+        # Every ACTIVE stage records its per-microbatch aux (stage-local KV
+        # in the engine's prefill) — unlike ``out``, which only the last
+        # stage owns; masked ticks keep the buffer untouched.
+        def _upd(buf, leaf):
+            old = jax.lax.dynamic_index_in_dim(buf, m_idx, 0, keepdims=False)
+            return jax.lax.dynamic_update_index_in_dim(
+                buf, jnp.where(active, leaf, old), m_idx, axis=0
+            )
+
+        aux = jax.tree.map(_upd, aux, a)
+    return y, out, aux
 
 
 def gpipe_forward(
@@ -32,7 +68,9 @@ def gpipe_forward(
     *,
     axis: str = "pp",
     comm: PPCommLayer | None = None,
-) -> jax.Array:
+    unroll: bool | None = None,
+    aux_init=None,
+):
     """Run the GPipe forward schedule; returns the (M, mb, d) pipeline
     output **on the last stage** (zeros elsewhere — callers broadcast or
     keep outputs stage-local, matching the reference's last-rank gather).
@@ -40,35 +78,54 @@ def gpipe_forward(
     Shard-local (inside shard_map over ``axis``). ``stage_fn`` must keep
     the microbatch shape (transformer stages do); it runs on every tick —
     masked ticks compute on zeros and their results are discarded.
+
+    ``unroll`` picks the schedule body: True statically unrolls the
+    ``M + S - 1`` ticks (one copy of the stage program per tick — fastest
+    to run, compile time grows with M); False rolls them into one
+    ``jax.lax.scan`` body (constant compile cost for any M — the long-M /
+    big-stage choice). None reads ``TDT_PP_UNROLL`` (default 1). Both
+    bodies share ``_tick``, so their outputs are bitwise identical; the
+    scan body is uniform across ticks and therefore issues one extra
+    final-tick ``send_next`` whose result is discarded.
+
+    ``aux_init`` opts into stage-local per-microbatch side outputs (the
+    PP engine's KV caches): a pytree of zeroed ``(M, ...)`` buffers; with
+    it, ``stage_fn`` returns ``(y, aux_leafs)`` and every active stage
+    writes its microbatch's aux at index ``m`` — the call then returns
+    ``(out, aux)``.
     """
     comm = comm or PPCommLayer(axis=axis)
     world = jax.lax.axis_size(axis)
     me = jax.lax.axis_index(axis)
-    m_total, mb, d = x.shape
+    m_total = x.shape[0]
     steps = m_total + world - 1
+    if unroll is None:
+        unroll = get_int_env("TDT_PP_UNROLL", 1) != 0
 
-    recv = jnp.zeros((mb, d), x.dtype)
-    out = jnp.zeros((m_total, mb, d), x.dtype)
-    for t in range(steps):  # static unroll: uniform program on every rank
-        m = t - me  # microbatch index this stage handles at tick t
-        active = jnp.logical_and(m >= 0, m < m_total)
-        m_idx = jnp.clip(m, 0, m_total - 1)
-        # Stage 0 injects fresh microbatches; later stages consume the wire.
-        inj = jax.lax.dynamic_index_in_dim(x, m_idx, axis=0, keepdims=False)
-        inp = jnp.where(me == 0, inj, recv)
-        y = stage_fn(inp)
-        y = jnp.where(active, y, jnp.zeros_like(y))
-        # Last stage records its finished microbatch.
-        take = jnp.logical_and(active, me == world - 1)
-        out = jax.lax.dynamic_update_index_in_dim(
-            out,
-            jnp.where(take, y, jax.lax.dynamic_index_in_dim(out, m_idx, 0, keepdims=False)),
-            m_idx,
-            axis=0,
-        )
-        if t + 1 < steps:
-            recv = comm.send_next(y)
-    return out
+    recv = jnp.zeros(x.shape[1:], x.dtype)
+    out = jnp.zeros_like(x)
+    aux = aux_init
+    if unroll:
+        for t in range(steps):  # static unroll: uniform program on every rank
+            y, out, aux = _tick(stage_fn, x, recv, out, aux, t,
+                                me=me, world=world, m_total=m_total)
+            if t + 1 < steps:
+                recv = comm.send_next(y)
+        return out if aux_init is None else (out, aux)
+
+    def body(carry, t):
+        recv, out, aux = carry
+        y, out, aux = _tick(stage_fn, x, recv, out, aux, t,
+                            me=me, world=world, m_total=m_total)
+        # Uniform scan body: every tick sends, including the last (whose
+        # arrival nobody reads) — a divergent final tick would need a
+        # lax.cond around the collective, which starves the rendezvous.
+        return (comm.send_next(y), out, aux), None
+
+    (_, out, aux), _ = jax.lax.scan(
+        body, (recv, out, aux), jnp.arange(steps, dtype=jnp.int32)
+    )
+    return out if aux_init is None else (out, aux)
 
 
 def gpipe_stage_params(params: jax.Array, num_layers: int, axis: str = "pp"):

@@ -311,6 +311,32 @@ class Engine:
             )
             self._verify_shard_paged = None
 
+        # ---- TP×PP: pipeline the stack over a 2-D pp×tp mesh --------------
+        # When the mesh carries a "pp" axis the one-shot prefill and the
+        # dense decode step are swapped for the GPipe programs
+        # (disagg/pp_engine.py) — same specs, so everything downstream
+        # (generate, decode_chunk, the paged bounce, serve) composes
+        # unchanged. Chunked prefill and verify stay the replicated
+        # single-stage programs: correct (pp ranks compute redundantly),
+        # just not pipelined.
+        self.pp_world = (
+            int(mesh.shape["pp"]) if "pp" in ctx.axis_names else 1
+        )
+        if self.pp_world > 1:
+            if backend not in ("xla", "dist_ar"):
+                raise ValueError(
+                    f"pp>1 supports the xla/dist_ar backends, not "
+                    f"{backend!r}: dist seq-shards prefill rows and mega "
+                    "pre-splits layer params — neither composes with "
+                    "stage-sliced layer blocks"
+                )
+            from triton_dist_tpu.disagg.pp_engine import build_pp_programs
+
+            self._prefill, self._decode_shard = build_pp_programs(
+                self, p_specs=p_specs, tok_spec=tok_spec,
+                kv_spec=kv_spec, len_spec=len_spec,
+            )
+
         # One compiled program per gen_len: the whole decode loop on device
         # (the XLA analog of replaying a captured CUDA graph gen_len times,
         # minus the per-token host dispatch).

@@ -155,6 +155,17 @@ class Request:
     kv_blocks: list[int] = dataclasses.field(default_factory=list)
     kv_shared: int = 0
     kv_wait: bool = False
+    #: Disaggregated serving (``docs/disagg.md``). ``prefill_only``: this
+    #: replica runs prefill + the first token, then parks the KV chain for
+    #: handoff instead of decoding. ``kv_import``: an unpacked handoff
+    #: payload (``disagg.kv_transfer``) to scatter into this request's
+    #: chain in place of a local prefill; consumed (set back to None) the
+    #: first time it is applied, so a post-crash re-prefill falls back to
+    #: deriving KV from the token history.
+    prefill_only: bool = False
+    kv_import: dict | None = dataclasses.field(
+        default=None, repr=False, compare=False
+    )
     tokens: list[int] = dataclasses.field(default_factory=list)
     #: Per-request trace handle (``runtime.tracing``). ``submit`` opens it;
     #: the server closes it at completion. Defaults to the no-op handle so
@@ -618,7 +629,8 @@ class Scheduler:
                deadline_s: float | None = None,
                tokens=None,
                trace_ctx: "tracing.SpanContext | None" = None,
-               tenant: str = "default", weight: float = 1.0) -> Request:
+               tenant: str = "default", weight: float = 1.0,
+               prefill_only: bool = False) -> Request:
         """Admission-check and enqueue one request (FCFS). Returns the
         request handle; a rejected request comes back with
         ``state=REJECTED`` and ``reject_reason`` set — it is NOT queued.
@@ -639,6 +651,7 @@ class Scheduler:
             on_token=on_token, on_finish=on_finish,
             priority=int(priority),
             tenant=str(tenant), weight=float(weight),
+            prefill_only=bool(prefill_only),
             tokens=[int(t) for t in tokens] if tokens else [],
             ttft_deadline_s=(
                 _env_deadline("TDT_DEADLINE_TTFT_S")

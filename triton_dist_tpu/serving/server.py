@@ -109,6 +109,8 @@ Env knobs::
     TDT_JOURNAL_DIR       directory for the write-ahead journal (unset = off)
     TDT_JOURNAL_FSYNC     journal appends between fsyncs (default 8)
     TDT_DRAIN_TIMEOUT_S   shutdown drain budget, s (0 = unbounded)
+    TDT_POOL_ROLE         disaggregated pool role: unified (default) |
+                          prefill | decode — see docs/disagg.md
 
 Metrics (``tdt_serving_*``, see ``docs/serving.md`` and
 ``docs/observability.md``): request/completion/reject/preemption/recovery
@@ -126,6 +128,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from triton_dist_tpu.disagg.kv_transfer import (
+    pack_kv_blocks,
+    scatter_kv_blocks,
+    unpack_kv_blocks,
+)
+from triton_dist_tpu.disagg.pool import pool_role_from_env, role_id
 from triton_dist_tpu.models.quant import kv_quant_from_env
 from triton_dist_tpu.runtime import resilience, slo, telemetry, tracing
 from triton_dist_tpu.runtime.utils import get_float_env, get_int_env
@@ -197,6 +205,18 @@ class InferenceServer:
                 self.num_blocks, self.block_size,
                 prefix_reuse=get_int_env("TDT_PREFIX_REUSE", 1) != 0,
             )
+        #: Disaggregated-pool role (``TDT_POOL_ROLE``, docs/disagg.md): a
+        #: "prefill" replica parks finished prefills for handoff instead of
+        #: decoding them; a "decode" replica receives parked KV over the
+        #: wire; "unified" (the default) serves both phases.
+        self.role = pool_role_from_env()
+        telemetry.set_gauge("tdt_disagg_pool_role", float(role_id(self.role)))
+        #: Parked handoffs awaiting export: req_id -> {"blocks", "length",
+        #: "tokens", "tenant"}. Each parked chain holds one extra allocator
+        #: ref per block, taken before the slot's release, so the prefilled
+        #: content survives until :meth:`release_handoff` (or process death
+        #: — the router then re-derives KV from the journaled history).
+        self._handoffs: dict[int, dict] = {}
         self.scheduler = Scheduler(
             self.num_slots, engine.max_len, queue_limit,
             shed_wait_s=shed_wait_s, shed_priority=shed_priority,
@@ -323,6 +343,8 @@ class InferenceServer:
         shedding = self.scheduler.shedding(self._now())
         return {
             "ready": not (shedding or self._shutdown or self._draining),
+            "role": self.role,
+            "parked_handoffs": len(self._handoffs),
             "shedding": shedding,
             "draining": self._draining,
             "shutting_down": self._shutdown,
@@ -366,6 +388,8 @@ class InferenceServer:
                         kv_len=int(self._lengths[slot.idx]),
                         prefilling=slot.idx in self._prefilling,
                     )
+                if req.prefill_only:
+                    entry["prefill_only"] = True
                 if self.spec_k >= 2:
                     entry.update(
                         spec_k=int(self._kcap[slot.idx]),
@@ -386,6 +410,11 @@ class InferenceServer:
             **({"ep": self._ep_info()} if self._is_ep_model() else {}),
             "mesh_epoch": resilience.mesh_epoch(),
             "backend": self.engine.backend,
+            "role": self.role,
+            "handoffs": {
+                "parked": len(self._handoffs),
+                "req_ids": sorted(self._handoffs),
+            },
             "shutting_down": self._shutdown,
             "queue_depth": self.scheduler.queue_depth(),
             "queued": self.scheduler.queued_summary(now),
@@ -451,7 +480,7 @@ class InferenceServer:
                ttft_deadline_s: float | None = None,
                deadline_s: float | None = None,
                trace_ctx=None, tenant: str = "default",
-               weight: float = 1.0) -> Request:
+               weight: float = 1.0, prefill_only: bool = False) -> Request:
         """Admission-check and enqueue one request; returns its handle
         (``state=REJECTED`` + ``reject_reason`` when not admitted). Admitted
         requests are journaled (write-ahead) when a journal is attached —
@@ -459,13 +488,20 @@ class InferenceServer:
         land in the survivor's per-tenant accounting byte-identically.
         ``trace_ctx`` (an extracted ``tracing.SpanContext``) makes the
         request trace continue a remote caller's trace — the fleet replica
-        passes the router's propagated context through here."""
+        passes the router's propagated context through here.
+        ``prefill_only`` (paged mode only) runs prefill + the first token
+        and then parks the KV chain for a disaggregated handoff instead of
+        decoding — see docs/disagg.md."""
+        if prefill_only and not self.paged:
+            raise ValueError(
+                "prefill_only requires paged serving (TDT_SERVING_PAGED=1)"
+            )
         req = self.scheduler.submit(
             prompt, max_new, arrival_time_s=arrival_time_s,
             on_token=on_token, on_finish=on_finish, now_s=self._now(),
             priority=priority, ttft_deadline_s=ttft_deadline_s,
             deadline_s=deadline_s, trace_ctx=trace_ctx,
-            tenant=tenant, weight=weight,
+            tenant=tenant, weight=weight, prefill_only=prefill_only,
         )
         if self._journal is not None and req.state is RequestState.QUEUED:
             # Rejections are never journaled: there is nothing to resume.
@@ -595,6 +631,74 @@ class InferenceServer:
             return []
         return self._journal.read_records()
 
+    # ------------------------------------------------- disaggregated handoff
+    def export_kv(self, req_id: int) -> dict:
+        """Pack a parked handoff's prefilled blocks into a wire blob
+        (``disagg.kv_transfer`` v1 format). Read-only and retryable: the
+        parked state stays until :meth:`release_handoff`. Raises
+        ``KeyError`` when nothing is parked under ``req_id`` (the request
+        never parked, or a recovery rebuild dropped the chain) — the
+        caller's cue to re-derive from the journaled history."""
+        st = self._handoffs.get(int(req_id))
+        if st is None:
+            raise KeyError(f"no parked handoff for request {int(req_id)}")
+        return pack_kv_blocks(self.cache, st["blocks"], length=st["length"])
+
+    def release_handoff(self, req_id: int) -> bool:
+        """Drop a parked handoff's extra block refs (the transfer landed,
+        or the router abandoned it). Idempotent; False when unknown."""
+        st = self._handoffs.pop(int(req_id), None)
+        if st is None:
+            return False
+        self.kv_ledger.allocator.free(st["blocks"])
+        self._publish_kv_gauges()
+        telemetry.emit("serving_handoff_released", req_id=int(req_id))
+        return True
+
+    def import_kv(self, prompt, max_new: int, tokens, kv_blob: dict, *,
+                  on_token=None, on_finish=None, priority: int = 1,
+                  ttft_deadline_s: float | None = None,
+                  deadline_s: float | None = None, trace_ctx=None,
+                  tenant: str = "default", weight: float = 1.0) -> Request:
+        """Decode-pool half of a handoff: admit a request whose prefill KV
+        arrives OVER THE WIRE. ``tokens`` is the donor's streamed history
+        (at least the first sampled token — the donor always samples and
+        streams token0 before parking); admission runs normally (KV budget,
+        shedding), the payload is applied by the join sweep in place of a
+        local prefill, and seeded tokens are NOT re-streamed. The payload
+        is consumed on first application, so a crash after admission falls
+        back to re-deriving the same KV from the journaled token history —
+        the stream stays byte-identical either way."""
+        if not self.paged:
+            raise ValueError(
+                "KV import requires paged serving (TDT_SERVING_PAGED=1)"
+            )
+        payload = unpack_kv_blocks(kv_blob)
+        toks = [int(t) for t in tokens][: int(max_new)]
+        if not toks:
+            raise ValueError("KV import needs the donor's token history")
+        req = self.scheduler.submit(
+            prompt, max_new, on_token=on_token, on_finish=on_finish,
+            now_s=self._now(), priority=priority,
+            ttft_deadline_s=ttft_deadline_s, deadline_s=deadline_s,
+            tokens=toks, trace_ctx=trace_ctx, tenant=tenant, weight=weight,
+        )
+        if req.state is not RequestState.QUEUED:
+            return req
+        req.kv_import = payload
+        if self._journal is not None:
+            self._journal.append(
+                "submit", req_id=req.req_id, prompt=req.prompt,
+                max_new=req.max_new, arrival_time_s=req.arrival_time_s,
+                priority=req.priority, tenant=req.tenant,
+                weight=req.weight, ttft_deadline_s=req.ttft_deadline_s,
+                deadline_s=req.deadline_s,
+            )
+            self._journal.append(
+                "chunk", req_id=req.req_id, start=0, tokens=toks
+            )
+        return req
+
     # ------------------------------------------------------------------- loop
     def step(self) -> bool:
         """One scheduler iteration: probe a due circuit breaker (restoring
@@ -663,6 +767,17 @@ class InferenceServer:
         self._lengths = np.zeros((self.num_slots,), np.int32)
         led = self.kv_ledger
         led.prefix.clear()
+        if self._handoffs:
+            # A pool rebuild invalidates every parked chain's CONTENT, so
+            # the parked refs must not outlive it: drop them — a later
+            # export fails loudly and the router re-derives the KV from the
+            # journaled token history instead of shipping garbage.
+            for st in self._handoffs.values():
+                led.allocator.free(st["blocks"])
+            telemetry.emit(
+                "serving_handoffs_dropped", n=len(self._handoffs),
+            )
+            self._handoffs.clear()
         occupied = self.scheduler.occupied_slots()
         for slot in occupied:
             led.release(slot.request)
@@ -808,6 +923,22 @@ class InferenceServer:
         HERE, in join order, so the token stream matches the slot-mode
         server byte-for-byte."""
         req = slot.request
+        if req.kv_import is not None:
+            # Disaggregated handoff: the prefill KV arrived over the wire.
+            # The payload is consumed up front so any failure — a malformed
+            # blob, a pool-geometry mismatch, a recovery preemption — falls
+            # back to deriving the very same KV from the token history
+            # below (the determinism fallback: stored wire bytes and a
+            # local prefill produce bitwise-identical blocks).
+            payload, req.kv_import = req.kv_import, None
+            try:
+                self._import_prefill(slot, payload)
+                return
+            except Exception as e:
+                telemetry.emit(
+                    "serving_kv_import_failed", req_id=req.req_id,
+                    error=f"{type(e).__name__}: {e}",
+                )
         ids = req.prompt + req.tokens[:-1]
         # Scripted chaos site: same discriminator as the slot-mode prefill.
         resilience.chaos_check("recovery" if req.tokens else "prefill")
@@ -900,6 +1031,10 @@ class InferenceServer:
                 self.scheduler.start_decode(slot)
             if self._remaining[slot.idx] == 0:
                 self._finish(slot)
+            elif req.prefill_only:
+                # A prefill-pool donor recovering mid-handoff re-parks: the
+                # re-derived chain is bitwise the one it would have shipped.
+                self._park_handoff(slot, p_len)
             return
         _, sub = jax.random.split(st["key"])
         tok = int(self.engine.sample_logits(logits, sub)[0])
@@ -911,6 +1046,72 @@ class InferenceServer:
             self._journal.append(
                 "prefill", req_id=req.req_id, start=0, tokens=[tok]
             )
+        if self._remaining[slot.idx] == 0:
+            self._finish(slot)
+        elif req.prefill_only:
+            self._park_handoff(slot, p_len)
+
+    def _park_handoff(self, slot: Slot, p_len: int) -> None:
+        """Prefill-pool half of a disaggregated handoff: keep the prefilled
+        chain alive under one extra allocator ref per block, record the
+        export state, and finish the slot with reason ``"handoff"`` — the
+        fleet router reads that finish as "ready to transfer", not
+        "complete". The parked blocks outlive the slot's release until
+        :meth:`release_handoff` (or process death, after which the router
+        re-derives the KV from the journaled token history)."""
+        req = slot.request
+        self.kv_ledger.allocator.incref(req.kv_blocks)
+        self._handoffs[req.req_id] = {
+            "blocks": list(req.kv_blocks),
+            "length": int(p_len),
+            "tokens": list(req.tokens),
+            "tenant": req.tenant,
+        }
+        telemetry.emit(
+            "serving_handoff_parked", req_id=req.req_id, kv_len=int(p_len),
+            n_blocks=len(req.kv_blocks),
+        )
+        self._finish(slot, reason="handoff")
+
+    def _import_prefill(self, slot: Slot, payload: dict) -> None:
+        """Apply an unpacked handoff payload in place of a local prefill:
+        CoW-isolate the chain (a prefix-index hit may have lent shared
+        blocks; every scattered block is fully overwritten, so no content
+        copy is needed), scatter the wire blocks in, and arm decode at the
+        seeded history. The sampling key is still split in join order, so
+        this server's key stream stays uniform with a local prefill."""
+        req = slot.request
+        ids = req.prompt + req.tokens[:-1]
+        p_len = len(ids)
+        if int(payload["length"]) != p_len:
+            raise ValueError(
+                f"handoff covers {payload['length']} rows, prefill history "
+                f"holds {p_len}"
+            )
+        if int(payload["n_blocks"]) > len(req.kv_blocks):
+            raise ValueError(
+                f"handoff ships {payload['n_blocks']} blocks, chain holds "
+                f"{len(req.kv_blocks)}"
+            )
+        self._key, _ = jax.random.split(self._key)
+        for j in range(len(req.kv_blocks)):
+            self.kv_ledger.make_writable(req, j)
+        self.cache = scatter_kv_blocks(self.cache, req.kv_blocks, payload)
+        self._lengths[slot.idx] = p_len
+        # The scattered content is bitwise what a local prefill writes, so
+        # indexing it for prefix reuse is as sound as after a local prefill.
+        self.kv_ledger.register_prefix(req)
+        self._push_tables()
+        self._publish_kv_gauges()
+        self._spec_prefill(slot.idx, ids)
+        self._last[slot.idx] = req.tokens[-1]
+        self._remaining[slot.idx] = max(req.max_new - len(req.tokens), 0)
+        if slot.state is SlotState.PREFILL:
+            self.scheduler.start_decode(slot)
+        telemetry.emit(
+            "serving_kv_import", req_id=req.req_id, kv_len=p_len,
+            n_blocks=int(payload["n_blocks"]),
+        )
         if self._remaining[slot.idx] == 0:
             self._finish(slot)
 
